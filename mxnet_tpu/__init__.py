@@ -1,0 +1,64 @@
+"""mxnet_tpu: a TPU-native deep learning framework.
+
+A from-scratch rebuild of the capabilities of early MXNet (the reference at
+`/root/reference`) designed for TPU/XLA:
+
+* imperative NDArray API with async dispatch (`mx.nd`),
+* symbolic graphs compiled by XLA (`mx.sym` + Executor),
+* data-parallel / model-parallel training over `jax.sharding` meshes
+  (KVStore + parallel),
+* data pipeline, optimizers, metrics, FeedForward/Module training loops.
+
+See SURVEY.md at the repo root for the reference component map.
+"""
+from __future__ import annotations
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context
+from . import engine
+from . import random  # noqa: A004
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import ops
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
+
+# Attach registry-driven functions to both namespaces (the reference's
+# auto-generated API surfaces).
+ops.populate_nd(nd.__dict__)
+symbol.populate(sym.__dict__)
+sym.Variable = symbol.Variable
+sym.Group = symbol.Group
+
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import monitor
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from .model import FeedForward
+from . import module as mod
+from . import module
+from . import visualization
+from . import visualization as viz
+from . import parallel
+from . import operator
+from .operator import PythonOp, NumpyOp, NDArrayOp
+
+__version__ = "0.1.0"

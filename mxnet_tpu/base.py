@@ -1,0 +1,67 @@
+"""Base types, error handling and dtype tables for mxnet_tpu.
+
+TPU-native rebuild of the reference's base layer (`include/mxnet/base.h`,
+`python/mxnet/base.py`).  Where the reference defines ctypes handle types over a C
+ABI, this framework is JAX-native: the "handles" are Python objects wrapping
+`jax.Array`s, and the dtype table mirrors the reference's integer type flags
+(`python/mxnet/ndarray.py` `_DTYPE_NP_TO_MX`) so the binary checkpoint format stays
+compatible, with bfloat16 added as a first-class TPU dtype.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax.numpy's bfloat16 comes from ml_dtypes
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    bfloat16 = np.dtype("float32")
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu — mirrors the reference's `MXNetError`."""
+
+
+# Integer type flags.  0-4 match the reference (`python/mxnet/ndarray.py:30-44`)
+# so saved .params files round-trip; >=5 are TPU-era extensions.
+_DTYPE_NP_TO_MX = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    bfloat16: 5,
+    np.dtype(np.int64): 6,
+    np.dtype(np.int8): 7,
+    np.dtype(np.bool_): 8,
+    np.dtype(np.uint32): 9,
+    np.dtype(np.uint64): 10,
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+
+def np_dtype(dtype) -> np.dtype:
+    """Canonicalize any dtype-like object to a numpy dtype."""
+    if isinstance(dtype, int):
+        return _DTYPE_MX_TO_NP[dtype]
+    return np.dtype(dtype)
+
+
+def dtype_flag(dtype) -> int:
+    """Numpy dtype -> integer flag used in the serialization format."""
+    d = np_dtype(dtype)
+    if d not in _DTYPE_NP_TO_MX:
+        raise MXNetError("unsupported dtype %s" % d)
+    return _DTYPE_NP_TO_MX[d]
+
+
+def check_shape(shape) -> tuple:
+    """Canonicalize a shape argument to a tuple of ints (reference TShape)."""
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(x) for x in shape)
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)

@@ -1,0 +1,302 @@
+"""Host-side dependency engine.
+
+Reference: `include/mxnet/engine.h`, `src/engine/threaded_engine.{h,cc}`,
+`src/engine/threaded_engine_perdevice.cc`, `src/engine/naive_engine.cc`.
+
+TPU-first split of responsibilities:
+
+* **Device compute ordering is XLA/JAX's job.**  Every jnp op on a `jax.Array`
+  is dispatched asynchronously and sequenced per-device by the runtime, which is
+  exactly what the reference's per-device worker threads + CUDA streams did for
+  mshadow kernels.  We do not re-schedule device work.
+* **Host-side ordering is ours.**  IO prefetch, KVStore host reductions,
+  checkpoint writes and custom host callbacks still need the reference's
+  single-writer / multi-reader versioned-variable semantics
+  (`threaded_engine.cc:32-168`).  This module implements that dependency
+  tracker over a thread pool, with the same API shape:
+  ``push(fn, const_vars, mutable_vars, priority)`` + ``wait_for_var`` /
+  ``wait_for_all``.
+
+Engine selection follows the reference (`src/engine/engine.cc:14-27`): set
+``MXNET_ENGINE_TYPE=NaiveEngine`` for a fully synchronous engine (debugging /
+deterministic bisection), default is the threaded engine.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import threading
+import weakref
+from collections import deque
+
+from .base import MXNetError
+
+
+class Var:
+    """A versioned variable: the unit of read/write dependency tracking.
+
+    State machine follows `ThreadedVar` (`src/engine/threaded_engine.cc:32-79`):
+    a FIFO of pending blocks per var; readers run concurrently, a writer waits
+    for all earlier readers and runs exclusively.
+    """
+
+    __slots__ = ("queue", "num_running_reads", "_engine", "__weakref__")
+
+    def __init__(self, engine):
+        self.queue = deque()  # entries: [is_write, op]
+        self.num_running_reads = 0
+        self._engine = engine
+
+    # All methods below are called with the engine lock held.
+    def append_read(self, op) -> bool:
+        """Register a read; returns True if the read can start now."""
+        if not self.queue:  # no queued writer ahead of us
+            self.num_running_reads += 1
+            return True
+        self.queue.append([False, op])
+        return False
+
+    def append_write(self, op) -> bool:
+        """Register a write; returns True if the write can start now."""
+        entry = [True, op]
+        self.queue.append(entry)
+        return self.queue[0] is entry and self.num_running_reads == 0
+
+    def complete_read(self):
+        """A reader finished; returns ops that became ready."""
+        self.num_running_reads -= 1
+        if self.num_running_reads == 0 and self.queue and self.queue[0][0]:
+            return [self.queue[0][1]]
+        return []
+
+    def complete_write(self):
+        """The head writer finished; returns ops that became ready."""
+        self.queue.popleft()
+        ready = []
+        while self.queue and not self.queue[0][0]:
+            _, op = self.queue.popleft()
+            self.num_running_reads += 1
+            ready.append(op)
+        if not ready and self.queue and self.num_running_reads == 0:
+            ready.append(self.queue[0][1])
+        return ready
+
+
+class _Opr:
+    __slots__ = ("fn", "const_vars", "mutable_vars", "priority", "wait", "name")
+
+    def __init__(self, fn, const_vars, mutable_vars, priority, name):
+        self.fn = fn
+        self.const_vars = const_vars
+        self.mutable_vars = mutable_vars
+        self.priority = priority
+        self.wait = 0
+        self.name = name
+
+
+class Engine:
+    """Threaded host-side dependency engine (default).
+
+    Reference: `ThreadedEnginePerDevice` with the var bookkeeping of
+    `ThreadedEngine`.  One pool of worker threads (host tasks have no
+    per-device affinity on TPU; device work is XLA's).
+    """
+
+    def __init__(self, num_workers=None):
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = []  # heapq of (-priority, seq, op)
+        self._seq = itertools.count()
+        self._num_pending = 0  # pushed but not completed
+        self._all_done = threading.Condition(self._lock)
+        self._shutdown = False
+        self._threads = []
+        self._exceptions = []
+        for i in range(max(1, num_workers)):
+            t = threading.Thread(target=self._worker, name="mx-engine-%d" % i, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- public API -------------------------------------------------------
+    def new_variable(self) -> Var:
+        return Var(self)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="opr"):
+        """Schedule ``fn()`` to run once all dependencies are satisfied.
+
+        ``const_vars`` are read, ``mutable_vars`` are written.  Overlapping or
+        duplicate var lists are rejected like `CheckDuplicate`
+        (`threaded_engine.cc:205-237`).
+        """
+        const_vars = list(const_vars)
+        mutable_vars = list(mutable_vars)
+        mset = set(map(id, mutable_vars))
+        if len(mset) != len(mutable_vars):
+            raise MXNetError("duplicate variables in mutable_vars")
+        if any(id(v) in mset for v in const_vars):
+            raise MXNetError("const_vars and mutable_vars overlap")
+        op = _Opr(fn, const_vars, mutable_vars, priority, name)
+        with self._lock:
+            if self._shutdown:
+                raise MXNetError("engine has been shut down")
+            self._num_pending += 1
+            op.wait = len(const_vars) + len(mutable_vars) + 1
+            satisfied = 1  # the +1 sentinel: op fully registered
+            for v in const_vars:
+                if v.append_read(op):
+                    satisfied += 1
+            for v in mutable_vars:
+                if v.append_write(op):
+                    satisfied += 1
+            op.wait -= satisfied
+            if op.wait == 0:
+                self._enqueue(op)
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), priority=0, name="opr"):
+        """Push and wait for this op to complete (reference `PushSync` is
+        async-push-of-sync-fn; this also blocks like DoSync callers expect)."""
+        done = threading.Event()
+        box = {}
+
+        def run():
+            try:
+                box["v"] = fn()
+            finally:
+                done.set()
+
+        self.push(run, const_vars, mutable_vars, priority, name)
+        done.wait()
+        self._raise_pending()
+        return box.get("v")
+
+    def wait_for_var(self, var: Var):
+        """Block until all previously pushed ops touching ``var`` finish.
+
+        Implemented as a sentinel read op, like `threaded_engine.cc:300-327`.
+        """
+        done = threading.Event()
+        self.push(done.set, const_vars=[var], name="wait_for_var")
+        done.wait()
+        self._raise_pending()
+
+    def wait_for_all(self):
+        """Block until the engine queue drains (`Engine::WaitForAll`)."""
+        with self._all_done:
+            while self._num_pending > 0:
+                self._all_done.wait()
+        self._raise_pending()
+
+    def shutdown(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    # -- internals --------------------------------------------------------
+    def _enqueue(self, op):
+        heapq.heappush(self._ready, (-op.priority, next(self._seq), op))
+        self._cv.notify()
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._ready and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._ready:
+                    return
+                _, _, op = heapq.heappop(self._ready)
+            try:
+                op.fn()
+            except Exception as e:  # surfaced at next sync point
+                with self._lock:
+                    self._exceptions.append(e)
+            self._complete(op)
+
+    def _complete(self, op):
+        with self._lock:
+            ready = []
+            for v in op.const_vars:
+                ready += v.complete_read()
+            for v in op.mutable_vars:
+                ready += v.complete_write()
+            for r in ready:
+                r.wait -= 1
+                if r.wait == 0:
+                    self._enqueue(r)
+            self._num_pending -= 1
+            if self._num_pending == 0:
+                self._all_done.notify_all()
+
+    def _raise_pending(self):
+        with self._lock:
+            if self._exceptions:
+                exc = self._exceptions[0]
+                self._exceptions.clear()
+                raise exc
+
+
+class NaiveEngine(Engine):
+    """Fully synchronous engine: ops execute inline at push.
+
+    Reference `src/engine/naive_engine.cc`; select with
+    ``MXNET_ENGINE_TYPE=NaiveEngine`` for debugging/determinism.
+    """
+
+    def __init__(self):  # no threads
+        self._exceptions = []
+        self._lock = threading.Lock()
+        self._num_pending = 0
+
+    def new_variable(self):
+        return Var(self)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0, name="opr"):
+        fn()
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), priority=0, name="opr"):
+        return fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+# Live NDArrays whose device buffers may still have in-flight XLA work; used by
+# wait_for_all() to give the reference's "engine drained" guarantee across both
+# the host engine and the XLA async dispatch queue.
+_live_arrays: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def get() -> Engine:
+    """Singleton engine (reference `Engine::Get`, `src/engine/engine.cc`)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            _engine = NaiveEngine() if etype == "NaiveEngine" else Engine()
+        return _engine
+
+
+def track_array(nd):
+    _live_arrays.add(nd)
+
+
+def wait_for_all():
+    """Drain host engine AND block on all live device arrays
+    (reference `MXNDArrayWaitAll`)."""
+    get().wait_for_all()
+    for nd in list(_live_arrays):
+        try:
+            nd.wait_to_read()
+        except Exception:
+            pass

@@ -1,0 +1,315 @@
+"""Executor: compiled forward/backward over a Symbol graph.
+
+Reference: `include/mxnet/symbolic.h:316-384` (`Executor::Bind/Forward/
+Backward/outputs`), `src/symbol/graph_executor.{h,cc}`, Python wrapper
+`python/mxnet/executor.py`.
+
+TPU-first redesign — what `GraphExecutor::Init` did once at Bind
+(`graph_executor.cc:927-939`: backward pass, placement, memory planning,
+cached engine ops) becomes: trace the DAG into a pure function and let XLA
+compile it.
+
+* Forward = one jitted call.  Training forward runs under `jax.vjp`, so the
+  linearization residuals are produced by the same compiled program — the
+  analogue of the reference pre-planning backward at bind time
+  (`MakeBackwardPass`, `static_graph.cc:411-530`).
+* Backward = the vjp function: XLA's autodiff replaces the explicit backward
+  nodes, gradient-sum aggregation (`CreateGradSumNode`) and
+  `DeclareBackwardDependency` pruning.
+* `grad_req` keeps reference semantics: 'write' overwrites the bound grad
+  array, 'add' accumulates (`kAddTo`), 'null' skips (`operator.h:23-36`).
+* Memory: XLA's buffer assignment subsumes `GraphStorageAllocator`
+  (inplace/colored reuse, `graph_memory_allocator.cc`); donation of input
+  buffers gives the in-place update ceiling.
+* Monitor callback (`symbolic.h:379-383`): eager interpretation path that
+  walks the same DAG un-jitted and reports every internal entry.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context
+from .ndarray import NDArray
+from .ops.registry import OpCtx
+from .symbol import Symbol, _topo_order
+
+
+def _build_graph_fn(symbol: Symbol):
+    """Trace plan: returns fn(arg_arrays, aux_arrays, rng, is_train) ->
+    (outputs, new_aux).  Pure — jit/vjp/pjit compose over it."""
+    heads = symbol._heads
+    order = _topo_order(heads)
+    arg_names = symbol.list_arguments()
+    arg_index = {n: i for i, n in enumerate(arg_names)}
+    # aux slots per node, in the same global order as list_auxiliary_states()
+    aux_slots = {}
+    n_aux = 0
+    for node in order:
+        if not node.is_variable:
+            k = len(node.op.list_aux(node.params))
+            if k:
+                aux_slots[id(node)] = (n_aux, n_aux + k)
+                n_aux += k
+
+    def fn(arg_arrays, aux_arrays, rng, is_train):
+        env = {}
+        new_aux = list(aux_arrays)
+        for seq, node in enumerate(order):
+            if node.is_variable:
+                env[(id(node), 0)] = arg_arrays[arg_index[node.name]]
+                continue
+            inputs = [env[(id(s), i)] for s, i in node.inputs]
+            lo, hi = aux_slots.get(id(node), (0, 0))
+            aux_in = new_aux[lo:hi]
+            key = (
+                jax.random.fold_in(rng, seq)
+                if getattr(node.op, "need_rng", False) and rng is not None
+                else None
+            )
+            octx = OpCtx(is_train=is_train, rng=key)
+            outs, aux_up = node.op.apply(octx, node.params, inputs, aux_in)
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+            for i, u in enumerate(aux_up):
+                if u is not None:
+                    new_aux[lo + i] = u
+        outputs = tuple(env[(id(n), i)] for n, i in heads)
+        return outputs, tuple(new_aux)
+
+    internal_entries = []
+    for node in order:
+        if node.is_variable:
+            internal_entries.append((node.name, (id(node), 0)))
+        else:
+            for i, oname in enumerate(node.op.list_outputs(node.params)):
+                internal_entries.append(("%s_%s" % (node.name, oname), (id(node), i)))
+
+    return fn, order, internal_entries
+
+
+def _as_list(arrays, names, what):
+    if arrays is None:
+        return None
+    if isinstance(arrays, dict):
+        missing = [n for n in names if n not in arrays]
+        if missing:
+            raise MXNetError("%s missing entries for %s" % (what, missing))
+        return [arrays[n] for n in names]
+    arrays = list(arrays)
+    if len(arrays) != len(names):
+        raise MXNetError(
+            "%s: expected %d arrays (%s), got %d"
+            % (what, len(names), names, len(arrays))
+        )
+    return arrays
+
+
+class Executor:
+    """Bound computation (one Symbol + argument/gradient/aux arrays)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = Context(ctx) if ctx is not None else None
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.arg_arrays = _as_list(args, self._arg_names, "args")
+        self.grad_arrays = _as_list(args_grad, self._arg_names, "args_grad")
+        self.aux_arrays = _as_list(aux_states, self._aux_names, "aux_states") or []
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, dict):
+            self._grad_req = {n: grad_req.get(n, "null") for n in self._arg_names}
+        else:
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        # group2ctx (model-parallel ctx_group placement) is honored by the
+        # sharded executor in parallel/; single-program binds run on ctx and
+        # rely on XLA fusion. Recorded for introspection.
+        self._group2ctx = group2ctx or {}
+
+        fn, self._order, self._internal_entries = _build_graph_fn(symbol)
+        self._fn = fn
+        self._jit_eval = jax.jit(lambda a, x, r: fn(a, x, r, False))
+        self._jit_train = jax.jit(lambda a, x, r: fn(a, x, r, True))
+        self._base_key = _random.next_key()
+        self._step = 0
+        self._vjp_fn = None
+        self._outputs = None
+        self._monitor_cb = None
+        self._device = self._ctx.jax_device() if self._ctx is not None else None
+
+    # -- dict views (python/mxnet/executor.py) -----------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        if self.grad_arrays is None:
+            return {}
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def outputs(self):
+        """Outputs of the most recent forward (async handles, like the
+        reference's `Executor::outputs` NDArrays)."""
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return self._outputs
+
+    def set_monitor_callback(self, callback):
+        self._monitor_cb = callback
+
+    # -- execution ---------------------------------------------------------
+    def _gather(self, arrays):
+        out = []
+        for nd in arrays:
+            arr = nd.data if isinstance(nd, NDArray) else jnp.asarray(arr)
+            if self._device is not None and getattr(arr, "device", None) != self._device:
+                arr = jax.device_put(arr, self._device)
+            out.append(arr)
+        return out
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward.  kwargs copy new values into bound args by name,
+        like `executor.py` forward(data=...)."""
+        for k, v in kwargs.items():
+            if k not in self._arg_names:
+                raise MXNetError("forward: unknown argument %r" % k)
+            dst = self.arg_arrays[self._arg_names.index(k)]
+            if isinstance(v, NDArray):
+                v.copyto(dst)
+            else:
+                dst[:] = v
+
+        args = self._gather(self.arg_arrays)
+        aux = self._gather(self.aux_arrays)
+        self._step += 1
+        rng = jax.random.fold_in(self._base_key, self._step)
+
+        if self._monitor_cb is not None:
+            self._forward_monitored(args, aux, rng, is_train)
+
+        if is_train and self.grad_arrays is not None:
+            aux_box = {}
+
+            def f(a):
+                outs, new_aux = self._jit_train(a, aux, rng)
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
+            self._vjp_fn = vjp_fn
+        else:
+            jit = self._jit_train if is_train else self._jit_eval
+            outs, new_aux = jit(args, aux, rng)
+            self._vjp_fn = None
+
+        if is_train:
+            for nd, arr in zip(self.aux_arrays, new_aux):
+                nd._set_data(arr)
+        self._outputs = [NDArray(o) for o in outs]
+        return self._outputs
+
+    def _forward_monitored(self, args, aux, rng, is_train):
+        """Eager interpretation for the monitor hook — reports every internal
+        entry like `RunOps`'s per-op callback (`graph_executor.cc:835-849`)."""
+        env_fn, order, entries = self._fn, self._order, self._internal_entries
+        # re-run eagerly, capturing env by monkey-walking the same plan
+        env = {}
+        arg_index = {n: i for i, n in enumerate(self._arg_names)}
+        aux_pos = 0
+        aux_list = list(aux)
+        seq = 0
+        for node in order:
+            if node.is_variable:
+                env[(id(node), 0)] = args[arg_index[node.name]]
+            else:
+                inputs = [env[(id(s), i)] for s, i in node.inputs]
+                k = len(node.op.list_aux(node.params))
+                aux_in = aux_list[aux_pos:aux_pos + k]
+                aux_pos += k
+                key = (
+                    jax.random.fold_in(rng, seq)
+                    if getattr(node.op, "need_rng", False)
+                    else None
+                )
+                outs, _ = node.op.apply(OpCtx(is_train, key), node.params, inputs, aux_in)
+                for i, o in enumerate(outs):
+                    env[(id(node), i)] = o
+            seq += 1
+        for name, key in entries:
+            if key in env:
+                self._monitor_cb(name, NDArray(env[key]))
+
+    def backward(self, out_grads=None):
+        """Compute gradients into the bound grad arrays.
+
+        Like the reference, `backward()` with no head gradients is only
+        meaningful when the outputs are loss layers — their custom vjp ignores
+        the incoming cotangent (`softmax_output-inl.h` Backward)."""
+        if self.grad_arrays is None:
+            raise MXNetError("bind with args_grad to use backward()")
+        if self._vjp_fn is None:
+            raise MXNetError("call forward(is_train=True) before backward()")
+        outs = self._outputs
+        if out_grads is None:
+            cot = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cot = tuple(
+                g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads
+            )
+        (grads,) = self._vjp_fn(cot)
+        for name, nd, g in zip(self._arg_names, self.grad_arrays, grads):
+            req = self._grad_req.get(name, "write")
+            if req == "null" or nd is None:
+                continue
+            if req == "add":
+                nd._set_data(nd.data + g)
+            else:
+                nd._set_data(g)
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        """Copy parameters by name (`executor.py` copy_params_from)."""
+        for name, array in arg_params.items():
+            if name in self._arg_names:
+                array.copyto(self.arg_arrays[self._arg_names.index(name)])
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %r" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self._aux_names:
+                    array.copyto(self.aux_arrays[self._aux_names.index(name)])
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new shapes.  The reference rebinds
+        sharing memory (`graph_executor.h:48-55`); with XLA the compile cache
+        keys on shapes, so this simply re-binds (buffers are reallocated)."""
+        from .ndarray import zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("reshape: cannot infer new shapes")
+        new_args = [
+            zeros(s, ctx=self._ctx, dtype=a.dtype)
+            for s, a in zip(arg_shapes, self.arg_arrays)
+        ]
+        new_grads = None
+        if self.grad_arrays is not None:
+            new_grads = [zeros(s, ctx=self._ctx) for s in arg_shapes]
+        new_aux = [zeros(s, ctx=self._ctx) for s in aux_shapes]
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux)

@@ -1,0 +1,221 @@
+"""Multi-device data-parallel executor group.
+
+Reference: `python/mxnet/executor_manager.py` (`_split_input_slice`,
+`DataParallelExecutorGroup`, `DataParallelExecutorManager`).
+
+TPU-first note: the reference binds one executor per GPU and slices each
+batch across them (`executor_manager.py:180-262`) — that architecture is kept
+here because it is exactly testable on a forced multi-device CPU host and maps
+1:1 onto per-chip jitted programs.  The *fused* alternative (one pjit program
+over a mesh with the batch sharded on the data axis — the idiomatic TPU
+form) lives in `parallel/trainer.py`; `FeedForward`/`Module` use this group
+for reference-semantics parity, examples chasing peak MFU use the fused
+trainer.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import NDArray, zeros
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split batch into per-device slices proportional to work load
+    (`executor_manager.py:13-45`)."""
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise MXNetError("batch size smaller than device count")
+    slices = []
+    begin = 0
+    for i, w in enumerate(work_load_list):
+        batch = int(round(batch_size * (sum(work_load_list[: i + 1]) / total)))
+        batch = min(batch, batch_size)
+        slices.append(slice(begin, batch))
+        begin = batch
+    if begin != batch_size:
+        slices[-1] = slice(slices[-1].start, batch_size)
+    return slices
+
+
+def _check_arguments(symbol):
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        raise MXNetError("duplicate argument names in symbol")
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise MXNetError("duplicate aux names in symbol")
+
+
+def _load_general(data, targets):
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                d_src[slice_idx.start:slice_idx.stop].copyto(d_dst)
+
+
+class DataParallelExecutorGroup:
+    """One executor per context with batch slices
+    (`executor_manager.py:180-262`)."""
+
+    def __init__(self, sym, arg_names, param_names, ctx, slices, train_data,
+                 shared_group=None):
+        _check_arguments(sym)
+        self.sym = sym
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.ctx = ctx
+        self.slices = slices
+
+        data_shapes = {k: tuple(v) for k, v in
+                       train_data.provide_data + train_data.provide_label}
+        self.data_names = [k for k, _ in train_data.provide_data]
+        self.label_names = [k for k, _ in train_data.provide_label]
+        self.aux_names = sym.list_auxiliary_states()
+        self.param_idx = [i for i, name in enumerate(arg_names)
+                          if name in param_names]
+
+        self.train_execs = []
+        for i, ctxi in enumerate(ctx):
+            batch_frac = slices[i].stop - slices[i].start
+            shapes = {
+                k: (batch_frac,) + v[1:] if k in data_shapes else v
+                for k, v in data_shapes.items()
+            }
+            if shared_group is None:
+                exec_ = sym.simple_bind(ctxi, grad_req="write", **shapes)
+            else:
+                # bucketing path: share parameter arrays with the largest
+                # bucket's executors (shared-memory rebind,
+                # `executor_manager.py:94-178`); XLA reuses the compiled
+                # program per shape via its cache.
+                shared = shared_group.train_execs[i]
+                arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+                args = [
+                    shared.arg_arrays[shared_group.sym.list_arguments().index(n)]
+                    if n in param_names else zeros(s, ctxi)
+                    for n, s in zip(sym.list_arguments(), arg_shapes)
+                ]
+                grads = [
+                    shared.grad_arrays[shared_group.sym.list_arguments().index(n)]
+                    if n in param_names else zeros(s, ctxi)
+                    for n, s in zip(sym.list_arguments(), arg_shapes)
+                ]
+                exec_ = sym.bind(ctxi, args, grads, "write",
+                                 shared.aux_arrays)
+            self.train_execs.append(exec_)
+
+        self.data_arrays = [
+            [(slices[i], e.arg_dict[name]) for i, e in enumerate(self.train_execs)]
+            for name in self.data_names
+        ]
+        self.label_arrays = [
+            [(slices[i], e.arg_dict[name]) for i, e in enumerate(self.train_execs)]
+            for name in self.label_names
+        ]
+        self.param_arrays = [
+            [e.arg_arrays[i] for e in self.train_execs] for i in self.param_idx
+        ]
+        self.grad_arrays = [
+            [e.grad_arrays[i] for e in self.train_execs] for i in self.param_idx
+        ]
+        self.aux_arrays = [
+            [e.aux_arrays[i] for e in self.train_execs]
+            for i in range(len(self.aux_names))
+        ]
+
+    def load_data_batch(self, data_batch):
+        _load_general(data_batch.data, self.data_arrays)
+        _load_general(data_batch.label, self.label_arrays)
+
+    def forward(self, is_train=False):
+        for e in self.train_execs:
+            e.forward(is_train=is_train)
+
+    def backward(self):
+        for e in self.train_execs:
+            e.backward()
+
+    def update_metric(self, metric, labels):
+        for e, sl in zip(self.train_execs, self.slices):
+            lab = [l[sl.start:sl.stop] for l in labels]
+            metric.update(lab, e.outputs)
+
+
+class DataParallelExecutorManager:
+    """Coordinates the group + param/grad lists for the training loop
+    (`executor_manager.py:288-318`)."""
+
+    def __init__(self, symbol, ctx, train_data, param_names, arg_names,
+                 aux_names, work_load_list=None, logger=None):
+        if logger is None:
+            logger = logging
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        if len(work_load_list) != num_device:
+            raise MXNetError("work_load_list must match ctx length")
+        self.slices = _split_input_slice(train_data.batch_size, work_load_list)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.ctx = ctx
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, arg_names, param_names, ctx, self.slices, train_data
+        )
+        self.symbol = symbol
+        self.curr_execgrp = self.execgrp
+        self.execgrp_bucket = {}
+
+    def install_monitor(self, monitor):
+        for e in self.curr_execgrp.train_execs:
+            monitor.install(e)
+
+    def set_params(self, arg_params, aux_params):
+        for e in self.curr_execgrp.train_execs:
+            e.copy_params_from(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Average params over devices into host dicts (`copy_to`)."""
+        for name, blocks in zip(self.param_names, self.param_arrays):
+            acc = blocks[0].data
+            for b in blocks[1:]:
+                acc = acc + b.data
+            arg_params[name]._set_data((acc / len(blocks)).astype(
+                arg_params[name].dtype))
+        for name, blocks in zip(self.aux_names, self.aux_arrays):
+            acc = blocks[0].data
+            for b in blocks[1:]:
+                acc = acc + b.data
+            aux_params[name]._set_data((acc / len(blocks)).astype(
+                aux_params[name].dtype))
+
+    @property
+    def param_arrays(self):
+        return self.curr_execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.curr_execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.curr_execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        self.curr_execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

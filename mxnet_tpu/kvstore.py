@@ -1,0 +1,164 @@
+"""KVStore: key-value parameter synchronization.
+
+Reference: `include/mxnet/kvstore.h`, `src/kvstore/kvstore_local.h`,
+`kvstore_device.h`, `kvstore_dist.h`, `kvstore_dist_server.h`, Python wrapper
+`python/mxnet/kvstore.py`; architecture `docs/system/multi_node.md`.
+
+The user-visible contract is kept exactly: int or str keys, `init/push/pull`
+with priority, a pluggable updater (default `stored += merged`), worker
+`rank`/`num_workers`, `barrier`, and `set_optimizer` installing a
+`get_updater(optimizer)` closure.
+
+TPU-first mapping (SURVEY §5.8):
+
+* `local` / `local_update_cpu` / `local_allreduce_cpu` — merge on host
+  memory like `KVStoreLocal::Push` (`kvstore_local.h:40-56`).
+* `device` / `local_allreduce_device` — merge stays on accelerator device 0
+  (the analogue of `KVStoreDevice`'s GPU-side reduce); with a single TPU
+  process the reduce is one fused XLA add chain.
+* `dist_sync` / `dist_async` / `dist` — BSP data parallelism.  In-process it
+  degenerates to rank 0 of 1 (like the reference running without a tracker);
+  the multi-process ps backend lives in `parallel/dist.py` and plugs in here
+  when `DMLC_ROLE` env wiring is present (`kvstore.h:157-206`).  The real
+  multi-chip path for SPMD training is `parallel.psum` under pjit — KVStore
+  remains the API for the reference's explicit push/pull style.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+
+
+class KVStore:
+    """Single-process store covering local and device types."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}  # key -> NDArray (the "stored" weight)
+        self._updater = None
+        self._on_device = "device" in kv_type
+
+    # -- helpers ----------------------------------------------------------
+    @staticmethod
+    def _keylist(key):
+        if isinstance(key, (int, str)):
+            return [key], False
+        return list(key), True
+
+    @staticmethod
+    def _vallist(value, nkeys):
+        """Normalize to list-of-lists: per key, a list of per-device values
+        (reference groups push values by key, `kvstore_local.h:180-236`)."""
+        if isinstance(value, NDArray):
+            value = [value]
+        if nkeys == 1 and value and isinstance(value[0], NDArray):
+            return [list(value)]
+        out = []
+        for v in value:
+            out.append([v] if isinstance(v, NDArray) else list(v))
+        return out
+
+    def _merge(self, vals):
+        """Reduce a list of NDArrays.  Fixed left-to-right order for the
+        determinism gate (`tests/nightly/multi_lenet.py`; SURVEY §7)."""
+        acc = vals[0].data
+        for v in vals[1:]:
+            acc = acc + v.data
+        return acc
+
+    # -- API ---------------------------------------------------------------
+    def init(self, key, value):
+        keys, _ = self._keylist(key)
+        vals = self._vallist(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % k)
+            v = vlist[0]
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        keys, _ = self._keylist(key)
+        vals = self._vallist(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            merged = NDArray(self._merge(vlist))
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(k, merged, stored)
+            else:
+                stored._set_data(stored.data + merged.data)
+
+    def pull(self, key, out=None, priority=0):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, _ = self._keylist(key)
+        if isinstance(out, NDArray):
+            outs = [[out]]
+        elif out and isinstance(out[0], NDArray) and len(keys) == 1:
+            outs = [list(out)]
+        else:
+            outs = [[o] if isinstance(o, NDArray) else list(o) for o in out]
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def set_optimizer(self, optimizer):
+        """Install an optimizer as the updater.  In dist mode the reference
+        pickles it to the servers (`kvstore.py:231`, `kvstore_server.py:24-56`);
+        locally it becomes a `get_updater` closure."""
+        from .optimizer import get_updater
+
+        if "dist" in self.type and self.rank != 0:
+            return
+        # exercise the serialization path like the reference (optimizers must
+        # remain picklable for the server protocol)
+        pickle.loads(pickle.dumps(optimizer))
+        self._set_updater(get_updater(optimizer))
+
+    @property
+    def rank(self):
+        return int(os.environ.get("DMLC_RANK", "0"))
+
+    @property
+    def num_workers(self):
+        return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+    def barrier(self):
+        pass
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+
+def create(name="local"):
+    """Factory (`python/mxnet/kvstore.py` create; types from
+    `src/kvstore/kvstore.cc:17-49`)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    valid = {
+        "local", "local_update_cpu", "local_allreduce_cpu",
+        "device", "local_allreduce_device",
+        "dist_sync", "dist_async", "dist",
+    }
+    if name not in valid:
+        raise MXNetError("unknown KVStore type %r" % name)
+    if name.startswith("dist") and os.environ.get("DMLC_PS_ROOT_URI"):
+        from .parallel.dist import DistKVStore
+
+        return DistKVStore(name)
+    return KVStore(name)

@@ -1,0 +1,11 @@
+"""Module API (reference `python/mxnet/module/`).
+
+Intermediate-level training API: bind/init_params/init_optimizer/
+forward/backward/update, plus the generic `fit` loop of `BaseModule`
+(`base_module.py:237`).
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule

@@ -1,0 +1,193 @@
+"""BaseModule: the generic high-level training loop
+(reference `python/mxnet/module/base_module.py`)."""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from .. import metric as metric_mod
+from ..base import MXNetError
+from ..callback import BatchEndParam
+from ..model import save_checkpoint
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self._symbol = None
+
+    # -- abstract interface ------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        raise NotImplementedError()
+
+    def backward(self, out_grads=None):
+        raise NotImplementedError()
+
+    def update(self):
+        raise NotImplementedError()
+
+    def get_outputs(self, merge_multi_context=True):
+        raise NotImplementedError()
+
+    def update_metric(self, eval_metric, labels):
+        raise NotImplementedError()
+
+    def bind(self, *args, **kwargs):
+        raise NotImplementedError()
+
+    def init_params(self, *args, **kwargs):
+        raise NotImplementedError()
+
+    def init_optimizer(self, *args, **kwargs):
+        raise NotImplementedError()
+
+    def get_params(self):
+        raise NotImplementedError()
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    # -- generic loops (base_module.py:237 ff.) ----------------------------
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, reset=True, epoch=0):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module must be bound and initialized")
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+            if batch_end_callback is not None:
+                p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                  eval_metric=eval_metric)
+                cbs = batch_end_callback if isinstance(batch_end_callback, list) \
+                    else [batch_end_callback]
+                for cb in cbs:
+                    cb(p)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module must be bound and initialized")
+        if reset:
+            eval_data.reset()
+        output_list = []
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            pad = eval_batch.pad
+            outputs = [out[0:out.shape[0] - pad].asnumpy()
+                       for out in self.get_outputs()]
+            output_list.append(outputs)
+        if len(output_list) == 0:
+            return output_list
+        if merge_batches:
+            num_outputs = len(output_list[0])
+            for out in output_list:
+                if len(out) != num_outputs:
+                    raise MXNetError("output count changed across batches")
+            output_list2 = [
+                np.concatenate([out[i] for out in output_list])
+                for i in range(num_outputs)
+            ]
+            if num_outputs == 1 and not always_output_list:
+                return output_list2[0]
+            return output_list2
+        return output_list
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=None,
+            eval_batch_end_callback=None, initializer=None,
+            arg_params=None, aux_params=None, allow_missing=False,
+            force_rebind=False, force_init=False, begin_epoch=0,
+            num_epoch=None, monitor=None):
+        """Generic fit (`base_module.py:237`)."""
+        from .. import initializer as init_mod
+
+        if num_epoch is None:
+            raise MXNetError("num_epoch must be specified")
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        optimizer_params = optimizer_params or {"learning_rate": 0.01}
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric)
+                    cbs = batch_end_callback \
+                        if isinstance(batch_end_callback, list) \
+                        else [batch_end_callback]
+                    for cb in cbs:
+                        cb(p)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            arg_p, aux_p = self.get_params()
+            self.set_params(arg_p, aux_p)
+            if epoch_end_callback is not None:
+                cbs = epoch_end_callback if isinstance(epoch_end_callback, list) \
+                    else [epoch_end_callback]
+                for cb in cbs:
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data:
+                res = self.score(eval_data, eval_metric,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+            train_data.reset()
+
+    def set_params(self, arg_params, aux_params):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=False,
+                         force_init=True)
+
+    def install_monitor(self, monitor):
+        raise NotImplementedError()
+
+    def save_checkpoint(self, prefix, epoch):
+        arg_p, aux_p = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_p, aux_p)

@@ -1,0 +1,69 @@
+"""Monitor: tensor-stat debugging hook (reference `python/mxnet/monitor.py`).
+
+Installs a per-output callback on executors (our Executor's eager monitored
+path, the analogue of `Executor::SetMonitorCallback` /
+`graph_executor.cc:835-849`) and prints regex-filtered stats every N batches.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """|x|/size(x) like the reference default."""
+                import numpy as np
+
+                a = x.asnumpy()
+                return float(np.abs(a).sum() / a.size)
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Attach to an executor (`monitor.py` install)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch; return [(step, name, stat)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)
+        return res

@@ -1,0 +1,430 @@
+"""Imperative NDArray layer on XLA.
+
+Reference: `include/mxnet/ndarray.h`, `src/ndarray/ndarray.cc`,
+`python/mxnet/ndarray.py` (1162 LoC ctypes wrapper).
+
+TPU-first design notes
+----------------------
+* An NDArray wraps a `jax.Array` placed on its Context's device.  Every op is
+  dispatched asynchronously by the JAX runtime — this *is* the reference's
+  "push to engine, return immediately" contract (`ndarray.cc:96-224`): the
+  Python thread composes work, `wait_to_read()`/`asnumpy()` are the sync
+  points (`ndarray.h:94-110`).
+* The reference NDArray is mutable with zero-copy `Slice`/`Reshape` views
+  (`ndarray.h:227-250`).  XLA buffers are immutable, so mutation is modelled
+  functionally: writes swap the underlying buffer; a view holds
+  ``(parent, index)`` and reads/writes *through* the parent, preserving the
+  reference's aliasing semantics (training loops write gradients into slices
+  of shared arrays — `executor_manager.py:180-262`).  XLA's buffer donation
+  keeps the memory ceiling equivalent to true in-place updates inside jitted
+  steps.
+* Save/load keeps the reference container structure (list magic `0x112` +
+  reserved word + arrays + names, `ndarray.cc:627-655`) so checkpoint tooling
+  carries over.
+
+The bulk of `mx.nd.*` functions (elementwise, reductions, ...) are injected by
+the operator registry (`ops/registry.py`), mirroring how the reference
+auto-generates Python functions from `NDArrayFunctionReg`
+(`ndarray.h:447-650`).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from . import engine
+from .base import MXNetError, check_shape, dtype_flag, np_dtype, numeric_types
+from .context import Context, cpu, current_context
+
+import jax
+import jax.numpy as jnp
+
+
+def _to_jax(value, dtype=None):
+    if isinstance(value, NDArray):
+        arr = value.data
+        return arr.astype(np_dtype(dtype).name) if dtype is not None else arr
+    return jnp.asarray(value, dtype=None if dtype is None else np_dtype(dtype).name)
+
+
+class NDArray:
+    """A multi-dimensional, device-resident array with async semantics."""
+
+    __slots__ = ("_data", "_parent", "_index", "_writable", "__weakref__")
+
+    def __init__(self, data, ctx=None, _parent=None, _index=None, writable=True):
+        self._parent = _parent
+        self._index = _index
+        self._writable = writable
+        if _parent is not None:
+            self._data = None
+        else:
+            arr = _to_jax(data)
+            if ctx is not None:
+                dev = Context(ctx).jax_device()
+                if getattr(arr, "device", None) != dev:
+                    arr = jax.device_put(arr, dev)
+            self._data = arr
+        engine.track_array(self)
+
+    # -- core buffer access ----------------------------------------------
+    @property
+    def data(self) -> jax.Array:
+        """The underlying jax.Array (reads through views lazily)."""
+        if self._parent is not None:
+            return self._parent.data[self._index]
+        return self._data
+
+    def _set_data(self, value):
+        if not self._writable:
+            raise MXNetError("NDArray is not writable")
+        if self._parent is not None:
+            self._parent._set_data(self._parent.data.at[self._index].set(value))
+        else:
+            # keep device placement of the old buffer
+            dev = getattr(self._data, "device", None)
+            value = jnp.asarray(value, dtype=self._data.dtype)
+            if dev is not None and getattr(value, "device", None) != dev:
+                value = jax.device_put(value, dev)
+            self._data = value
+
+    # -- properties -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self.data.dtype)
+
+    @property
+    def context(self) -> Context:
+        dev = getattr(self.data, "device", None)
+        if dev is None:
+            return cpu()
+        devtype = "cpu" if dev.platform == "cpu" else "tpu"
+        # device_id within its platform's device list
+        try:
+            idx = list(jax.devices(dev.platform)).index(dev)
+        except Exception:
+            idx = 0
+        return Context(devtype, idx)
+
+    ctx = context
+
+    @property
+    def T(self):
+        return NDArray(jnp.transpose(self.data))
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def __repr__(self):
+        return "<NDArray %s @%s>" % ("x".join(map(str, self.shape)), self.context)
+
+    # -- sync points ------------------------------------------------------
+    def wait_to_read(self):
+        """Block until all pending writes to this array complete
+        (`ndarray.h:94-97`)."""
+        jax.block_until_ready(self.data)
+
+    def wait_to_write(self):
+        """Block until pending reads+writes complete (`ndarray.h:103-110`).
+        With functional buffers a new write never races an old read, so this
+        is the same barrier as `wait_to_read`."""
+        jax.block_until_ready(self.data)
+
+    def asnumpy(self) -> np.ndarray:
+        """Copy to a numpy array; a synchronization point like the reference
+        (`ndarray.py` asnumpy -> `MXNDArraySyncCopyToCPU`)."""
+        return np.asarray(self.data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("asscalar() requires size-1 array")
+        return self.asnumpy().reshape(()).item()
+
+    # -- conversion / copy ------------------------------------------------
+    def astype(self, dtype):
+        return NDArray(self.data.astype(np_dtype(dtype).name))
+
+    def copy(self):
+        return NDArray(jnp.array(self.data), ctx=self.context)
+
+    def copyto(self, other):
+        """Copy into another NDArray (cross-device) or materialize on a
+        Context (`ndarray.cc` `CopyFromTo`)."""
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise MXNetError(
+                    "copyto shape mismatch %s vs %s" % (self.shape, other.shape)
+                )
+            arr = self.data
+            if arr.dtype != other.dtype:
+                arr = arr.astype(other.dtype)
+            other._set_data(arr)
+            return other
+        if isinstance(other, Context):
+            return NDArray(self.data, ctx=other)
+        raise MXNetError("copyto: expects NDArray or Context")
+
+    def as_in_context(self, ctx):
+        ctx = Context(ctx)
+        if ctx == self.context:
+            return self
+        return self.copyto(ctx)
+
+    # -- views ------------------------------------------------------------
+    def slice(self, start, stop):
+        """Zero-copy-semantics view over axis 0 (`ndarray.h:227-239`).
+        Writes to the view write through to this array."""
+        start, stop = int(start), int(stop)
+        return NDArray(None, _parent=self, _index=slice(start, stop))
+
+    def reshape(self, shape):
+        """Reshaped view sharing data (`ndarray.h:241-250`)."""
+        shape = check_shape(shape)
+        return NDArray(jnp.reshape(self.data, shape))
+
+    def __getitem__(self, idx):
+        if isinstance(idx, int):
+            return NDArray(None, _parent=self, _index=idx)
+        if isinstance(idx, slice):
+            if idx.step not in (None, 1):
+                raise MXNetError("slice step not supported")
+            start = idx.start or 0
+            stop = idx.stop if idx.stop is not None else self.shape[0]
+            return self.slice(start, stop)
+        raise MXNetError("unsupported index %r" % (idx,))
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, slice) and idx == slice(None):
+            target_shape = self.shape
+            if isinstance(value, numeric_types):
+                self._set_data(jnp.full(target_shape, value, dtype=self.dtype))
+            else:
+                arr = _to_jax(value)
+                if arr.shape != target_shape:
+                    raise MXNetError(
+                        "shape mismatch in assignment: %s vs %s"
+                        % (arr.shape, target_shape)
+                    )
+                self._set_data(arr)
+            return
+        view = self[idx] if not isinstance(idx, NDArray) else None
+        if view is None:
+            raise MXNetError("unsupported index %r" % (idx,))
+        if isinstance(value, numeric_types):
+            value = jnp.full(view.shape, value, dtype=self.dtype)
+        view._set_data(_to_jax(value))
+
+    # -- arithmetic (async, like `BinaryOp<OP>` pushes) --------------------
+    def _binary(self, other, fn, reverse=False):
+        o = _to_jax(other) if not isinstance(other, numeric_types) else other
+        a, b = (o, self.data) if reverse else (self.data, o)
+        return NDArray(fn(a, b))
+
+    def __add__(self, other):
+        return self._binary(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        return self._binary(other, jnp.subtract, reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        return self._binary(other, jnp.divide, reverse=True)
+
+    def __pow__(self, other):
+        return self._binary(other, jnp.power)
+
+    def __neg__(self):
+        return NDArray(jnp.negative(self.data))
+
+    def __iadd__(self, other):
+        self._set_data(jnp.add(self.data, _to_jax(other) if isinstance(other, NDArray) else other))
+        return self
+
+    def __isub__(self, other):
+        self._set_data(jnp.subtract(self.data, _to_jax(other) if isinstance(other, NDArray) else other))
+        return self
+
+    def __imul__(self, other):
+        self._set_data(jnp.multiply(self.data, _to_jax(other) if isinstance(other, NDArray) else other))
+        return self
+
+    def __itruediv__(self, other):
+        self._set_data(jnp.divide(self.data, _to_jax(other) if isinstance(other, NDArray) else other))
+        return self
+
+    def __eq__(self, other):  # elementwise, like numpy/mxnet
+        if isinstance(other, (NDArray,) + numeric_types):
+            return self._binary(other, lambda a, b: (a == b).astype(self.dtype))
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+
+# -- creation ------------------------------------------------------------
+
+
+def empty(shape, ctx=None, dtype=np.float32):
+    """Uninitialized array (we zero-fill: XLA has no uninit buffers)."""
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=np.float32):
+    ctx = ctx or current_context()
+    return NDArray(jnp.zeros(check_shape(shape), dtype=np_dtype(dtype).name), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=np.float32):
+    ctx = ctx or current_context()
+    return NDArray(jnp.ones(check_shape(shape), dtype=np_dtype(dtype).name), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=np.float32):
+    ctx = ctx or current_context()
+    return NDArray(jnp.full(check_shape(shape), val, dtype=np_dtype(dtype).name), ctx=ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (`python/mxnet/ndarray.py` array)."""
+    if isinstance(source_array, NDArray):
+        src = source_array.data
+        if dtype is not None:
+            src = src.astype(np_dtype(dtype).name)
+        return NDArray(src, ctx=ctx or current_context())
+    arr = np.asarray(source_array, dtype=None if dtype is None else np_dtype(dtype))
+    if dtype is None:
+        if not isinstance(source_array, np.ndarray):
+            arr = arr.astype(np.float32)  # reference default is float32
+        elif arr.dtype == np.float64:
+            arr = arr.astype(np.float32)  # x64 is disabled on TPU paths
+    return NDArray(arr, ctx=ctx or current_context())
+
+
+def arange(start, stop=None, step=1.0, ctx=None, dtype=np.float32):
+    return NDArray(jnp.arange(start, stop, step, dtype=np_dtype(dtype).name),
+                   ctx=ctx or current_context())
+
+
+def concatenate(arrays, axis=0):
+    return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis))
+
+
+def onehot_encode(indices, out):
+    """out[i, indices[i]] = 1 (reference `onehot_encode`, `ndarray.cc`)."""
+    depth = out.shape[1]
+    idx = indices.data.astype("int32")
+    out._set_data(jax.nn.one_hot(idx, depth, dtype=out.dtype))
+    return out
+
+
+def waitall():
+    """Block until all pending computation completes (`MXNDArrayWaitAll`)."""
+    engine.wait_for_all()
+
+
+# -- serialization -------------------------------------------------------
+# Container layout follows `ndarray.cc:627-655`: u64 magic 0x112, u64 reserved,
+# arrays, names.  Per-array field encoding is fixed little-endian (the
+# reference's exact per-array layout lived in the empty mshadow submodule).
+
+_LIST_MAGIC = 0x112
+_ARRAY_MAGIC = 0xF7B7
+
+
+def _save_array(f, nd: NDArray):
+    arr = np.ascontiguousarray(nd.asnumpy())
+    shape = arr.shape
+    ctx = nd.context
+    f.write(struct.pack("<IIQ", _ARRAY_MAGIC, len(shape), 0))
+    for d in shape:
+        f.write(struct.pack("<q", d))
+    f.write(struct.pack("<II", ctx.device_typeid, ctx.device_id))
+    f.write(struct.pack("<I", dtype_flag(arr.dtype)))
+    raw = arr.tobytes()
+    f.write(struct.pack("<Q", len(raw)))
+    f.write(raw)
+
+
+def _load_array(f) -> NDArray:
+    magic, ndim, _ = struct.unpack("<IIQ", f.read(16))
+    if magic != _ARRAY_MAGIC:
+        raise MXNetError("invalid NDArray record (bad magic)")
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    dev_type, dev_id = struct.unpack("<II", f.read(8))
+    (tf,) = struct.unpack("<I", f.read(4))
+    (nbytes,) = struct.unpack("<Q", f.read(8))
+    arr = np.frombuffer(f.read(nbytes), dtype=np_dtype(tf)).reshape(shape)
+    # Like the reference, data loads to host then moves to the saved context
+    # (`ndarray.cc:600-624`); unknown contexts fall back to cpu.
+    try:
+        ctx = Context(Context.devtype2str.get(dev_type, "cpu"), dev_id)
+        ctx.jax_device()
+    except MXNetError:
+        ctx = cpu()
+    return NDArray(arr, ctx=ctx)
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict (`MXNDArraySave`)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    names, arrays = [], []
+    if isinstance(data, dict):
+        for k in sorted(data):
+            names.append(k)
+            arrays.append(data[k])
+    else:
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for nd in arrays:
+            _save_array(f, nd)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` -> list or dict (`MXNDArrayLoad`)."""
+    with open(fname, "rb") as f:
+        magic, _ = struct.unpack("<QQ", f.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError("invalid NDArray file (bad magic)")
+        (n,) = struct.unpack("<Q", f.read(8))
+        arrays = [_load_array(f) for _ in range(n)]
+        (nn,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nn):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        if len(names) != len(arrays):
+            raise MXNetError("corrupt NDArray file: name/array count mismatch")
+        return dict(zip(names, arrays))
+    return arrays

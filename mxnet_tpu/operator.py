@@ -1,0 +1,130 @@
+"""Custom operators in Python.
+
+Reference: `python/mxnet/operator.py:388` — `PythonOp`/`NumpyOp` (synchronous
+numpy callbacks bridged through `src/operator/native_op-inl.h`) and
+`NDArrayOp` (async NDArray callbacks through `ndarray_op-inl.h`).
+
+TPU-first mapping: a NumpyOp's forward/backward run on host via
+`jax.pure_callback` when used inside a jitted graph, exactly the escape-hatch
+role `native_op` played; `get_symbol` produces a registry op on the fly so
+custom ops compose with the symbolic API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import OpDef, register
+
+
+class PythonOp:
+    """Base class (`operator.py` PythonOp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    # -- user overrides ----------------------------------------------------
+    def forward(self, in_data, out_data):
+        raise NotImplementedError()
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise MXNetError("backward not implemented")
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -- symbol integration -----------------------------------------------
+    def get_symbol(self, *args, **kwargs):
+        """Create a Symbol for this op (reference wires a C callback; here we
+        register a dynamic registry op whose apply uses custom_vjp +
+        pure_callback so it works inside jitted executors)."""
+        from . import symbol as sym_mod
+
+        pyop = self
+
+        class _PyOpDef(OpDef):
+            name = "_python_op_%d" % id(pyop)
+
+            def list_arguments(self, params):
+                return pyop.list_arguments()
+
+            def list_outputs(self, params):
+                return pyop.list_outputs()
+
+            def infer_shape(self, params, in_shapes):
+                if any(s is None for s in in_shapes):
+                    return in_shapes, [None] * len(pyop.list_outputs()), []
+                ins, outs = pyop.infer_shape([list(s) for s in in_shapes])
+                return ([tuple(s) for s in ins], [tuple(s) for s in outs], [])
+
+            def apply(self, octx, params, inputs, aux):
+                in_shapes = [tuple(x.shape) for x in inputs]
+                _, out_shapes = pyop.infer_shape([list(s) for s in in_shapes])
+                out_avals = [
+                    jax.ShapeDtypeStruct(tuple(s), inputs[0].dtype)
+                    for s in out_shapes
+                ]
+
+                def host_fwd(*arrs):
+                    in_data = [np.asarray(a) for a in arrs]
+                    out_data = [np.zeros(s, in_data[0].dtype) for s in out_shapes]
+                    pyop.forward(in_data, out_data)
+                    return tuple(out_data)
+
+                @jax.custom_vjp
+                def _op(*xs):
+                    return jax.pure_callback(host_fwd, tuple(out_avals), *xs)
+
+                def _fwd(*xs):
+                    outs = _op(*xs)
+                    return outs, (xs, outs)
+
+                def _bwd(res, gs):
+                    xs, outs = res
+
+                    def host_bwd(*arrs):
+                        k = len(xs)
+                        m = len(outs)
+                        in_data = [np.asarray(a) for a in arrs[:k]]
+                        out_data = [np.asarray(a) for a in arrs[k:k + m]]
+                        out_grad = [np.asarray(a) for a in arrs[k + m:]]
+                        in_grad = [np.zeros_like(d) for d in in_data]
+                        pyop.backward(out_grad, in_data, out_data, in_grad)
+                        return tuple(in_grad)
+
+                    in_avals = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs]
+                    return jax.pure_callback(
+                        host_bwd, tuple(in_avals), *(xs + outs + tuple(gs))
+                    )
+
+                _op.defvjp(_fwd, _bwd)
+                outs = _op(*inputs)
+                return list(outs), []
+
+        opdef = register(_PyOpDef)
+        factory = sym_mod._make_factory(opdef)
+        return factory(*args, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Numpy custom op (`operator.py` NumpyOp) — same callback contract."""
+
+
+class NDArrayOp(PythonOp):
+    """Async NDArray custom op (`operator.py` NDArrayOp).  On TPU the
+    forward/backward receive jax arrays wrapped as NDArrays; executed via the
+    same host-callback bridge (the engine-callback async-ness is supplied by
+    XLA's async dispatch)."""

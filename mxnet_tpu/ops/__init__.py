@@ -1,0 +1,75 @@
+"""Operator library: importing this package registers all ops.
+
+The registry drives both API surfaces, like the reference's dual registration
+of simple ops as NDArray functions and atomic symbols
+(`include/mxnet/operator_util.h:363-434`):
+
+* `populate_nd(ns)` — imperative functions on NDArrays (`mx.nd.*`,
+  reference `_init_ndarray_module`).
+* `symbol.populate(ns)` — symbol factories (`mx.sym.*`,
+  reference `_init_symbol_module`).
+"""
+from __future__ import annotations
+
+from . import registry
+from . import elementwise  # noqa: F401  (registers ops)
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
+from . import loss  # noqa: F401
+from .registry import OpCtx, OpDef, Param, get, list_ops, register
+
+
+def _make_nd_function(op):
+    from .. import random as _random
+    from ..ndarray import NDArray
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        inputs, params = [], {}
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            else:
+                raise TypeError(
+                    "%s: positional args must be NDArrays; pass params by name"
+                    % op.name
+                )
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                inputs.append(v)
+            else:
+                params[k] = v
+        if op.key_var_num_args and op.key_var_num_args not in params:
+            params[op.key_var_num_args] = len(inputs)
+        parsed = op.parse_params(params)
+        if op.list_aux(parsed):
+            raise registry.MXNetError(
+                "%s holds auxiliary state; use the symbolic API" % op.name
+            )
+        key = _random.next_key() if op.need_rng else None
+        outs, _ = op.apply(
+            registry.OpCtx(is_train=False, rng=key),
+            parsed,
+            [i.data for i in inputs],
+            [],
+        )
+        results = [NDArray(o) for o in outs]
+        if out is not None:
+            if len(results) != 1:
+                raise registry.MXNetError("%s: out= needs single output" % op.name)
+            results[0].copyto(out)
+            return out
+        return results[0] if len(results) == 1 else results
+
+    fn.__name__ = op.name
+    fn.__doc__ = (op.__doc__ or "") + "\n\nImperative form (auto-generated)."
+    return fn
+
+
+def populate_nd(namespace):
+    seen = {}
+    for name in registry.list_ops():
+        op = registry.get(name)
+        if id(op) not in seen:
+            seen[id(op)] = _make_nd_function(op)
+        namespace[name] = seen[id(op)]

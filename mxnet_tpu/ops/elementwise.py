@@ -1,0 +1,249 @@
+"""Elementwise / scalar / reduction / linalg simple ops.
+
+Reference: `src/operator/elementwise_binary_op-inl.h:213-231`,
+`elementwise_binary_scalar_op-inl.h`, `elementwise_unary_op-inl.h`,
+`broadcast_reduce_op-inl.h:143-181`, `src/operator/mshadow_op.h` (the 41
+scalar functors), and the NDArray-side ops in `src/ndarray/ndarray.cc`
+(Dot, Clip, ElementwiseSum, sampling).
+
+These are the reference's dual-registered "simple ops": every entry appears as
+an `mx.nd` function and an `mx.sym` atomic symbol.  On TPU they are single
+jnp/lax calls — XLA fuses chains of them into the surrounding matmuls, which
+replaces the reference's mshadow expression-template fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import (
+    OpDef,
+    Param,
+    register,
+    register_binary,
+    register_scalar,
+    register_unary,
+)
+
+# -- binary (elementwise_binary_op-inl.h:213-231) ------------------------
+register_binary("_Plus", jnp.add, aliases=["_plus", "elemwise_add"])
+register_binary("_Minus", jnp.subtract, aliases=["_minus"])
+register_binary("_Mul", jnp.multiply, aliases=["_mul"])
+register_binary("_Div", jnp.divide, aliases=["_div"])
+register_binary("_Power", jnp.power, aliases=["_power"])
+register_binary("_Maximum", jnp.maximum, aliases=["_maximum"])
+register_binary("_Minimum", jnp.minimum, aliases=["_minimum"])
+
+# -- scalar (elementwise_binary_scalar_op-inl.h) -------------------------
+register_scalar("_PlusScalar", jnp.add, aliases=["_plus_scalar"])
+register_scalar("_MinusScalar", jnp.subtract, aliases=["_minus_scalar"])
+register_scalar("_RMinusScalar", jnp.subtract, reverse=True, aliases=["_rminus_scalar"])
+register_scalar("_MulScalar", jnp.multiply, aliases=["_mul_scalar"])
+register_scalar("_DivScalar", jnp.divide, aliases=["_div_scalar"])
+register_scalar("_RDivScalar", jnp.divide, reverse=True, aliases=["_rdiv_scalar"])
+register_scalar("_PowerScalar", jnp.power, aliases=["_power_scalar"])
+register_scalar("_RPowerScalar", jnp.power, reverse=True, aliases=["_rpower_scalar"])
+register_scalar("_MaximumScalar", jnp.maximum, aliases=["_maximum_scalar"])
+register_scalar("_MinimumScalar", jnp.minimum, aliases=["_minimum_scalar"])
+
+# -- unary (elementwise_unary_op-inl.h; functors in mshadow_op.h) --------
+register_unary("abs", jnp.abs)
+register_unary("sign", jnp.sign)
+register_unary("round", jnp.round)
+register_unary("ceil", jnp.ceil)
+register_unary("floor", jnp.floor)
+register_unary("square", jnp.square)
+register_unary("sqrt", jnp.sqrt)
+register_unary("rsqrt", jax.lax.rsqrt)
+register_unary("exp", jnp.exp)
+register_unary("log", jnp.log)
+register_unary("cos", jnp.cos)
+register_unary("sin", jnp.sin)
+register_unary("negative", jnp.negative)
+register_unary("sigmoid", jax.nn.sigmoid)
+register_unary("relu", jax.nn.relu)
+register_unary("tanh", jnp.tanh)
+
+
+class _Clip(OpDef):
+    """clip(src, a_min, a_max) (`src/ndarray/ndarray.cc` Clip / simple op)."""
+
+    name = "clip"
+    params = {
+        "a_min": Param(float, required=True),
+        "a_max": Param(float, required=True),
+    }
+
+    def apply(self, octx, params, inputs, aux):
+        return [jnp.clip(inputs[0], params["a_min"], params["a_max"])], []
+
+
+register(_Clip)
+
+
+class _Dot(OpDef):
+    """2-D matrix product (`ndarray.cc` Dot; mshadow `dot`).
+
+    The canonical MXU op: on TPU this is a single `jnp.dot` lowered to the
+    systolic array; accumulate in float32 even for bf16 inputs.
+    """
+
+    name = "dot"
+
+    def list_arguments(self, params):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, params, in_shapes):
+        a, b = in_shapes
+        if a is None or b is None:
+            return in_shapes, [None], []
+        if len(a) != 2 or len(b) != 2 or a[1] != b[0]:
+            raise MXNetError("dot: incompatible shapes %s %s" % (a, b))
+        return [a, b], [(a[0], b[1])], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [
+            jnp.dot(inputs[0], inputs[1], preferred_element_type=jnp.float32).astype(
+                inputs[0].dtype
+            )
+        ], []
+
+
+register(_Dot)
+
+
+class _BatchDot(OpDef):
+    """Batched matmul over leading dim."""
+
+    name = "batch_dot"
+
+    def list_arguments(self, params):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, params, in_shapes):
+        a, b = in_shapes
+        if a is None or b is None:
+            return in_shapes, [None], []
+        if len(a) != 3 or len(b) != 3 or a[0] != b[0] or a[2] != b[1]:
+            raise MXNetError("batch_dot: incompatible shapes %s %s" % (a, b))
+        return [a, b], [(a[0], a[1], b[2])], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [
+            jnp.matmul(inputs[0], inputs[1], preferred_element_type=jnp.float32).astype(
+                inputs[0].dtype
+            )
+        ], []
+
+
+register(_BatchDot)
+
+
+# -- reductions (broadcast_reduce_op-inl.h:143-181) ----------------------
+
+
+class _Reduce(OpDef):
+    """Whole-tensor reduction to shape (1,), reference semantics; with an
+    optional ``axis`` extension for TPU-era use."""
+
+    params = {
+        "axis": Param("shape", default=None),
+        "keepdims": Param(bool, default=False),
+    }
+
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+        self.params = dict(_Reduce.params)
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        axis = params["axis"]
+        if axis is None:
+            return [d], [(1,)], []
+        out = tuple(
+            (1 if params["keepdims"] else None) if i in axis else s
+            for i, s in enumerate(d)
+        )
+        out = tuple(s for s in out if s is not None)
+        return [d], [out if out else (1,)], []
+
+    def apply(self, octx, params, inputs, aux):
+        axis = params["axis"]
+        x = inputs[0]
+        if axis is None:
+            return [self._fn(x).reshape(1)], []
+        out = self._fn(x, axis=axis, keepdims=params["keepdims"])
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return [out], []
+
+
+register(_Reduce("sum", jnp.sum), aliases=["sum_axis"])
+register(_Reduce("max", jnp.max), aliases=["max_axis"])
+register(_Reduce("min", jnp.min), aliases=["min_axis"])
+register(_Reduce("norm", lambda x, **kw: jnp.sqrt(jnp.sum(jnp.square(x), **kw))))
+
+
+class _ArgmaxChannel(OpDef):
+    """argmax over axis 1, per row (`broadcast_reduce_op-inl.h` argmax_channel).
+    Input (n, c) -> output (n,)."""
+
+    name = "argmax_channel"
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        if len(d) != 2:
+            raise MXNetError("argmax_channel: input must be 2D")
+        return [d], [(d[0],)], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [jnp.argmax(inputs[0], axis=1).astype(inputs[0].dtype)], []
+
+
+register(_ArgmaxChannel)
+
+
+class _Transpose(OpDef):
+    name = "transpose"
+    params = {"axes": Param("shape", default=None)}
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        axes = params["axes"] or tuple(reversed(range(len(d))))
+        return [d], [tuple(d[a] for a in axes)], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [jnp.transpose(inputs[0], params["axes"])], []
+
+
+register(_Transpose)
+
+
+class _SmoothL1(OpDef):
+    """smooth_l1 with sigma (present in later simple-op sets; useful for
+    detection heads)."""
+
+    name = "smooth_l1"
+    params = {"scalar": Param(float, default=1.0)}
+
+    def apply(self, octx, params, inputs, aux):
+        sigma2 = params["scalar"] ** 2
+        x = inputs[0]
+        out = jnp.where(
+            jnp.abs(x) < 1.0 / sigma2,
+            0.5 * sigma2 * jnp.square(x),
+            jnp.abs(x) - 0.5 / sigma2,
+        )
+        return [out], []
+
+
+register(_SmoothL1)

@@ -1,0 +1,226 @@
+"""Loss-head operators with reference backward semantics.
+
+Reference: `src/operator/softmax_output-inl.h`, `regression_output-inl.h`,
+`loss_binary_op-inl.h`, `identity_attach_KL_sparse_reg-inl.h`.
+
+These ops are special: their *training gradient is not the autodiff of their
+forward*.  The reference hard-codes backward = `(prediction - label) *
+grad_scale` and ignores any incoming head gradient (loss layers are graph
+terminals).  We reproduce that exactly with `jax.custom_vjp`, so `jax.vjp`
+over a composed graph yields the same gradients as the reference executor.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import OpDef, Param, register
+
+
+# -- SoftmaxOutput --------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output):
+    return jax.nn.softmax(data, axis=1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output):
+    out = jax.nn.softmax(data, axis=1)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output, res, g):
+    out, label = res
+    # one-hot along axis 1; label shape = data shape minus axis 1
+    lbl = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lbl, out.shape[1], axis=1, dtype=out.dtype)
+    grad = out - onehot
+    if use_ignore:
+        mask = (label != ignore_label).astype(out.dtype)
+        grad = grad * jnp.expand_dims(mask, 1)
+    grad = grad * grad_scale
+    return grad.astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+class SoftmaxOutput(OpDef):
+    """Softmax with cross-entropy gradient (`softmax_output-inl.h`).
+
+    Forward: softmax over axis 1 ((n, c) or (n, c, ...) with
+    multi_output).  Backward: `(softmax - onehot(label)) * grad_scale`,
+    entries with `label == ignore_label` zeroed when `use_ignore`.
+    Registered alias `Softmax` like the reference's deprecated name.
+    """
+
+    name = "SoftmaxOutput"
+    params = {
+        "grad_scale": Param(float, default=1.0),
+        "ignore_label": Param(float, default=-1.0),
+        "multi_output": Param(bool, default=False),
+        "use_ignore": Param(bool, default=False),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        lshape = (d[0],) + tuple(d[2:]) if params["multi_output"] else (d[0],)
+        return [d, lshape], [d], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [
+            _softmax_output(
+                inputs[0],
+                inputs[1],
+                params["grad_scale"],
+                params["ignore_label"],
+                params["use_ignore"],
+                params["multi_output"],
+            )
+        ], []
+
+
+register(SoftmaxOutput, aliases=["Softmax"])
+
+
+# -- Regression outputs ---------------------------------------------------
+
+
+def _make_regression(name_, fwd_fn, grad_fn):
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def fwd(data, label, grad_scale):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        n = label.shape[0] if label.ndim else 1
+        grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / 1.0)
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    op.defvjp(fwd, bwd)
+
+    class _Reg(OpDef):
+        name = name_
+        params = {"grad_scale": Param(float, default=1.0)}
+
+        def list_arguments(self, params):
+            return ["data", "label"]
+
+        def infer_shape(self, params, in_shapes):
+            d = in_shapes[0]
+            if d is None:
+                return in_shapes, [None], []
+            return [d, d], [d], []
+
+        def apply(self, octx, params, inputs, aux):
+            return [op(inputs[0], inputs[1], params["grad_scale"])], []
+
+    _Reg.__doc__ = "`src/operator/regression_output-inl.h` (%s)" % name_
+    return _Reg
+
+
+register(_make_regression("LinearRegressionOutput", lambda x: x,
+                          lambda o, l: o - l))
+register(_make_regression("LogisticRegressionOutput", jax.nn.sigmoid,
+                          lambda o, l: o - l))
+register(_make_regression("MAERegressionOutput", lambda x: x,
+                          lambda o, l: jnp.sign(o - l)))
+
+
+# -- softmax_cross_entropy (loss_binary_op-inl.h) -------------------------
+
+
+@jax.custom_vjp
+def _softmax_ce(data, label):
+    logp = jax.nn.log_softmax(data, axis=1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=1
+    )[:, 0]
+    return -jnp.sum(picked).reshape(1)
+
+
+def _softmax_ce_fwd(data, label):
+    return _softmax_ce(data, label), (data, label)
+
+
+def _softmax_ce_bwd(res, g):
+    data, label = res
+    prob = jax.nn.softmax(data, axis=1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[1], dtype=data.dtype)
+    return (g[0] * (prob - onehot), jnp.zeros_like(label))
+
+
+_softmax_ce.defvjp(_softmax_ce_fwd, _softmax_ce_bwd)
+
+
+class SoftmaxCrossEntropy(OpDef):
+    """`src/operator/loss_binary_op-inl.h` — scalar summed CE loss."""
+
+    name = "softmax_cross_entropy"
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [(1,)], []
+        return [d, (d[0],)], [(1,)], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [_softmax_ce(inputs[0], inputs[1])], []
+
+
+register(SoftmaxCrossEntropy)
+
+
+# -- IdentityAttachKLSparseReg -------------------------------------------
+
+
+class IdentityAttachKLSparseReg(OpDef):
+    """`src/operator/identity_attach_KL_sparse_reg-inl.h` — identity forward;
+    backward adds the KL-sparseness penalty gradient
+    `penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat))` where rho_hat is the
+    batch mean activation (sigmoid-activity assumption)."""
+
+    name = "IdentityAttachKLSparseReg"
+    params = {
+        "sparseness_target": Param(float, default=0.1),
+        "penalty": Param(float, default=0.001),
+        "momentum": Param(float, default=0.9),
+    }
+
+    def apply(self, octx, params, inputs, aux):
+        rho = params["sparseness_target"]
+        penalty = params["penalty"]
+
+        @jax.custom_vjp
+        def _op(x):
+            return x
+
+        def _fwd(x):
+            return x, x
+
+        def _bwd(x, g):
+            rho_hat = jnp.mean(x, axis=0, keepdims=True)
+            kl = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+            return (g + kl.astype(x.dtype),)
+
+        _op.defvjp(_fwd, _bwd)
+        return [_op(inputs[0])], []
+
+
+register(IdentityAttachKLSparseReg)

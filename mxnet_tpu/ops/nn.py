@@ -1,0 +1,520 @@
+"""Neural-network layer operators.
+
+Reference: the per-layer files under `src/operator/` (each a
+`foo-inl.h`/`foo.cc`/`foo.cu` triple registered via
+`MXNET_REGISTER_OP_PROPERTY`).  Shape semantics (NCHW, ceil-mode pooling,
+weight layouts) match the reference so symbol zoos port unchanged; kernels are
+jnp/lax so XLA tiles the matmuls/convs onto the MXU and fuses the elementwise
+epilogues — the TPU replacement for mshadow expression templates + cuDNN.
+
+Loss heads (SoftmaxOutput, *RegressionOutput, softmax_cross_entropy) use
+`jax.custom_vjp`: like the reference, their backward ignores the incoming head
+gradient and emits `(prediction - label) * grad_scale`
+(`src/operator/softmax_output-inl.h`, `regression_output-inl.h`).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpCtx, OpDef, Param, register
+
+
+def _pair(v, name):
+    if v is None:
+        return None
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        v = (v[0], v[0])
+    if len(v) != 2:
+        raise MXNetError("%s must have 2 entries, got %r" % (name, v))
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+class Activation(OpDef):
+    """`src/operator/activation-inl.h`: relu/sigmoid/tanh/softrelu."""
+
+    name = "Activation"
+    params = {"act_type": Param(str, required=True)}
+    _FNS = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+    }
+
+    def apply(self, octx, params, inputs, aux):
+        act = params["act_type"]
+        if act not in self._FNS:
+            raise MXNetError("Activation: unknown act_type %r" % act)
+        return [self._FNS[act](inputs[0])], []
+
+
+register(Activation)
+
+
+class LeakyReLU(OpDef):
+    """`src/operator/leaky_relu-inl.h`: leaky/prelu/rrelu (+elu extension).
+
+    rrelu draws a uniform slope in [lower_bound, upper_bound] per element in
+    training and uses the midpoint at inference, like the reference.
+    """
+
+    name = "LeakyReLU"
+    params = {
+        "act_type": Param(str, default="leaky"),
+        "slope": Param(float, default=0.25),
+        "lower_bound": Param(float, default=0.125),
+        "upper_bound": Param(float, default=0.334),
+    }
+    need_rng = True
+
+    def list_arguments(self, params):
+        if params["act_type"] == "prelu":
+            return ["data", "gamma"]
+        return ["data"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if params["act_type"] == "prelu":
+            g = (d[1],) if d is not None else in_shapes[1]
+            return [d, g], [d], []
+        return [d], [d], []
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        act = params["act_type"]
+        if act == "leaky":
+            return [jnp.where(x > 0, x, params["slope"] * x)], []
+        if act == "elu":
+            return [jnp.where(x > 0, x, params["slope"] * (jnp.exp(x) - 1.0))], []
+        if act == "prelu":
+            gamma = inputs[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+            return [jnp.where(x > 0, x, gamma * x)], []
+        if act == "rrelu":
+            lo, hi = params["lower_bound"], params["upper_bound"]
+            if octx.is_train:
+                slope = jax.random.uniform(
+                    octx.require_rng(), x.shape, x.dtype, lo, hi
+                )
+            else:
+                slope = (lo + hi) / 2.0
+            return [jnp.where(x > 0, x, slope * x)], []
+        raise MXNetError("LeakyReLU: unknown act_type %r" % act)
+
+
+register(LeakyReLU)
+
+
+class SoftmaxActivation(OpDef):
+    """`src/operator/softmax_activation-inl.h`: softmax over features
+    (mode=instance) or over channel axis per spatial position (mode=channel)."""
+
+    name = "SoftmaxActivation"
+    params = {"mode": Param(str, default="instance")}
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        if params["mode"] == "channel":
+            return [jax.nn.softmax(x, axis=1)], []
+        flat = x.reshape(x.shape[0], -1)
+        return [jax.nn.softmax(flat, axis=1).reshape(x.shape)], []
+
+
+register(SoftmaxActivation)
+
+
+# ---------------------------------------------------------------------------
+# Dense / conv / pooling
+# ---------------------------------------------------------------------------
+
+
+class FullyConnected(OpDef):
+    """`src/operator/fully_connected-inl.h:46-243` — y = x·Wᵀ + b.
+
+    Input is flattened to (batch, -1) like the reference; the matmul
+    accumulates in f32 on the MXU regardless of input dtype.
+    """
+
+    name = "FullyConnected"
+    params = {
+        "num_hidden": Param(int, required=True),
+        "no_bias": Param(bool, default=False),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "weight"] if params["no_bias"] else ["data", "weight", "bias"]
+
+    def infer_shape(self, params, in_shapes):
+        nh = params["num_hidden"]
+        d = in_shapes[0]
+        if d is None:
+            w = in_shapes[1]
+            if w is not None:
+                # partial backward inference: batch unknown
+                out = None
+            return in_shapes, [None], []
+        flat = int(np.prod(d[1:]))
+        shapes = [d, (nh, flat)]
+        if not params["no_bias"]:
+            shapes.append((nh,))
+        return shapes, [(d[0], nh)], []
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0].reshape(inputs[0].shape[0], -1)
+        w = inputs[1]
+        y = jnp.dot(x, w.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        if not params["no_bias"]:
+            y = y + inputs[2]
+        return [y], []
+
+
+register(FullyConnected)
+
+
+class Convolution(OpDef):
+    """`src/operator/convolution-inl.h` — NCHW, OIHW weights, grouped conv.
+
+    Lowered to a single `lax.conv_general_dilated`, XLA's native conv HLO,
+    which the TPU compiler maps onto the MXU (vs the reference's im2col+gemm,
+    `convolution-inl.h:104-135`)."""
+
+    name = "Convolution"
+    params = {
+        "kernel": Param("shape", required=True),
+        "stride": Param("shape", default=(1, 1)),
+        "dilate": Param("shape", default=(1, 1)),
+        "pad": Param("shape", default=(0, 0)),
+        "num_filter": Param(int, required=True),
+        "num_group": Param(int, default=1),
+        "no_bias": Param(bool, default=False),
+        "workspace": Param(int, default=512),  # accepted, ignored (XLA plans)
+    }
+
+    def list_arguments(self, params):
+        return ["data", "weight"] if params["no_bias"] else ["data", "weight", "bias"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        if len(d) != 4:
+            raise MXNetError("Convolution: data must be NCHW 4D, got %s" % (d,))
+        k = _pair(params["kernel"], "kernel")
+        s = _pair(params["stride"], "stride")
+        dil = _pair(params["dilate"], "dilate")
+        p = _pair(params["pad"], "pad")
+        nf, ng = params["num_filter"], params["num_group"]
+        if d[1] % ng or nf % ng:
+            raise MXNetError("Convolution: channels not divisible by num_group")
+        wshape = (nf, d[1] // ng, k[0], k[1])
+        oh = (d[2] + 2 * p[0] - (dil[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (d[3] + 2 * p[1] - (dil[1] * (k[1] - 1) + 1)) // s[1] + 1
+        if oh <= 0 or ow <= 0:
+            raise MXNetError("Convolution: kernel exceeds input")
+        shapes = [d, wshape] + ([] if params["no_bias"] else [(nf,)])
+        return shapes, [(d[0], nf, oh, ow)], []
+
+    def apply(self, octx, params, inputs, aux):
+        k = _pair(params["kernel"], "kernel")
+        s = _pair(params["stride"], "stride")
+        dil = _pair(params["dilate"], "dilate")
+        p = _pair(params["pad"], "pad")
+        x, w = inputs[0], inputs[1]
+        y = jax.lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])],
+            rhs_dilation=dil,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=params["num_group"],
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if not params["no_bias"]:
+            y = y + inputs[2].reshape(1, -1, 1, 1)
+        return [y], []
+
+
+register(Convolution)
+
+
+class Deconvolution(OpDef):
+    """`src/operator/deconvolution-inl.h` — transposed convolution.
+    Weight layout (C_in, num_filter/num_group, kh, kw); output spatial size
+    `stride*(in-1) + kernel - 2*pad` like the reference's InferShape."""
+
+    name = "Deconvolution"
+    params = {
+        "kernel": Param("shape", required=True),
+        "stride": Param("shape", default=(1, 1)),
+        "pad": Param("shape", default=(0, 0)),
+        "num_filter": Param(int, required=True),
+        "num_group": Param(int, default=1),
+        "no_bias": Param(bool, default=True),
+        "workspace": Param(int, default=512),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "weight"] if params["no_bias"] else ["data", "weight", "bias"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        k = _pair(params["kernel"], "kernel")
+        s = _pair(params["stride"], "stride")
+        p = _pair(params["pad"], "pad")
+        nf, ng = params["num_filter"], params["num_group"]
+        wshape = (d[1], nf // ng, k[0], k[1])
+        oh = s[0] * (d[2] - 1) + k[0] - 2 * p[0]
+        ow = s[1] * (d[3] - 1) + k[1] - 2 * p[1]
+        shapes = [d, wshape] + ([] if params["no_bias"] else [(nf,)])
+        return shapes, [(d[0], nf, oh, ow)], []
+
+    def apply(self, octx, params, inputs, aux):
+        k = _pair(params["kernel"], "kernel")
+        s = _pair(params["stride"], "stride")
+        p = _pair(params["pad"], "pad")
+        x, w = inputs[0], inputs[1]
+        # Transposed conv = input-dilated conv with spatially-flipped kernel
+        # and swapped I/O channels ("IOHW" dimension numbers).
+        y = jax.lax.conv_general_dilated(
+            x,
+            jnp.flip(w, axis=(-2, -1)),
+            window_strides=(1, 1),
+            padding=[(k[0] - 1 - p[0], k[0] - 1 - p[0]),
+                     (k[1] - 1 - p[1], k[1] - 1 - p[1])],
+            lhs_dilation=s,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            feature_group_count=params["num_group"],
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if not params["no_bias"]:
+            y = y + inputs[2].reshape(1, -1, 1, 1)
+        return [y], []
+
+
+register(Deconvolution)
+
+
+class Pooling(OpDef):
+    """`src/operator/pooling-inl.h` — max/avg/sum, NCHW, the reference's
+    clamped ceil-mode output size (`pooling-inl.h:191-197`).  avg divides by
+    the full kernel area including padding, like `pooling-inl.h:94`."""
+
+    name = "Pooling"
+    params = {
+        "kernel": Param("shape", required=True),
+        "pool_type": Param(str, default="max"),
+        "stride": Param("shape", default=(1, 1)),
+        "pad": Param("shape", default=(0, 0)),
+        "global_pool": Param(bool, default=False),
+    }
+
+    def _out_hw(self, params, d):
+        k = _pair(params["kernel"], "kernel")
+        s = _pair(params["stride"], "stride")
+        p = _pair(params["pad"], "pad")
+        if params["global_pool"]:
+            return (1, 1), (d[2], d[3]), (1, 1), (0, 0)
+        oh = min(d[2] + 2 * p[0] - k[0] + s[0] - 1, d[2] + 2 * p[0] - 1) // s[0] + 1
+        ow = min(d[3] + 2 * p[1] - k[1] + s[1] - 1, d[3] + 2 * p[1] - 1) // s[1] + 1
+        if oh <= 0 or ow <= 0:
+            raise MXNetError("Pooling: kernel size exceeds input")
+        return (oh, ow), k, s, p
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        if len(d) != 4:
+            raise MXNetError("Pooling: data must be NCHW 4D")
+        (oh, ow), _, _, _ = self._out_hw(params, d)
+        return [d], [(d[0], d[1], oh, ow)], []
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        d = x.shape
+        (oh, ow), k, s, p = self._out_hw(params, d)
+        # ceil-mode: extend bottom/right padding so every output window fits
+        eh = max(0, (oh - 1) * s[0] + k[0] - (d[2] + 2 * p[0]))
+        ew = max(0, (ow - 1) * s[1] + k[1] - (d[3] + 2 * p[1]))
+        pads = ((0, 0), (0, 0), (p[0], p[0] + eh), (p[1], p[1] + ew))
+        pt = params["pool_type"]
+        if pt == "max":
+            init = -jnp.inf
+            out = jax.lax.reduce_window(
+                x, init, jax.lax.max, (1, 1, k[0], k[1]), (1, 1, s[0], s[1]), pads
+            )
+        elif pt in ("avg", "sum"):
+            out = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1, 1, k[0], k[1]), (1, 1, s[0], s[1]), pads
+            )
+            if pt == "avg":
+                out = out / (k[0] * k[1])
+        else:
+            raise MXNetError("Pooling: unknown pool_type %r" % pt)
+        return [out.astype(x.dtype)], []
+
+
+register(Pooling)
+
+
+# ---------------------------------------------------------------------------
+# Normalization / regularization
+# ---------------------------------------------------------------------------
+
+
+class BatchNorm(OpDef):
+    """`src/operator/batch_norm-inl.h` — batch normalization over axis 1.
+
+    Outputs [output, mean, var] with one visible output; aux states
+    moving_mean/moving_var updated with the reference's momentum rule.
+    `fix_gamma` defaults True like the reference (`batch_norm-inl.h:40`).
+    Training backward differentiates through the batch statistics (the
+    reference hand-derives this; here `jax.vjp` does).
+    """
+
+    name = "BatchNorm"
+    params = {
+        "eps": Param(float, default=1e-3),
+        "momentum": Param(float, default=0.9),
+        "fix_gamma": Param(bool, default=True),
+        "use_global_stats": Param(bool, default=False),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "gamma", "beta"]
+
+    def list_outputs(self, params):
+        return ["output", "mean", "var"]
+
+    def num_visible_outputs(self, params):
+        return 1
+
+    def list_aux(self, params):
+        return ["moving_mean", "moving_var"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None, None, None], [None, None]
+        c = (d[1],)
+        return [d, c, c], [d, c, c], [c, c]
+
+    def apply(self, octx, params, inputs, aux):
+        x, gamma, beta = inputs
+        moving_mean, moving_var = aux
+        axes = tuple(i for i in range(x.ndim) if i != 1)
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        if params["fix_gamma"]:
+            gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+        if octx.is_train and not params["use_global_stats"]:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            m = params["momentum"]
+            new_mean = moving_mean * m + mean * (1 - m)
+            new_var = moving_var * m + var * (1 - m)
+            aux_updates = [jax.lax.stop_gradient(new_mean),
+                           jax.lax.stop_gradient(new_var)]
+        else:
+            mean, var = moving_mean, moving_var
+            aux_updates = [None, None]
+        inv = jax.lax.rsqrt(var.reshape(bshape) + params["eps"])
+        out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(
+            bshape
+        )
+        return [out, mean, var], aux_updates
+
+
+register(BatchNorm)
+
+
+class Dropout(OpDef):
+    """`src/operator/dropout-inl.h` — inverted dropout (scale at train)."""
+
+    name = "Dropout"
+    params = {"p": Param(float, default=0.5)}
+    need_rng = True
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        p = params["p"]
+        if not octx.is_train or p <= 0.0:
+            return [x], []
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(octx.require_rng(), keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)], []
+
+
+register(Dropout)
+
+
+class LRN(OpDef):
+    """`src/operator/lrn-inl.h` — local response norm across channels:
+    out = x * (knorm + alpha/nsize * Σ_window x²)^(-beta)."""
+
+    name = "LRN"
+    params = {
+        "alpha": Param(float, default=1e-4),
+        "beta": Param(float, default=0.75),
+        "knorm": Param(float, default=2.0),
+        "nsize": Param(int, required=True),
+    }
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        n = params["nsize"]
+        half = n // 2
+        sq = jnp.square(x)
+        ssum = jax.lax.reduce_window(
+            sq,
+            0.0,
+            jax.lax.add,
+            (1, n, 1, 1),
+            (1, 1, 1, 1),
+            ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)),
+        )
+        scale = params["knorm"] + (params["alpha"] / n) * ssum
+        return [(x * jnp.power(scale, -params["beta"])).astype(x.dtype)], []
+
+
+register(LRN)
+
+
+class Embedding(OpDef):
+    """`src/operator/embedding-inl.h` — table lookup; backward is a
+    scatter-add into the table (autodiff of `take`)."""
+
+    name = "Embedding"
+    params = {
+        "input_dim": Param(int, required=True),
+        "output_dim": Param(int, required=True),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "weight"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        w = (params["input_dim"], params["output_dim"])
+        if d is None:
+            return [None, w], [None], []
+        return [d, w], [tuple(d) + (params["output_dim"],)], []
+
+    def apply(self, octx, params, inputs, aux):
+        idx = inputs[0].astype(jnp.int32)
+        return [jnp.take(inputs[1], idx, axis=0)], []
+
+
+register(Embedding)

@@ -1,0 +1,231 @@
+"""Operator registry: metadata + pure-JAX kernels.
+
+Reference: `include/mxnet/operator.h` (`Operator`/`OperatorProperty`),
+`include/mxnet/operator_util.h` (simple-op registry) and
+`MXNET_REGISTER_OP_PROPERTY` registrations across `src/operator/*.cc`.
+
+TPU-first redesign: an operator is a **pure function** over jax arrays plus
+metadata.  What the reference split across `Forward`/`Backward`/`InferShape`/
+`InferType`/`DeclareBackwardDependency`/inplace options collapses to:
+
+* ``apply(octx, params, inputs, aux) -> (outputs, aux_updates)`` — a pure
+  traceable function.  Backward is derived by `jax.vjp`; ops whose training
+  gradient is *not* the autodiff of their forward (SoftmaxOutput, BlockGrad,
+  regression heads) use `jax.custom_vjp` inside ``apply``.
+* ``infer_shape`` — forward+bidirectional shape completion so `simple_bind`
+  can materialize parameter shapes from the data shape alone, like
+  `OperatorProperty::InferShape` (`operator.h:152-172`).
+* memory planning, inplace, backward-dependency pruning: subsumed by XLA.
+
+Each op is registered once and exposed through both the imperative `mx.nd`
+namespace and the symbolic `mx.sym` namespace, mirroring the reference's
+dual-registered simple ops (`operator_util.h:363-434`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, check_shape
+
+_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class OpCtx:
+    """Per-call context threaded through ``apply``: training flag + PRNG key.
+
+    Replaces the reference's `OpContext{is_train, RunContext, requested
+    resources}` (`operator.h:48-74`): temp space is XLA's problem, the PRNG is
+    a functional key (no per-device stateful `Random<xpu>` needed).
+    """
+
+    __slots__ = ("is_train", "rng")
+
+    def __init__(self, is_train=False, rng=None):
+        self.is_train = is_train
+        self.rng = rng
+
+    def require_rng(self):
+        if self.rng is None:
+            raise MXNetError("operator requires an RNG key but none was provided")
+        return self.rng
+
+
+class Param:
+    """Typed keyword parameter (dmlc::Parameter analogue, `base.h:227-276`)."""
+
+    __slots__ = ("name", "type", "default", "required", "doc")
+
+    def __init__(self, type, default=None, required=False, doc=""):
+        self.name = None
+        self.type = type
+        self.default = default
+        self.required = required
+        self.doc = doc
+
+    def parse(self, value):
+        t = self.type
+        if t is bool:
+            if isinstance(value, str):
+                return value.lower() in ("true", "1")
+            return bool(value)
+        if t == "shape":
+            return check_shape(value) if value is not None else None
+        if t is float:
+            return float(value)
+        if t is int:
+            return int(value)
+        if t is str:
+            return str(value)
+        return value
+
+
+class OpDef:
+    """Base class for operator definitions.  Subclass and register()."""
+
+    name: str = None
+    params: dict = {}
+    # variable-arity input op (Concat/ElementwiseSum): name of the count param
+    key_var_num_args: str = None
+    need_rng: bool = False
+
+    # -- metadata ---------------------------------------------------------
+    def list_arguments(self, params):
+        return ["data"]
+
+    def list_outputs(self, params):
+        return ["output"]
+
+    def list_aux(self, params):
+        return []
+
+    def parse_params(self, kwargs):
+        out = {}
+        kwargs = dict(kwargs)
+        for pname, p in self.params.items():
+            if pname in kwargs:
+                out[pname] = p.parse(kwargs.pop(pname))
+            elif p.required:
+                raise MXNetError("%s: required parameter %r missing" % (self.name, pname))
+            else:
+                out[pname] = p.default
+        if kwargs:
+            raise MXNetError("%s: unknown parameters %s" % (self.name, sorted(kwargs)))
+        return out
+
+    # -- shape/type inference --------------------------------------------
+    def infer_shape(self, params, in_shapes):
+        """Complete shapes.  ``in_shapes``: list aligned with list_arguments,
+        entries are tuples or None.  Returns (in_shapes, out_shapes,
+        aux_shapes); any entry may be None if not yet inferable."""
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        return [d] * len(in_shapes), [d], []
+
+    def infer_type(self, params, in_types):
+        known = [t for t in in_types if t is not None]
+        if not known:
+            return in_types, [None] * len(self.list_outputs(params)), []
+        t = known[0]
+        n_aux = len(self.list_aux(params))
+        return (
+            [t] * len(in_types),
+            [t] * len(self.list_outputs(params)),
+            [t] * n_aux,
+        )
+
+    # -- compute ----------------------------------------------------------
+    def apply(self, octx: OpCtx, params, inputs, aux):
+        """Pure function: jax arrays in -> (list of outputs, list of aux
+        updates (same length as list_aux; None = unchanged))."""
+        raise NotImplementedError(self.name)
+
+
+def register(op_cls_or_def, aliases=()):
+    """Register an OpDef (class or instance).  Returns the instance."""
+    op = op_cls_or_def() if isinstance(op_cls_or_def, type) else op_cls_or_def
+    if not op.name:
+        raise MXNetError("op must have a name")
+    _REGISTRY[op.name] = op
+    for a in aliases:
+        _REGISTRY[a] = op
+    return op
+
+
+def get(name: str) -> OpDef:
+    if name not in _REGISTRY:
+        raise MXNetError("unknown operator %r" % name)
+    return _REGISTRY[name]
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Declarative helpers for the "simple op" families
+# (`src/operator/elementwise_*`, `broadcast_reduce_op`): one-liner
+# registrations that surface in both mx.nd and mx.sym.
+# ---------------------------------------------------------------------------
+
+
+class _UnaryOp(OpDef):
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+        self.params = {}
+
+    def apply(self, octx, params, inputs, aux):
+        return [self._fn(inputs[0])], []
+
+
+class _BinaryOp(OpDef):
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+        self.params = {}
+
+    def list_arguments(self, params):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, params, in_shapes):
+        a, b = in_shapes
+        s = a if a is not None else b
+        if a is not None and b is not None and a != b:
+            raise MXNetError(
+                "%s: shape mismatch %s vs %s" % (self.name, a, b)
+            )
+        return [s, s], [s], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [self._fn(inputs[0], inputs[1])], []
+
+
+class _ScalarOp(OpDef):
+    """op(tensor, scalar) with optional reverse (`elementwise_binary_scalar_op`)."""
+
+    params = {"scalar": Param(float, required=True)}
+
+    def __init__(self, name, fn, reverse=False):
+        self.name = name
+        self._fn = fn
+        self._reverse = reverse
+        self.params = dict(_ScalarOp.params)
+
+    def apply(self, octx, params, inputs, aux):
+        s = params["scalar"]
+        a = inputs[0]
+        out = self._fn(s, a) if self._reverse else self._fn(a, s)
+        return [out], []
+
+
+def register_unary(name, fn, aliases=()):
+    return register(_UnaryOp(name, fn), aliases=aliases)
+
+
+def register_binary(name, fn, aliases=()):
+    return register(_BinaryOp(name, fn), aliases=aliases)
+
+
+def register_scalar(name, fn, reverse=False, aliases=()):
+    return register(_ScalarOp(name, fn, reverse=reverse), aliases=aliases)

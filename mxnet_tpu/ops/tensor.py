@@ -1,0 +1,338 @@
+"""Tensor-manipulation operators.
+
+Reference: `src/operator/{reshape,concat,slice_channel,swapaxis,cast,
+block_grad,crop,upsampling,elementwise_sum}-inl.h`.  All pure data-movement:
+on TPU these lower to XLA reshape/transpose/concat HLOs that usually fuse
+away entirely.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, np_dtype
+from .registry import OpDef, Param, register
+
+
+class Reshape(OpDef):
+    """`src/operator/reshape-inl.h`.  Accepts `target_shape` (reference) or
+    `shape` with 0=copy-dim and -1=infer extensions."""
+
+    name = "Reshape"
+    params = {
+        "target_shape": Param("shape", default=None),
+        "shape": Param("shape", default=None),
+    }
+
+    def _resolve(self, params, d):
+        tgt = params["shape"] or params["target_shape"]
+        if tgt is None:
+            raise MXNetError("Reshape: need target_shape or shape")
+        tgt = list(tgt)
+        for i, v in enumerate(tgt):
+            if v == 0:
+                tgt[i] = d[i]
+        if -1 in tgt:
+            known = int(np.prod([v for v in tgt if v != -1]))
+            tgt[tgt.index(-1)] = int(np.prod(d)) // max(known, 1)
+        if int(np.prod(tgt)) != int(np.prod(d)):
+            raise MXNetError("Reshape: size mismatch %s -> %s" % (d, tgt))
+        return tuple(tgt)
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        return [d], [self._resolve(params, d)], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [jnp.reshape(inputs[0], self._resolve(params, inputs[0].shape))], []
+
+
+register(Reshape)
+
+
+class Flatten(OpDef):
+    """Flatten to (batch, -1) (`src/operator/reshape-inl.h` Flatten)."""
+
+    name = "Flatten"
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        return [d], [(d[0], int(np.prod(d[1:])))], []
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        return [x.reshape(x.shape[0], -1)], []
+
+
+register(Flatten)
+
+
+class Concat(OpDef):
+    """`src/operator/concat-inl.h` — variable-arity concat along `dim`."""
+
+    name = "Concat"
+    params = {
+        "num_args": Param(int, required=True),
+        "dim": Param(int, default=1),
+    }
+    key_var_num_args = "num_args"
+
+    def list_arguments(self, params):
+        return ["arg%d" % i for i in range(params["num_args"])]
+
+    def infer_shape(self, params, in_shapes):
+        dim = params["dim"]
+        known = [s for s in in_shapes if s is not None]
+        if not known:
+            return in_shapes, [None], []
+        base = list(known[0])
+        total = 0
+        for s in in_shapes:
+            if s is None:
+                return in_shapes, [None], []
+            total += s[dim]
+        base[dim] = total
+        return in_shapes, [tuple(base)], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [jnp.concatenate(inputs, axis=params["dim"])], []
+
+
+register(Concat)
+
+
+class SliceChannel(OpDef):
+    """`src/operator/slice_channel-inl.h` — split into num_outputs along
+    `axis` (default 1), optional squeeze of the split axis."""
+
+    name = "SliceChannel"
+    params = {
+        "num_outputs": Param(int, required=True),
+        "axis": Param(int, default=1),
+        "squeeze_axis": Param(bool, default=False),
+    }
+
+    def list_outputs(self, params):
+        return ["output%d" % i for i in range(params["num_outputs"])]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        n = params["num_outputs"]
+        if d is None:
+            return in_shapes, [None] * n, []
+        ax = params["axis"]
+        if d[ax] % n:
+            raise MXNetError("SliceChannel: axis %d size %d not divisible by %d"
+                             % (ax, d[ax], n))
+        piece = list(d)
+        piece[ax] = d[ax] // n
+        if params["squeeze_axis"]:
+            if piece[ax] != 1:
+                raise MXNetError("SliceChannel: squeeze_axis needs size-1 slices")
+            piece.pop(ax)
+        return [d], [tuple(piece)] * n, []
+
+    def apply(self, octx, params, inputs, aux):
+        outs = jnp.split(inputs[0], params["num_outputs"], axis=params["axis"])
+        if params["squeeze_axis"]:
+            outs = [jnp.squeeze(o, axis=params["axis"]) for o in outs]
+        return outs, []
+
+
+register(SliceChannel)
+
+
+class ElementWiseSum(OpDef):
+    """`src/operator/elementwise_sum-inl.h` — n-ary add (gradient
+    aggregation node; `kAddTo` semantics fall out of autodiff)."""
+
+    name = "ElementWiseSum"
+    params = {"num_args": Param(int, required=True)}
+    key_var_num_args = "num_args"
+
+    def list_arguments(self, params):
+        return ["arg%d" % i for i in range(params["num_args"])]
+
+    def infer_shape(self, params, in_shapes):
+        known = [s for s in in_shapes if s is not None]
+        s = known[0] if known else None
+        return [s] * len(in_shapes), [s], []
+
+    def apply(self, octx, params, inputs, aux):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out], []
+
+
+register(ElementWiseSum)
+
+
+class SwapAxis(OpDef):
+    """`src/operator/swapaxis-inl.h`."""
+
+    name = "SwapAxis"
+    params = {"dim1": Param(int, default=0), "dim2": Param(int, default=0)}
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        s = list(d)
+        a, b = params["dim1"], params["dim2"]
+        s[a], s[b] = s[b], s[a]
+        return [d], [tuple(s)], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [jnp.swapaxes(inputs[0], params["dim1"], params["dim2"])], []
+
+
+register(SwapAxis)
+
+
+class Cast(OpDef):
+    """`src/operator/cast-inl.h` — dtype cast (the gradient casts back)."""
+
+    name = "Cast"
+    params = {"dtype": Param(str, required=True)}
+
+    def infer_type(self, params, in_types):
+        out = np_dtype(params["dtype"])
+        return in_types, [out], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [inputs[0].astype(np_dtype(params["dtype"]).name)], []
+
+
+register(Cast)
+
+
+class BlockGrad(OpDef):
+    """`src/operator/block_grad-inl.h` — identity forward, zero gradient."""
+
+    name = "BlockGrad"
+
+    def apply(self, octx, params, inputs, aux):
+        return [jax.lax.stop_gradient(inputs[0])], []
+
+
+register(BlockGrad)
+
+
+class Crop(OpDef):
+    """`src/operator/crop-inl.h` — crop NCHW input to `h_w` (or to the size
+    of a second reference input) at `offset`, or centered."""
+
+    name = "Crop"
+    params = {
+        "num_args": Param(int, default=1),
+        "offset": Param("shape", default=(0, 0)),
+        "h_w": Param("shape", default=(0, 0)),
+        "center_crop": Param(bool, default=False),
+    }
+    key_var_num_args = "num_args"
+
+    def list_arguments(self, params):
+        if params["num_args"] == 2:
+            return ["data", "crop_like"]
+        return ["data"]
+
+    def _target(self, params, d, like):
+        if params["num_args"] == 2 and like is not None:
+            return like[2], like[3]
+        hw = params["h_w"]
+        if hw == (0, 0):
+            raise MXNetError("Crop: need h_w or a crop_like input")
+        return hw[0], hw[1]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        like = in_shapes[1] if len(in_shapes) > 1 else None
+        if d is None or (params["num_args"] == 2 and like is None):
+            return in_shapes, [None], []
+        th, tw = self._target(params, d, like)
+        return in_shapes, [(d[0], d[1], th, tw)], []
+
+    def apply(self, octx, params, inputs, aux):
+        x = inputs[0]
+        like = inputs[1].shape if len(inputs) > 1 else None
+        th, tw = self._target(params, x.shape, like)
+        if params["center_crop"]:
+            oy = (x.shape[2] - th) // 2
+            ox = (x.shape[3] - tw) // 2
+        else:
+            oy, ox = params["offset"]
+        return [jax.lax.dynamic_slice(
+            x, (0, 0, oy, ox), (x.shape[0], x.shape[1], th, tw)
+        )], []
+
+
+register(Crop)
+
+
+class UpSampling(OpDef):
+    """`src/operator/upsampling-inl.h` — nearest or bilinear upsampling of
+    one or more inputs to `scale`× the (largest) input, concatenated along
+    channels.  Bilinear uses `jax.image.resize` instead of the reference's
+    learned deconvolution filter."""
+
+    name = "UpSampling"
+    params = {
+        "scale": Param(int, required=True),
+        "sample_type": Param(str, default="nearest"),
+        "num_args": Param(int, default=1),
+        "num_filter": Param(int, default=0),  # accepted for parity
+    }
+    key_var_num_args = "num_args"
+
+    def list_arguments(self, params):
+        n = params["num_args"]
+        return ["arg%d" % i for i in range(n)] if n > 1 else ["data"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if any(s is None for s in in_shapes):
+            return in_shapes, [None], []
+        sc = params["scale"]
+        oh, ow = d[2] * sc, d[3] * sc
+        c = sum(s[1] for s in in_shapes)
+        return in_shapes, [(d[0], c, oh, ow)], []
+
+    def apply(self, octx, params, inputs, aux):
+        sc = params["scale"]
+        oh, ow = inputs[0].shape[2] * sc, inputs[0].shape[3] * sc
+        ups = []
+        for x in inputs:
+            if params["sample_type"] == "bilinear":
+                up = jax.image.resize(
+                    x, (x.shape[0], x.shape[1], oh, ow), method="bilinear"
+                )
+            else:
+                r = oh // x.shape[2]
+                up = jnp.repeat(jnp.repeat(x, r, axis=2), ow // x.shape[3], axis=3)
+            ups.append(up)
+        out = ups[0] if len(ups) == 1 else jnp.concatenate(ups, axis=1)
+        return [out.astype(inputs[0].dtype)], []
+
+
+register(UpSampling)
+
+
+class _CrossDeviceCopy(OpDef):
+    """`src/operator/cross_device_copy.cc` — marker op the reference's
+    executor special-cased (`ExecType::kCrossDeviceCopy`).  Under XLA/SPMD,
+    device transfer is a sharding change; as a single-device op it is
+    identity."""
+
+    name = "_CrossDeviceCopy"
+
+    def apply(self, octx, params, inputs, aux):
+        return [inputs[0]], []
+
+
+register(_CrossDeviceCopy)

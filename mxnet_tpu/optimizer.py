@@ -1,0 +1,330 @@
+"""Optimizers (reference `python/mxnet/optimizer.py`, C++ side
+`src/optimizer/sgd-inl.h`).
+
+Registry + the reference's optimizer set: SGD (momentum/clip/rescale), SGLD,
+ccSGD (alias of SGD — the C++ fused impl is here the XLA-fused one), Adam,
+AdaGrad, RMSProp, AdaDelta, Test (used by distributed closed-form oracles).
+
+TPU-first: each `update` is a pure jitted kernel over (weight, grad, state);
+XLA fuses the whole update chain into one HBM-bandwidth-bound pass — the
+reference needed a hand-written CUDA kernel (`sgd.cu`) for the same effect.
+Per-parameter lr/wd multipliers, `param_idx2name`, lr schedulers and
+`get_updater` keep reference semantics so KVStore updaters work unchanged.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import random as _random
+
+__all__ = ["Optimizer", "SGD", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Test", "create", "get_updater", "register"]
+
+
+class Optimizer:
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, rescale_grad=1.0, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError("unknown optimizer %r" % name)
+        return Optimizer.opt_registry[name.lower()](rescale_grad=rescale_grad, **kwargs)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 arg_names=None, sym=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.num_update = 0
+        self._index_update_count = {}
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- multipliers (optimizer.py:124-170) -------------------------------
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name, a in attr.items():
+                if "__lr_mult__" in a:
+                    self.lr_mult[name] = float(a["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")
+                    or n.endswith("weight") or n.endswith("gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name, a in attr.items():
+                if "__wd_mult__" in a:
+                    self.wd_mult[name] = float(a["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _preprocess(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def create_state(self, index, weight):
+        raise NotImplementedError()
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+
+@Optimizer.register
+class SGD(Optimizer):
+    """SGD with momentum/weight decay (`optimizer.py:231`, `sgd-inl.h:21-40`).
+
+    mom = momentum*mom - lr*(grad*rescale + wd*weight); weight += mom
+    """
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess(grad.data)
+        w = weight.data
+        if state is not None:
+            mom = self.momentum * state.data - lr * (g + wd * w)
+            state._set_data(mom)
+            weight._set_data(w + mom)
+        else:
+            weight._set_data(w - lr * (g + wd * w))
+
+
+class ccSGD(SGD):
+    """Alias of SGD — the reference's C++-fused variant (`optimizer.py`
+    ccSGD); on TPU the standard path is already fused by XLA."""
+
+
+Optimizer.opt_registry["ccsgd"] = ccSGD
+
+
+@Optimizer.register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (`optimizer.py` SGLD)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess(grad.data)
+        w = weight.data
+        noise = jax.random.normal(_random.next_key(), w.shape, w.dtype) * math.sqrt(lr)
+        weight._set_data(w - lr / 2 * (g + wd * w) + noise)
+
+
+@Optimizer.register
+class Adam(Optimizer):
+    """Adam (`optimizer.py` Adam; Kingma & Ba)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 decay_factor=(1 - 1e-8), **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.decay_factor = decay_factor
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = self._preprocess(grad.data) + wd * weight.data
+        m = self.beta1 * mean.data + (1 - self.beta1) * g
+        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
+        mean._set_data(m)
+        var._set_data(v)
+        coef1 = 1 - self.beta1 ** t
+        coef2 = 1 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+
+
+@Optimizer.register
+class AdaGrad(Optimizer):
+    """AdaGrad (`optimizer.py` AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess(grad.data)
+        hist = state.data + jnp.square(g)
+        state._set_data(hist)
+        weight._set_data(
+            weight.data
+            - lr * (g / jnp.sqrt(hist + self.float_stable_eps) + wd * weight.data)
+        )
+
+
+@Optimizer.register
+class RMSProp(Optimizer):
+    """RMSProp (`optimizer.py` RMSProp; Tieleman & Hinton variant with
+    gradient-mean subtraction, as in the reference)."""
+
+    def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        n, gbar, delta = state
+        g = self._preprocess(grad.data) + wd * weight.data
+        n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
+        g_new = (1 - self.gamma1) * g + self.gamma1 * gbar.data
+        d_new = self.gamma2 * delta.data - lr * (
+            g / jnp.sqrt(n_new - jnp.square(g_new) + 1e-4)
+        )
+        n._set_data(n_new)
+        gbar._set_data(g_new)
+        delta._set_data(d_new)
+        weight._set_data(weight.data + d_new)
+
+
+@Optimizer.register
+class AdaDelta(Optimizer):
+    """AdaDelta (`optimizer.py` AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess(grad.data)
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g.data + (1 - self.rho) * jnp.square(g)
+        current_delta = (
+            jnp.sqrt(acc_delta.data + self.epsilon)
+            / jnp.sqrt(ag + self.epsilon)
+        ) * g
+        ad = self.rho * acc_delta.data + (1 - self.rho) * jnp.square(current_delta)
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+        weight._set_data(weight.data - current_delta - wd * weight.data)
+
+
+@Optimizer.register
+class Test(Optimizer):
+    """Test optimizer (`optimizer.py:737`): w += rescale_grad * grad.
+    Used by the distributed closed-form oracle
+    (`tests/nightly/dist_sync_kvstore.py:30-46`)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight.data + grad.data * self.rescale_grad)
+        state._set_data(weight.data)
+
+
+create = Optimizer.create_optimizer
+
+
+def get_updater(optimizer):
+    """Closure for KVStore updaters (`optimizer.py:755`): lazily creates
+    per-key state, then applies `optimizer.update`."""
+    states = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+
+    updater.optimizer = optimizer
+    updater.states = states
+    return updater

@@ -1,0 +1,10 @@
+"""Parallel training over device meshes.
+
+This package is the TPU-native replacement for the reference's parallelism
+stack (SURVEY §2.5/§5.8): KVStore device reduce → XLA collectives over ICI;
+ps-lite BSP → SPMD pjit over a `jax.sharding.Mesh`; ctx_group model
+parallelism → sharding annotations; plus TPU-era capabilities the reference
+lacked (sequence/context parallelism via ring attention).
+"""
+from .mesh import MeshContext, get_mesh, make_mesh, data_parallel_sharding
+from .trainer import SPMDTrainer

@@ -1,0 +1,237 @@
+"""Multi-process distributed KVStore backend.
+
+Reference: `src/kvstore/kvstore_dist.h` + `kvstore_dist_server.h` over
+ps-lite (SURVEY §2.10).  This module provides the same worker-facing
+semantics (BSP `dist_sync` accumulate-then-apply, `dist_async` per-push
+apply) over a plain TCP parameter server in the standard library — the role
+wiring uses the reference's `DMLC_*` env contract
+(`include/mxnet/kvstore.h:157-206`) set by `tools/launch.py`.
+
+For SPMD multi-chip jobs the idiomatic path is `parallel.SPMDTrainer` (XLA
+collectives over ICI/DCN); this server exists for API/test parity with the
+reference's multi-process nightly tests (`tests/nightly/dist_sync_kvstore.py`).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..kvstore import KVStore
+from ..ndarray import NDArray, array
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    head = b""
+    while len(head) < 8:
+        chunk = sock.recv(8 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (n,) = struct.unpack("<Q", head)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class ParameterServer:
+    """Server process body (`kvstore_dist_server.h`): single-threaded apply
+    loop (updaters may be Python), sync-mode accumulate until all workers
+    pushed, then update + reply (BSP)."""
+
+    def __init__(self, host, port, num_workers):
+        self.num_workers = num_workers
+        self.store = {}
+        self.updater = None
+        self.sync_mode = True
+        self._accum = {}
+        self._accum_count = {}
+        self._waiting = {}
+        self._lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_waiters = []
+        self._stop = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(num_workers * 2)
+
+    def run(self):
+        threads = []
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=1)
+
+    def _apply_update(self, key, merged):
+        stored = self.store[key]
+        if self.updater is not None:
+            self.updater(key, merged, stored)
+        else:
+            stored += merged
+
+    def _serve(self, conn):
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                conn.close()
+                return
+            op = msg["op"]
+            if op == "init":
+                with self._lock:
+                    if msg["key"] not in self.store:
+                        self.store[msg["key"]] = np.array(msg["value"])
+                _send_msg(conn, {"ok": True})
+            elif op == "push":
+                key, val = msg["key"], np.asarray(msg["value"])
+                done = threading.Event()
+                with self._lock:
+                    if not self.sync_mode:
+                        self._apply_update(key, val)
+                        done.set()
+                    else:
+                        self._accum[key] = self._accum.get(key, 0) + val
+                        self._accum_count[key] = self._accum_count.get(key, 0) + 1
+                        self._waiting.setdefault(key, []).append(done)
+                        if self._accum_count[key] == self.num_workers:
+                            self._apply_update(key, self._accum[key])
+                            for ev in self._waiting[key]:
+                                ev.set()
+                            del self._accum[key]
+                            self._accum_count[key] = 0
+                            self._waiting[key] = []
+                done.wait()
+                _send_msg(conn, {"ok": True})
+            elif op == "pull":
+                with self._lock:
+                    val = np.array(self.store[msg["key"]])
+                _send_msg(conn, {"value": val})
+            elif op == "barrier":
+                ev = threading.Event()
+                with self._lock:
+                    self._barrier_waiters.append(ev)
+                    if len(self._barrier_waiters) == self.num_workers:
+                        for w in self._barrier_waiters:
+                            w.set()
+                        self._barrier_waiters = []
+                ev.wait()
+                _send_msg(conn, {"ok": True})
+            elif op == "set_optimizer":
+                from ..optimizer import get_updater
+
+                opt = pickle.loads(msg["optimizer"])
+
+                def np_updater(key, grad, weight,
+                               _u=get_updater(opt)):
+                    g, w = array(grad), array(weight)
+                    _u(key, g, w)
+                    weight[...] = w.asnumpy()
+
+                with self._lock:
+                    self.updater = np_updater
+                _send_msg(conn, {"ok": True})
+            elif op == "set_sync":
+                with self._lock:
+                    self.sync_mode = msg["sync"]
+                _send_msg(conn, {"ok": True})
+            elif op == "stop":
+                _send_msg(conn, {"ok": True})
+                self._stop = True
+                self._sock.close()
+                conn.close()
+                return
+
+
+class DistKVStore(KVStore):
+    """Worker-side distributed store (`kvstore_dist.h`): local merge then
+    push/pull to the server; rank 0 inits (`kvstore_dist.h:49-60`)."""
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._addr = (uri, port)
+        self._sock = socket.create_connection(self._addr, timeout=120)
+        self._sock_lock = threading.Lock()
+        if "async" in kv_type:
+            self._rpc({"op": "set_sync", "sync": False})
+
+    def _rpc(self, msg):
+        with self._sock_lock:
+            _send_msg(self._sock, msg)
+            return _recv_msg(self._sock)
+
+    def init(self, key, value):
+        keys, _ = self._keylist(key)
+        vals = self._vallist(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if self.rank == 0:
+                self._rpc({"op": "init", "key": k,
+                           "value": vlist[0].asnumpy()})
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, _ = self._keylist(key)
+        vals = self._vallist(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            merged = np.asarray(self._merge(vlist))
+            self._rpc({"op": "push", "key": k, "value": merged})
+
+    def pull(self, key, out=None, priority=0):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, _ = self._keylist(key)
+        if isinstance(out, NDArray):
+            outs = [[out]]
+        elif out and isinstance(out[0], NDArray) and len(keys) == 1:
+            outs = [list(out)]
+        else:
+            outs = [[o] if isinstance(o, NDArray) else list(o) for o in out]
+        for k, olist in zip(keys, outs):
+            val = self._rpc({"op": "pull", "key": k})["value"]
+            src = array(val)
+            for o in olist:
+                src.copyto(o)
+
+    def set_optimizer(self, optimizer):
+        if self.rank == 0:
+            self._rpc({"op": "set_optimizer",
+                       "optimizer": pickle.dumps(optimizer)})
+        self.barrier()
+
+    def barrier(self):
+        self._rpc({"op": "barrier"})
+
+    def stop_server(self):
+        if self.rank == 0:
+            self._rpc({"op": "stop"})
+
+
+def run_server():
+    """Server-process entry (`python/mxnet/kvstore_server.py:47-68`): called
+    when DMLC_ROLE=server; blocks until kStopServer."""
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    server = ParameterServer(uri, port, num_workers)
+    server.run()

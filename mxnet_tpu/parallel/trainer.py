@@ -1,0 +1,166 @@
+"""SPMD fused trainer: the idiomatic TPU training path.
+
+Where `DataParallelExecutorManager` mirrors the reference architecture
+(per-device executors + kvstore reduce, `executor_manager.py:180-262` +
+`kvstore_local.h`), this trainer is the TPU-native form of the same
+computation: ONE jitted step over a `Mesh`, batch sharded on the "data" axis,
+parameters replicated (or sharded on "model" for tensor parallelism), XLA
+inserting the gradient all-reduce over ICI — the SPMD equivalent of
+`kvstore='device'` push/pull with perfect comm/compute overlap (the XLA
+latency-hiding scheduler replaces the reference's priority-queue trick,
+`model.py:96-98`).
+
+Forward+backward+optimizer-update fuse into a single XLA program with donated
+buffers, so per-step HBM traffic is minimal — this is the bench.py path.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..executor import _build_graph_fn
+from ..ndarray import NDArray
+from .. import random as _random
+
+
+def _sgd_update(params, grads, momenta, lr, momentum, wd, rescale):
+    new_p, new_m = {}, {}
+    for k, p in params.items():
+        g = grads[k] * rescale + wd * p
+        if momentum:
+            m = momentum * momenta[k] - lr * g
+            new_m[k] = m
+            new_p[k] = p + m
+        else:
+            new_m[k] = momenta[k]
+            new_p[k] = p - lr * g
+    return new_p, new_m
+
+
+class SPMDTrainer:
+    """One-program data-parallel trainer for a Symbol graph.
+
+    Parameters
+    ----------
+    symbol : Symbol whose outputs are loss heads (SoftmaxOutput etc.).
+    mesh : jax.sharding.Mesh with a "data" axis (make_mesh()).
+    data_shapes : dict name -> global batch shape (like simple_bind kwargs).
+    optimizer : 'sgd' params via lr/momentum/wd (fused); other optimizers
+        can be applied per-step on host via apply_host_optimizer.
+    """
+
+    def __init__(self, symbol, mesh, data_shapes, initializer=None, lr=0.01,
+                 momentum=0.9, wd=0.0001, dtype=np.float32,
+                 param_sharding=None):
+        self.symbol = symbol
+        self.mesh = mesh
+        self.lr, self.momentum, self.wd = lr, momentum, wd
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**data_shapes)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes from %s" % (data_shapes,))
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.data_names = [n for n in self.arg_names if n in data_shapes]
+        self.param_names = [n for n in self.arg_names if n not in data_shapes]
+        shape_of = dict(zip(self.arg_names, arg_shapes))
+
+        # init params on host (reference initializer protocol), then place
+        # replicated over the mesh (or a custom per-param sharding for TP)
+        from ..initializer import Uniform
+        from ..ndarray import zeros
+
+        initializer = initializer or Uniform(0.07)
+        repl = NamedSharding(mesh, P())
+        self._param_sharding = {}
+        params = {}
+        for n in self.param_names:
+            host = zeros(shape_of[n], dtype=dtype)
+            initializer(n, host)
+            sh = (param_sharding or {}).get(n, repl)
+            self._param_sharding[n] = sh
+            params[n] = jax.device_put(host.data, sh)
+        self.params = params
+        self.momenta = {
+            n: jax.device_put(jnp.zeros_like(v), self._param_sharding[n])
+            for n, v in params.items()
+        }
+        self.aux = {
+            n: jax.device_put(jnp.zeros(s, dtype=dtype), repl)
+            for n, s in zip(self.aux_names, aux_shapes)
+        }
+        for n in self.aux_names:  # aux init: means 0, vars 1
+            if n.endswith("moving_var"):
+                self.aux[n] = jax.device_put(
+                    jnp.ones_like(self.aux[n]), repl)
+
+        graph_fn, _, _ = _build_graph_fn(symbol)
+        batch_sharding = NamedSharding(mesh, P("data"))
+        self._batch_sharding = batch_sharding
+        self._base_key = _random.next_key()
+        global_batch = shape_of[self.data_names[0]][0]
+        rescale = 1.0 / global_batch
+
+        def step(params, momenta, aux, batch, rng):
+            def f(p):
+                args = [
+                    batch[n] if n in batch else p[n] for n in self.arg_names
+                ]
+                aux_list = [aux[n] for n in self.aux_names]
+                outs, new_aux = graph_fn(args, aux_list, rng, True)
+                return outs, new_aux
+
+            outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
+            cot = tuple(jnp.ones_like(o) for o in outs)
+            (grads,) = vjp(cot)
+            new_params, new_momenta = _sgd_update(
+                params, grads, momenta, self.lr, self.momentum, self.wd,
+                rescale,
+            )
+            aux_out = dict(zip(self.aux_names, new_aux))
+            return new_params, new_momenta, aux_out, outs
+
+        self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+        def fwd(params, aux, batch, rng):
+            args = [batch[n] if n in batch else params[n]
+                    for n in self.arg_names]
+            outs, _ = graph_fn(args, [aux[n] for n in self.aux_names], rng,
+                               False)
+            return outs
+
+        self._fwd = jax.jit(fwd)
+        self._nstep = 0
+
+    def shard_batch(self, batch):
+        """Host numpy/NDArray dict -> device arrays laid out over the data
+        axis (the SPMD replacement for per-GPU slice copies)."""
+        out = {}
+        for n, v in batch.items():
+            arr = v.data if isinstance(v, NDArray) else jnp.asarray(v)
+            out[n] = jax.device_put(arr, self._batch_sharding)
+        return out
+
+    def step(self, batch):
+        """One fused train step.  Returns the graph outputs."""
+        self._nstep += 1
+        rng = jax.random.fold_in(self._base_key, self._nstep)
+        self.params, self.momenta, self.aux, outs = self._step(
+            self.params, self.momenta, self.aux, self.shard_batch(batch), rng
+        )
+        return outs
+
+    def forward(self, batch):
+        rng = jax.random.fold_in(self._base_key, 0)
+        return self._fwd(self.params, self.aux, self.shard_batch(batch), rng)
+
+    def get_params(self):
+        """Host NDArray dicts (checkpoint path)."""
+        arg = {n: NDArray(np.asarray(v)) for n, v in self.params.items()}
+        aux = {n: NDArray(np.asarray(v)) for n, v in self.aux.items()}
+        return arg, aux

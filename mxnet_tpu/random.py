@@ -1,0 +1,62 @@
+"""Random number handling (reference `python/mxnet/random.py`,
+`src/resource.cc` per-device PRNG).
+
+TPU-first: randomness is functional.  A process-global root key (set by
+`mx.random.seed`) hands out subkeys; executors fork their own streams.  This
+replaces the reference's per-device stateful `mshadow::Random<xpu>` while
+keeping the user API (`seed`, `uniform`, `normal`).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _root():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state: int):
+    """Seed all generators (`mx.random.seed`).  Like the reference, this
+    reseeds both imperative sampling and operator RNG (dropout/rrelu)."""
+    global _DEFAULT_SEED
+    _DEFAULT_SEED = int(seed_state)
+    _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    np.random.seed(_DEFAULT_SEED & 0x7FFFFFFF)
+
+
+def next_key():
+    """Split off a fresh subkey from the global stream."""
+    key = _root()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, dtype=np.float32):
+    """Draw from U[low, high) into a new NDArray (`mx.nd.uniform`)."""
+    from .base import check_shape, np_dtype
+    from .ndarray import NDArray
+
+    arr = jax.random.uniform(
+        next_key(), check_shape(shape), np_dtype(dtype).name, low, high
+    )
+    return NDArray(arr, ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, dtype=np.float32):
+    """Draw from N(loc, scale^2) (`mx.nd.normal`)."""
+    from .base import check_shape, np_dtype
+    from .ndarray import NDArray
+
+    arr = loc + scale * jax.random.normal(
+        next_key(), check_shape(shape), np_dtype(dtype).name
+    )
+    return NDArray(arr, ctx=ctx)

@@ -1,0 +1,165 @@
+"""RecordIO: sequence-of-records container (reference
+`python/mxnet/recordio.py`; C++ reader/writer came from dmlc-core).
+
+On-disk format matches dmlc recordio so packs interoperate with reference
+tooling (`tools/im2rec.py`): each record is
+
+    u32 magic (0xced7230a) | u32 lrec | data | pad to 4B
+
+where lrec's upper 3 bits are a continuation flag (unused here: we write
+single-part records) and lower 29 bits the length.  Image records prepend the
+`IRHeader` struct 'IfQQ' (flag, label, id, id2) exactly like the reference
+(`recordio.py:100-115`).
+
+The C++ fast-path reader for training pipelines lives in `native/`; this
+module is the always-available implementation and the format authority.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_LREC_MASK = (1 << 29) - 1
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IRFormat = "IfQQ"
+_IRSize = struct.calcsize(_IRFormat)
+
+
+def pack(header, s):
+    """Prepend an IRHeader to a byte string (`recordio.py:104`)."""
+    header = IRHeader(*header)
+    return struct.pack(_IRFormat, *header) + s
+
+
+def unpack(s):
+    """Split a record into (IRHeader, payload) (`recordio.py` unpack)."""
+    header = IRHeader(*struct.unpack(_IRFormat, s[:_IRSize]))
+    return header, s[_IRSize:]
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record holding an encoded or raw image.  Without OpenCV in
+    the image, accepts raw `.npy`-encoded payloads written by `pack_img`."""
+    header, s = unpack(s)
+    import io as _io
+
+    arr = np.load(_io.BytesIO(s), allow_pickle=False)
+    return header, arr
+
+
+def pack_img(header, img, quality=95, img_fmt=".npy"):
+    """Pack an image array (raw npy payload; JPEG needs OpenCV which the
+    image lacks — the C++ loader handles JPEG when built with libjpeg)."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.save(buf, np.asarray(img), allow_pickle=False)
+    return pack(header, buf.getvalue())
+
+
+class MXRecordIO:
+    """Sequential reader/writer (`recordio.py` MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %r" % self.flag)
+
+    def close(self):
+        if self.handle:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        self.handle.write(struct.pack("<II", _MAGIC, len(buf) & _LREC_MASK))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic at offset %d" % (self.tell() - 8))
+        length = lrec & _LREC_MASK
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed random-access variant (`recordio.py` MXIndexedRecordIO):
+    sidecar .idx file of `key\\toffset` lines."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as fin:
+                for line in fin:
+                    key, off = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(off)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)

@@ -1,0 +1,108 @@
+"""Network visualization (reference `python/mxnet/visualization.py`):
+`print_summary` (text table) and `plot_network` (graphviz dot source; emitted
+as a string so no graphviz binary is required)."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .base import MXNetError
+from .symbol import Symbol
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Layer-by-layer summary with params count (`visualization.py`
+    print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {x[0] for x in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    lines = []
+
+    def print_row(f, pos):
+        line = ""
+        for i, x in enumerate(f):
+            line += str(x)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        lines.append(line)
+
+    lines.append("=" * line_length)
+    print_row(fields, positions)
+    lines.append("=" * line_length)
+
+    total_params = 0
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            key = node["name"] + "_output" if op != "null" else node["name"]
+            if show_shape:
+                for k, v in shape_dict.items():
+                    if k.startswith(node["name"]):
+                        out_shape = list(v)
+                        break
+        cur_param = 0
+        if show_shape:
+            for in_idx, _ in [(x[0], x[1]) for x in node["inputs"]]:
+                in_node = nodes[in_idx]
+                if in_node["op"] == "null" and in_node["name"] != "data" and \
+                        not in_node["name"].endswith(("label",)):
+                    for k, v in shape_dict.items():
+                        if k == in_node["name"]:
+                            cur_param += int(np.prod(v))
+        first_connection = ""
+        if node["inputs"]:
+            first_connection = nodes[node["inputs"][0][0]]["name"]
+        print_row(
+            ["%s(%s)" % (node["name"], op), out_shape, cur_param, first_connection],
+            positions,
+        )
+        total_params += cur_param
+    lines.append("=" * line_length)
+    lines.append("Total params: %d" % total_params)
+    lines.append("=" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", shape=None, node_attrs=None):
+    """Emit graphviz dot source for the network (`visualization.py`
+    plot_network; returns the dot string instead of a pydot object)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    lines = ["digraph %s {" % title.replace(" ", "_")]
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            shape_str = "ellipse"
+            label = name
+        else:
+            shape_str = "box"
+            label = "%s\\n%s" % (op, name)
+        lines.append('  n%d [label="%s", shape=%s];' % (i, label, shape_str))
+    for i, node in enumerate(nodes):
+        for inp in node["inputs"]:
+            lines.append("  n%d -> n%d;" % (inp[0], i))
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device CPU platform BEFORE jax imports.
+
+This is the TPU build's version of the reference's hardware fakes (SURVEY §4):
+multi-device logic (DP executor groups, mesh sharding, model parallelism)
+runs on 8 virtual CPU devices, the same way the reference tested
+model-parallel code on cpu(0)/cpu(1).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,112 @@
+"""Port of `tests/python/unittest/test_executor.py`: bind/forward/backward,
+grad_req semantics, aux updates, monitor."""
+import numpy as np
+
+import mxnet_tpu as mx
+from common import reldiff
+
+
+def test_bind_forward_backward():
+    np.random.seed(0)
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b + a
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(3, 4).astype(np.float32)
+    args = {"a": mx.nd.array(a_np), "b": mx.nd.array(b_np)}
+    grads = {"a": mx.nd.zeros((3, 4)), "b": mx.nd.zeros((3, 4))}
+    exe = c.bind(mx.cpu(), args, grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, a_np * b_np + a_np, rtol=1e-5)
+    exe.backward([mx.nd.ones((3, 4))])
+    np.testing.assert_allclose(grads["a"].asnumpy(), b_np + 1, rtol=1e-5)
+    np.testing.assert_allclose(grads["b"].asnumpy(), a_np, rtol=1e-5)
+
+
+def test_grad_req_add():
+    a = mx.sym.Variable("a")
+    c = a * 2.0
+    args = {"a": mx.nd.ones((2, 2))}
+    grads = {"a": mx.nd.zeros((2, 2))}
+    exe = c.bind(mx.cpu(), args, grads, grad_req="add")
+    for _ in range(3):
+        exe.forward(is_train=True)
+        exe.backward([mx.nd.ones((2, 2))])
+    assert (grads["a"].asnumpy() == 6).all()
+
+
+def test_grad_req_null():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b
+    args = {"a": mx.nd.ones((2,)), "b": mx.nd.ones((2,))}
+    grads = {"a": mx.nd.zeros((2,)), "b": mx.nd.zeros((2,))}
+    exe = c.bind(mx.cpu(), args, grads, grad_req={"a": "write", "b": "null"})
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((2,))])
+    assert (grads["a"].asnumpy() == 1).all()
+    assert (grads["b"].asnumpy() == 0).all()
+
+
+def test_forward_kwargs_update():
+    a = mx.sym.Variable("a")
+    exe = (a * 3.0).simple_bind(mx.cpu(), a=(2, 2))
+    out1 = exe.forward(a=mx.nd.ones((2, 2)))[0].asnumpy()
+    assert (out1 == 3).all()
+    out2 = exe.forward(a=np.full((2, 2), 2.0, np.float32))[0].asnumpy()
+    assert (out2 == 6).all()
+
+
+def test_batchnorm_aux_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn", momentum=0.5)
+    exe = bn.simple_bind(mx.cpu(), data=(8, 3))
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    np.random.seed(0)
+    x = (np.random.randn(8, 3) * 2 + 5).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    # moving_mean moved halfway toward batch mean (momentum 0.5)
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-3)
+    # eval mode uses moving stats, doesn't update them
+    exe.forward(is_train=False)
+    np.testing.assert_allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), mm,
+                               rtol=1e-6)
+
+
+def test_copy_params_from():
+    a = mx.sym.Variable("a")
+    fc = mx.sym.FullyConnected(data=a, num_hidden=2, name="fc")
+    exe = fc.simple_bind(mx.cpu(), a=(1, 2))
+    w = mx.nd.array(np.arange(4).reshape(2, 2).astype(np.float32))
+    exe.copy_params_from({"fc_weight": w}, allow_extra_params=True)
+    np.testing.assert_allclose(exe.arg_dict["fc_weight"].asnumpy(),
+                               w.asnumpy())
+
+
+def test_monitor_callback():
+    a = mx.sym.Variable("a")
+    fc = mx.sym.FullyConnected(data=a, num_hidden=2, name="fc")
+    exe = fc.simple_bind(mx.cpu(), a=(1, 2))
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward()
+    assert "fc_output" in seen
+
+
+def test_outputs_async_handles():
+    a = mx.sym.Variable("a")
+    exe = (a + 1.0).simple_bind(mx.cpu(), a=(2,))
+    exe.forward(a=mx.nd.ones((2,)))
+    outs = exe.outputs
+    assert (outs[0].asnumpy() == 2).all()
+
+
+def test_reshape_executor():
+    a = mx.sym.Variable("a")
+    fc = mx.sym.FullyConnected(data=a, num_hidden=3, name="fc")
+    exe = fc.simple_bind(mx.cpu(), a=(4, 5))
+    exe2 = exe.reshape(a=(8, 5))
+    assert exe2.arg_dict["a"].shape == (8, 5)
+    assert exe2.arg_dict["fc_weight"].shape == (3, 5)

@@ -1,0 +1,79 @@
+"""Port of `tests/python/unittest/test_infer_shape.py`."""
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_mlp_infer():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    fc2 = mx.sym.FullyConnected(data=fc1, name="fc2", num_hidden=10)
+    out = mx.sym.SoftmaxOutput(data=fc2, name="sm")
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    assert out_shapes[0] == (100, 10)
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["fc2_weight"] == (10, 1000)
+    assert d["sm_label"] == (100,)
+
+
+def test_incomplete_returns_none():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=10)
+    arg, out, aux = fc.infer_shape()
+    assert arg is None and out is None
+
+
+def test_partial():
+    data = mx.sym.Variable("data")
+    prev = mx.sym.Variable("prev")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    fc2 = mx.sym.FullyConnected(data=prev, name="fc2", num_hidden=128)
+    out = fc1 + fc2
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(data=(10, 64))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (128, 64)
+    assert d["fc2_weight"] is None
+    # full inference fails without prev
+    assert out.infer_shape(data=(10, 64))[0] is None
+
+
+def test_conv_chain_shapes():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                              pad=(1, 1), name="conv")
+    pool = mx.sym.Pooling(data=conv, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="pool")
+    flat = mx.sym.Flatten(data=pool)
+    fc = mx.sym.FullyConnected(data=flat, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert out_shapes[0] == (2, 10)
+    # ceil-mode pooling formula (reference pooling-inl.h:191-197)
+    p2 = mx.sym.Pooling(data=mx.sym.Variable("x"), kernel=(2, 2),
+                        stride=(2, 2), pool_type="max")
+    _, out_shapes, _ = p2.infer_shape(x=(1, 1, 5, 5))
+    assert out_shapes[0] == (1, 1, 3, 3)
+
+
+def test_batchnorm_shapes():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(4, 3, 2, 2))
+    d = dict(zip(bn.list_arguments(), arg_shapes))
+    assert d["bn_gamma"] == (3,)
+    assert d["bn_beta"] == (3,)
+    assert aux_shapes == [(3,), (3,)]
+    assert out_shapes[0] == (4, 3, 2, 2)
+
+
+def test_infer_type():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4)
+    arg_types, out_types, _ = fc.infer_type(data="float32")
+    import numpy as np
+
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types[0] == np.float32

@@ -1,0 +1,140 @@
+"""Port of `tests/python/unittest/test_ndarray.py`: imperative API,
+views/aliasing, serialization."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 2), dtype=np.float32)
+    assert (b.asnumpy() == 1).all()
+    c = mx.nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    assert (d.asnumpy() == [[1, 2], [3, 4]]).all()
+
+
+def test_elementwise():
+    np.random.seed(0)
+    a_np = np.random.randn(4, 5).astype(np.float32)
+    b_np = np.random.randn(4, 5).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    np.testing.assert_allclose((a + b).asnumpy(), a_np + b_np, rtol=1e-5)
+    np.testing.assert_allclose((a - b).asnumpy(), a_np - b_np, rtol=1e-5)
+    np.testing.assert_allclose((a * b).asnumpy(), a_np * b_np, rtol=1e-5)
+    np.testing.assert_allclose((a / b).asnumpy(), a_np / b_np, rtol=1e-4)
+    np.testing.assert_allclose((a + 2).asnumpy(), a_np + 2, rtol=1e-5)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - a_np, rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), -a_np, rtol=1e-5)
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 3))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a /= 2
+    assert (a.asnumpy() == 3).all()
+
+
+def test_setitem_and_views():
+    a = mx.nd.zeros((4, 3))
+    a[:] = 1.0
+    assert (a.asnumpy() == 1).all()
+    a[1:3] = 5.0
+    out = a.asnumpy()
+    assert (out[1:3] == 5).all() and (out[0] == 1).all() and (out[3] == 1).all()
+    # slice views write through to the parent (reference zero-copy Slice)
+    s = a.slice(0, 2)
+    s[:] = 9.0
+    assert (a.asnumpy()[:2] == 9).all()
+    # views observe parent writes
+    a[:] = 0.5
+    assert (s.asnumpy() == 0.5).all()
+
+
+def test_copyto_and_context():
+    a = mx.nd.array(np.arange(6).reshape(2, 3))
+    b = mx.nd.zeros((2, 3))
+    a.copyto(b)
+    assert (b.asnumpy() == a.asnumpy()).all()
+    c = a.as_in_context(mx.cpu(1))
+    assert c.context == mx.cpu(1)
+    assert (c.asnumpy() == a.asnumpy()).all()
+
+
+def test_registry_functions():
+    a_np = np.random.rand(3, 3).astype(np.float32) + 0.5
+    a = mx.nd.array(a_np)
+    np.testing.assert_allclose(mx.nd.sqrt(a).asnumpy(), np.sqrt(a_np), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.exp(a).asnumpy(), np.exp(a_np), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.square(a).asnumpy(), a_np ** 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.clip(a, a_min=0.6, a_max=1.0).asnumpy(),
+        np.clip(a_np, 0.6, 1.0), rtol=1e-6)
+    b_np = np.random.rand(3, 4).astype(np.float32)
+    b = mx.nd.array(b_np)
+    np.testing.assert_allclose(mx.nd.dot(a, b).asnumpy(),
+                               a_np.dot(b_np), rtol=1e-4)
+    np.testing.assert_allclose(mx.nd.sum(a).asnumpy(),
+                               [a_np.sum()], rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.norm(a).asnumpy(),
+                               [np.sqrt((a_np ** 2).sum())], rtol=1e-5)
+
+
+def test_out_kwarg():
+    a = mx.nd.array(np.ones((2, 2), np.float32) * 4)
+    out = mx.nd.zeros((2, 2))
+    r = mx.nd.sqrt(a, out=out)
+    assert r is out
+    assert (out.asnumpy() == 2).all()
+
+
+def test_onehot():
+    idx = mx.nd.array([0, 2, 1])
+    out = mx.nd.zeros((3, 3))
+    mx.nd.onehot_encode(idx, out)
+    np.testing.assert_allclose(out.asnumpy(), np.eye(3)[[0, 2, 1]])
+
+
+def test_serialization_roundtrip(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    arrays = [mx.nd.array(np.random.randn(3, 4).astype(np.float32)),
+              mx.nd.array(np.arange(5, dtype=np.float32))]
+    mx.nd.save(fname, arrays)
+    loaded = mx.nd.load(fname)
+    assert len(loaded) == 2
+    for a, b in zip(arrays, loaded):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    # dict form with names
+    d = {"w": arrays[0], "b": arrays[1]}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), arrays[0].asnumpy())
+
+
+def test_dtype_preserved_in_save(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    a = mx.nd.array(np.arange(4), dtype=np.int32)
+    mx.nd.save(fname, [a])
+    (b,) = mx.nd.load(fname)
+    assert b.dtype == np.int32
+
+
+def test_waitall_and_sync():
+    a = mx.nd.ones((64, 64))
+    for _ in range(10):
+        a = a * 1.0 + 0.0
+    a.wait_to_read()
+    mx.nd.waitall()
+    assert (a.asnumpy() == 1).all()

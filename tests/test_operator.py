@@ -1,0 +1,348 @@
+"""Port of `tests/python/unittest/test_operator.py` (873 LoC in the
+reference): per-op forward vs numpy, backward vs finite differences."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from common import check_numeric_gradient, reldiff
+
+
+def _fwd(sym, location, aux=None):
+    args = {k: mx.nd.array(v) for k, v in location.items()}
+    aux_list = None
+    if aux is not None:
+        aux_list = [mx.nd.array(aux[n]) for n in sym.list_auxiliary_states()]
+    exe = sym.bind(mx.cpu(), args, None, "null", aux_list)
+    return [o.asnumpy() for o in exe.forward(is_train=False)]
+
+
+def test_elementwise_sum():
+    np.random.seed(0)
+    n = 4
+    xs = [mx.sym.Variable("x%d" % i) for i in range(n)]
+    s = mx.sym.ElementWiseSum(*xs, name="esum")
+    arrs = {("x%d" % i): np.random.randn(3, 4).astype(np.float32)
+            for i in range(n)}
+    out = _fwd(s, arrs)[0]
+    np.testing.assert_allclose(out, sum(arrs.values()), rtol=1e-5)
+    check_numeric_gradient(s, arrs)
+
+
+def test_fully_connected():
+    np.random.seed(0)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    loc = {
+        "data": np.random.randn(5, 10).astype(np.float32),
+        "fc_weight": np.random.randn(4, 10).astype(np.float32),
+        "fc_bias": np.random.randn(4).astype(np.float32),
+    }
+    out = _fwd(fc, loc)[0]
+    expected = loc["data"].dot(loc["fc_weight"].T) + loc["fc_bias"]
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+    check_numeric_gradient(fc, loc)
+
+
+def test_activations():
+    np.random.seed(0)
+    x = np.random.randn(4, 5).astype(np.float32)
+    for act, fn in [
+        ("relu", lambda v: np.maximum(v, 0)),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("tanh", np.tanh),
+        ("softrelu", lambda v: np.log1p(np.exp(v))),
+    ]:
+        sym = mx.sym.Activation(data=mx.sym.Variable("data"), act_type=act)
+        out = _fwd(sym, {"data": x})[0]
+        np.testing.assert_allclose(out, fn(x), rtol=1e-4, atol=1e-5)
+        if act != "relu":  # relu kink breaks finite differences at 0
+            check_numeric_gradient(sym, {"data": x})
+
+
+def test_leaky_relu_variants():
+    np.random.seed(0)
+    x = np.random.randn(4, 3).astype(np.float32) + 0.1
+    leaky = mx.sym.LeakyReLU(data=mx.sym.Variable("data"),
+                             act_type="leaky", slope=0.1)
+    out = _fwd(leaky, {"data": x})[0]
+    np.testing.assert_allclose(out, np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    prelu = mx.sym.LeakyReLU(data=mx.sym.Variable("data"), act_type="prelu",
+                             name="pr")
+    loc = {"data": x.reshape(4, 3),
+           "pr_gamma": np.array([0.1, 0.2, 0.3], np.float32)}
+    out = _fwd(prelu, loc)[0]
+    expected = np.where(x > 0, x, x * np.array([0.1, 0.2, 0.3]))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_convolution_forward():
+    np.random.seed(0)
+    data = np.random.randn(2, 3, 7, 7).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    conv = mx.sym.Convolution(data=mx.sym.Variable("data"), num_filter=4,
+                              kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              name="conv")
+    out = _fwd(conv, {"data": data, "conv_weight": w, "conv_bias": b})[0]
+    assert out.shape == (2, 4, 4, 4)
+    # spot-check one output element against direct correlation
+    padded = np.pad(data, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = (padded[0, :, 0:3, 0:3] * w[1]).sum() + b[1]
+    np.testing.assert_allclose(out[0, 1, 0, 0], expect, rtol=1e-3)
+
+
+def test_convolution_gradient():
+    np.random.seed(0)
+    conv = mx.sym.Convolution(data=mx.sym.Variable("data"), num_filter=2,
+                              kernel=(2, 2), name="conv", no_bias=True)
+    loc = {
+        "data": np.random.randn(1, 2, 4, 4).astype(np.float32),
+        "conv_weight": np.random.randn(2, 2, 2, 2).astype(np.float32),
+    }
+    check_numeric_gradient(conv, loc, rtol=2e-2)
+
+
+def test_deconvolution_shape_inverts_conv():
+    data = mx.sym.Variable("data")
+    deconv = mx.sym.Deconvolution(data=data, num_filter=3, kernel=(4, 4),
+                                  stride=(2, 2), pad=(1, 1), name="dc")
+    _, out_shapes, _ = deconv.infer_shape(data=(1, 5, 8, 8))
+    assert out_shapes[0] == (1, 3, 16, 16)
+    np.random.seed(0)
+    loc = {"data": np.random.randn(1, 2, 3, 3).astype(np.float32),
+           "dc2_weight": np.random.randn(2, 2, 2, 2).astype(np.float32)}
+    deconv2 = mx.sym.Deconvolution(data=data, num_filter=2, kernel=(2, 2),
+                                   name="dc2")
+    check_numeric_gradient(deconv2, loc, rtol=2e-2)
+
+
+def test_pooling():
+    np.random.seed(0)
+    x = np.random.randn(1, 1, 4, 4).astype(np.float32)
+    mp = mx.sym.Pooling(data=mx.sym.Variable("data"), kernel=(2, 2),
+                        stride=(2, 2), pool_type="max")
+    out = _fwd(mp, {"data": x})[0]
+    expect = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    ap = mx.sym.Pooling(data=mx.sym.Variable("data"), kernel=(2, 2),
+                        stride=(2, 2), pool_type="avg")
+    out = _fwd(ap, {"data": x})[0]
+    expect = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    check_numeric_gradient(ap, {"data": x})
+
+
+def test_global_pooling():
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    gp = mx.sym.Pooling(data=mx.sym.Variable("data"), kernel=(1, 1),
+                        global_pool=True, pool_type="avg")
+    out = _fwd(gp, {"data": x})[0]
+    np.testing.assert_allclose(out[..., 0, 0], x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_batchnorm_forward_train():
+    np.random.seed(0)
+    x = (np.random.randn(8, 3) * 3 + 2).astype(np.float32)
+    bn = mx.sym.BatchNorm(data=mx.sym.Variable("data"), name="bn",
+                          fix_gamma=False, eps=1e-3)
+    loc = {"data": x, "bn_gamma": np.array([1.0, 2.0, 0.5], np.float32),
+           "bn_beta": np.array([0.0, 1.0, -1.0], np.float32)}
+    aux = {"bn_moving_mean": np.zeros(3, np.float32),
+           "bn_moving_var": np.ones(3, np.float32)}
+    out = _fwd_train(bn, loc, aux)[0]
+    mean, var = x.mean(axis=0), x.var(axis=0)
+    norm = (x - mean) / np.sqrt(var + 1e-3)
+    expect = norm * loc["bn_gamma"] + loc["bn_beta"]
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-4)
+
+
+def _fwd_train(sym, location, aux=None):
+    args = {k: mx.nd.array(v) for k, v in location.items()}
+    aux_list = None
+    if aux is not None:
+        aux_list = [mx.nd.array(aux[n]) for n in sym.list_auxiliary_states()]
+    exe = sym.bind(mx.cpu(), args, None, "null", aux_list)
+    return [o.asnumpy() for o in exe.forward(is_train=True)]
+
+
+def test_softmax_output_grad():
+    """Backward must be (softmax - onehot), ignoring head grads
+    (reference `softmax_output-inl.h`)."""
+    np.random.seed(0)
+    x = np.random.randn(4, 5).astype(np.float32)
+    label = np.array([0, 2, 4, 1], np.float32)
+    sm = mx.sym.SoftmaxOutput(data=mx.sym.Variable("data"), name="sm")
+    args = {"data": mx.nd.array(x), "sm_label": mx.nd.array(label)}
+    grads = {"data": mx.nd.zeros(x.shape), "sm_label": mx.nd.zeros(label.shape)}
+    exe = sm.bind(mx.cpu(), args, grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    exp = np.exp(x - x.max(axis=1, keepdims=True))
+    softmax = exp / exp.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, softmax, rtol=1e-4)
+    exe.backward()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    np.testing.assert_allclose(grads["data"].asnumpy(), softmax - onehot,
+                               rtol=1e-4, atol=1e-5)
+    assert (grads["sm_label"].asnumpy() == 0).all()
+
+
+def test_softmax_output_ignore_label():
+    x = np.random.randn(3, 4).astype(np.float32)
+    label = np.array([1, -1, 2], np.float32)
+    sm = mx.sym.SoftmaxOutput(data=mx.sym.Variable("data"), name="sm",
+                              use_ignore=True, ignore_label=-1)
+    args = {"data": mx.nd.array(x), "sm_label": mx.nd.array(label)}
+    grads = {"data": mx.nd.zeros(x.shape), "sm_label": mx.nd.zeros(label.shape)}
+    exe = sm.bind(mx.cpu(), args, grads)
+    exe.forward(is_train=True)
+    exe.backward()
+    g = grads["data"].asnumpy()
+    assert (g[1] == 0).all() and (g[0] != 0).any()
+
+
+def test_regression_outputs():
+    np.random.seed(0)
+    x = np.random.randn(4, 3).astype(np.float32)
+    y = np.random.randn(4, 3).astype(np.float32)
+    for opname, fwd_fn, grad_fn in [
+        ("LinearRegressionOutput", lambda v: v, lambda o, l: o - l),
+        ("LogisticRegressionOutput", lambda v: 1 / (1 + np.exp(-v)),
+         lambda o, l: o - l),
+        ("MAERegressionOutput", lambda v: v, lambda o, l: np.sign(o - l)),
+    ]:
+        sym = getattr(mx.sym, opname)(data=mx.sym.Variable("data"), name="r")
+        args = {"data": mx.nd.array(x), "r_label": mx.nd.array(y)}
+        grads = {"data": mx.nd.zeros(x.shape), "r_label": mx.nd.zeros(y.shape)}
+        exe = sym.bind(mx.cpu(), args, grads)
+        out = exe.forward(is_train=True)[0].asnumpy()
+        np.testing.assert_allclose(out, fwd_fn(x), rtol=1e-4)
+        exe.backward()
+        np.testing.assert_allclose(grads["data"].asnumpy(),
+                                   grad_fn(fwd_fn(x), y), rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_cross_entropy():
+    np.random.seed(0)
+    x = np.random.randn(6, 4).astype(np.float32)
+    label = np.array([0, 1, 2, 3, 0, 1], np.float32)
+    sym = mx.sym.softmax_cross_entropy(data=mx.sym.Variable("data"),
+                                       label=mx.sym.Variable("label"))
+    out = _fwd(sym, {"data": x, "label": label})[0]
+    logp = x - np.log(np.exp(x).sum(axis=1, keepdims=True))
+    expect = -logp[np.arange(6), label.astype(int)].sum()
+    np.testing.assert_allclose(out, [expect], rtol=1e-4)
+
+
+def test_block_grad():
+    a = mx.sym.Variable("a")
+    blocked = mx.sym.BlockGrad(data=a * 2.0) + a
+    args = {"a": mx.nd.ones((3,))}
+    grads = {"a": mx.nd.zeros((3,))}
+    exe = blocked.bind(mx.cpu(), args, grads)
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((3,))])
+    assert (grads["a"].asnumpy() == 1).all()  # only the identity path
+
+
+def test_reshape_flatten_swapaxis_cast():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    r = mx.sym.Reshape(data=mx.sym.Variable("data"), target_shape=(2, 12))
+    assert _fwd(r, {"data": x})[0].shape == (2, 12)
+    r2 = mx.sym.Reshape(data=mx.sym.Variable("data"), shape=(0, -1))
+    assert _fwd(r2, {"data": x})[0].shape == (2, 12)
+    f = mx.sym.Flatten(data=mx.sym.Variable("data"))
+    assert _fwd(f, {"data": x})[0].shape == (2, 12)
+    s = mx.sym.SwapAxis(data=mx.sym.Variable("data"), dim1=0, dim2=2)
+    np.testing.assert_allclose(_fwd(s, {"data": x})[0], x.swapaxes(0, 2))
+    c = mx.sym.Cast(data=mx.sym.Variable("data"), dtype="int32")
+    assert _fwd(c, {"data": x})[0].dtype == np.int32
+
+
+def test_concat_slice_channel():
+    np.random.seed(0)
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(2, 5).astype(np.float32)
+    cat = mx.sym.Concat(mx.sym.Variable("a"), mx.sym.Variable("b"), dim=1)
+    out = _fwd(cat, {"a": a, "b": b})[0]
+    np.testing.assert_allclose(out, np.concatenate([a, b], axis=1))
+    check_numeric_gradient(cat, {"a": a, "b": b})
+
+    x = np.random.randn(2, 6).astype(np.float32)
+    sl = mx.sym.SliceChannel(data=mx.sym.Variable("data"), num_outputs=3)
+    outs = _fwd(sl, {"data": x})
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[1], x[:, 2:4])
+
+
+def test_embedding():
+    np.random.seed(0)
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    emb = mx.sym.Embedding(data=mx.sym.Variable("data"), input_dim=10,
+                           output_dim=4, name="emb")
+    out = _fwd(emb, {"data": idx, "emb_weight": w})[0]
+    np.testing.assert_allclose(out, w[[1, 3, 5]])
+
+
+def test_dropout_train_eval():
+    mx.random.seed(42)
+    x = np.ones((100, 100), np.float32)
+    do = mx.sym.Dropout(data=mx.sym.Variable("data"), p=0.5)
+    out_eval = _fwd(do, {"data": x})[0]
+    np.testing.assert_allclose(out_eval, x)  # identity at inference
+    out_train = _fwd_train(do, {"data": x})[0]
+    kept = (out_train != 0)
+    assert 0.4 < kept.mean() < 0.6
+    np.testing.assert_allclose(out_train[kept], 2.0, rtol=1e-5)
+
+
+def test_lrn():
+    np.random.seed(0)
+    x = np.random.rand(1, 5, 3, 3).astype(np.float32)
+    lrn = mx.sym.LRN(data=mx.sym.Variable("data"), nsize=3, alpha=1e-4,
+                     beta=0.75, knorm=2.0)
+    out = _fwd(lrn, {"data": x})[0]
+    # direct computation
+    sq = x ** 2
+    expect = np.zeros_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        ssum = sq[:, lo:hi].sum(axis=1)
+        expect[:, c] = x[:, c] * (2.0 + (1e-4 / 3) * ssum) ** -0.75
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_crop_and_upsampling():
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    crop = mx.sym.Crop(data=mx.sym.Variable("data"), h_w=(2, 2),
+                       offset=(1, 1), num_args=1)
+    out = _fwd(crop, {"data": x})[0]
+    np.testing.assert_allclose(out[0, 0], x[0, 0, 1:3, 1:3])
+    up = mx.sym.UpSampling(mx.sym.Variable("data"), scale=2,
+                           sample_type="nearest", num_args=1)
+    out = _fwd(up, {"data": x})[0]
+    assert out.shape == (1, 1, 12, 12)
+    np.testing.assert_allclose(out[0, 0, :2, :2], x[0, 0, 0, 0])
+
+
+def test_unary_ops_grad():
+    np.random.seed(0)
+    x = (np.random.rand(3, 3).astype(np.float32) + 0.5)
+    for name in ["sqrt", "exp", "log", "square", "sin", "cos"]:
+        sym = getattr(mx.sym, name)(mx.sym.Variable("x"))
+        check_numeric_gradient(sym, {"x": x})
+
+
+def test_reductions():
+    np.random.seed(0)
+    x = np.random.randn(3, 4).astype(np.float32)
+    assert abs(_fwd(mx.sym.sum(mx.sym.Variable("x")), {"x": x})[0][0]
+               - x.sum()) < 1e-4
+    assert abs(_fwd(mx.sym.max(mx.sym.Variable("x")), {"x": x})[0][0]
+               - x.max()) < 1e-5
+    assert abs(_fwd(mx.sym.min(mx.sym.Variable("x")), {"x": x})[0][0]
+               - x.min()) < 1e-5
+    am = _fwd(mx.sym.argmax_channel(mx.sym.Variable("x")), {"x": x})[0]
+    np.testing.assert_allclose(am, x.argmax(axis=1).astype(np.float32))
+    tr = _fwd(mx.sym.transpose(mx.sym.Variable("x")), {"x": x})[0]
+    np.testing.assert_allclose(tr, x.T)

@@ -1,0 +1,109 @@
+"""Port of `tests/python/unittest/test_symbol.py`: composition, outputs,
+internals, JSON round-trip, attributes."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net = mx.sym.Activation(data=net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=4)
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    return net
+
+
+def test_symbol_basic():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_compose_positional_and_kwargs():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    assert set(c.list_arguments()) == {"a", "b"}
+    d = mx.sym.ElementWiseSum(a, b, c, name="esum")
+    assert d.list_arguments() == ["a", "b"]  # c reuses a,b
+    assert len(d.list_outputs()) == 1
+
+
+def test_scalar_ops_on_symbols():
+    a = mx.sym.Variable("a")
+    exe = (2.0 * a + 1.0).simple_bind(mx.cpu(), a=(2, 2))
+    exe.arg_dict["a"][:] = 3.0
+    out = exe.forward()[0].asnumpy()
+    assert (out == 7.0).all()
+
+
+def test_grouping_and_getitem():
+    a = mx.sym.Variable("a")
+    b = mx.sym.FullyConnected(data=a, num_hidden=3, name="fc")
+    grp = mx.sym.Group([b, a])
+    assert len(grp.list_outputs()) == 2
+    sub = grp[0]
+    assert sub.list_outputs() == ["fc_output"]
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    assert "relu1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_json_roundtrip(tmp_path):
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.loads(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # saved symbol computes the same result
+    np.random.seed(0)
+    shapes = {"data": (2, 6)}
+    e1 = net.simple_bind(mx.cpu(), **shapes)
+    e2 = net2.simple_bind(mx.cpu(), **shapes)
+    x = np.random.randn(2, 6).astype(np.float32)
+    for e in (e1, e2):
+        e.arg_dict["data"][:] = x
+        for k in e.arg_dict:
+            if k.endswith("weight"):
+                e.arg_dict[k][:] = 0.1
+    o1 = e1.forward()[0].asnumpy()
+    o2 = e2.forward()[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-5)
+    f = str(tmp_path / "sym.json")
+    net.save(f)
+    assert mx.sym.load(f).list_arguments() == net.list_arguments()
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        fc = mx.sym.FullyConnected(data=a, num_hidden=2, name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+    ad = fc.attr_dict()
+    assert ad["fc"]["ctx_group"] == "dev1"
+    assert ad["a"]["ctx_group"] == "dev1"
+
+
+def test_variable_arity_concat():
+    xs = [mx.sym.Variable("x%d" % i) for i in range(3)]
+    c = mx.sym.Concat(*xs, dim=1, name="cat")
+    arg_shapes, out_shapes, _ = c.infer_shape(
+        x0=(2, 3), x1=(2, 4), x2=(2, 5))
+    assert out_shapes[0] == (2, 12)
+
+
+def test_aux_states_listed():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
