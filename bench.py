@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: the BASELINE.json north star — ResNet-50 ImageNet-shape training
+(fused fwd+bwd+SGD-momentum step via parallel.SPMDTrainer, bf16 compute,
+f32 accumulation).  `vs_baseline` compares images/sec/chip against the
+reference's only published absolute throughput: ~170 images/sec on 4 GPUs
+(`docs/tutorials/imagenet_full.md:45`) = 42.5 images/sec/device.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+    if dtype.kind == "V" or str(dtype) == "bfloat16":
+        from mxnet_tpu.base import bfloat16 as dtype  # ml_dtypes bfloat16
+
+    net = models.get_resnet(num_classes=1000, num_layers=50)
+    mesh = make_mesh(axis_names=("data",))
+    n_dev = mesh.devices.size
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes={"data": (batch, 3, image, image),
+                     "softmax_label": (batch,)},
+        lr=0.1, momentum=0.9, wd=1e-4, dtype=dtype,
+    )
+    rng = np.random.RandomState(0)
+    batch_np = {
+        "data": rng.randn(batch, 3, image, image).astype(np.float32).astype(dtype),
+        "softmax_label": rng.randint(0, 1000, size=(batch,)).astype(np.float32),
+    }
+
+    # warmup / compile
+    trainer.step(batch_np)
+    jax.block_until_ready(trainer.params)
+
+    t0 = time.time()
+    for _ in range(steps):
+        trainer.step(batch_np)
+    jax.block_until_ready(trainer.params)
+    dt = (time.time() - t0) / steps
+
+    ips = batch / dt
+    ips_chip = ips / n_dev
+    # ResNet-50 @224: ~4.09 GFLOPs forward/image; training ~3x forward.
+    flops_step = 3 * 4.089e9 * batch
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12")) * n_dev  # v5e bf16
+    mfu = flops_step / dt / peak
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips_chip, 2),
+        "unit": "images/sec/chip (mfu=%.3f, batch=%d, dtype=%s)"
+                % (mfu, batch, np.dtype(dtype).name),
+        "vs_baseline": round(ips_chip / 42.5, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,27 @@ def _as_list(arrays, names, what):
     return arrays
 
 
+class _LazyOutputs:
+    """List-like view of a pending training forward's outputs.  Accessing it
+    materializes the forward; training loops that go forward→backward→metric
+    never pay for a separate forward pass."""
+
+    def __init__(self, exe):
+        self._exe = exe
+
+    def _mat(self):
+        return self._exe.outputs
+
+    def __len__(self):
+        return len(self._mat())
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+
 class Executor:
     """Bound computation (one Symbol + argument/gradient/aux arrays)."""
 
@@ -136,9 +157,23 @@ class Executor:
         self._fn = fn
         self._jit_eval = jax.jit(lambda a, x, r: fn(a, x, r, False))
         self._jit_train = jax.jit(lambda a, x, r: fn(a, x, r, True))
+
+        # Fused forward+backward program, compiled ONCE per executor: the
+        # analogue of GraphExecutor pre-creating cached engine ops at Bind
+        # (`graph_executor.cc:769-806`).  jax.vjp re-traces per call, so the
+        # vjp is taken *inside* jit where it is traced once and cached; XLA
+        # then shares activations between fwd and bwd in one program.
+        def train_step(args, aux, rng, cots):
+            outs, vjp_fn, new_aux = jax.vjp(
+                lambda a: fn(a, aux, rng, True), args, has_aux=True
+            )
+            (grads,) = vjp_fn(cots)
+            return outs, new_aux, grads
+
+        self._jit_train_step = jax.jit(train_step)
         self._base_key = _random.next_key()
         self._step = 0
-        self._vjp_fn = None
+        self._pending = None  # (args, aux, rng) snapshot for lazy train fwd
         self._outputs = None
         self._monitor_cb = None
         self._device = self._ctx.jax_device() if self._ctx is not None else None
@@ -163,7 +198,14 @@ class Executor:
         """Outputs of the most recent forward (async handles, like the
         reference's `Executor::outputs` NDArrays)."""
         if self._outputs is None:
-            raise MXNetError("call forward() first")
+            if self._pending is not None:
+                args, aux, rng = self._pending
+                outs, new_aux = self._jit_train(args, aux, rng)
+                for nd, arr in zip(self.aux_arrays, new_aux):
+                    nd._set_data(arr)
+                self._outputs = [NDArray(o) for o in outs]
+            else:
+                raise MXNetError("call forward() first")
         return self._outputs
 
     def set_monitor_callback(self, callback):
@@ -200,19 +242,16 @@ class Executor:
             self._forward_monitored(args, aux, rng, is_train)
 
         if is_train and self.grad_arrays is not None:
-            aux_box = {}
-
-            def f(a):
-                outs, new_aux = self._jit_train(a, aux, rng)
-                return outs, new_aux
-
-            outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
-            self._vjp_fn = vjp_fn
-        else:
-            jit = self._jit_train if is_train else self._jit_eval
-            outs, new_aux = jit(args, aux, rng)
-            self._vjp_fn = None
-
+            # Lazy training forward: the actual compute happens in the fused
+            # fwd+bwd program at backward() (training loops read outputs only
+            # after backward, `model.py:244-245`).  Reading .outputs before
+            # backward() triggers a separate forward (see outputs property).
+            self._pending = (args, aux, rng)
+            self._outputs = None
+            return _LazyOutputs(self)
+        jit = self._jit_train if is_train else self._jit_eval
+        outs, new_aux = jit(args, aux, rng)
+        self._pending = None
         if is_train:
             for nd, arr in zip(self.aux_arrays, new_aux):
                 nd._set_data(arr)
@@ -250,19 +289,32 @@ class Executor:
             if key in env:
                 self._monitor_cb(name, NDArray(env[key]))
 
+    def _out_avals(self, args, aux, rng):
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        if not hasattr(self, "_aval_cache"):
+            self._aval_cache = {}
+        if key not in self._aval_cache:
+            outs, _ = jax.eval_shape(
+                lambda a, x, r: self._fn(a, x, r, True), args, aux, rng
+            )
+            self._aval_cache[key] = outs
+        return self._aval_cache[key]
+
     def backward(self, out_grads=None):
-        """Compute gradients into the bound grad arrays.
+        """Compute gradients into the bound grad arrays via the fused
+        fwd+bwd program.
 
         Like the reference, `backward()` with no head gradients is only
         meaningful when the outputs are loss layers — their custom vjp ignores
         the incoming cotangent (`softmax_output-inl.h` Backward)."""
         if self.grad_arrays is None:
             raise MXNetError("bind with args_grad to use backward()")
-        if self._vjp_fn is None:
+        if self._pending is None:
             raise MXNetError("call forward(is_train=True) before backward()")
-        outs = self._outputs
+        args, aux, rng = self._pending
         if out_grads is None:
-            cot = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            avals = self._out_avals(args, aux, rng)
+            cot = tuple(jnp.ones(o.shape, o.dtype) for o in avals)
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -270,7 +322,10 @@ class Executor:
                 g.data if isinstance(g, NDArray) else jnp.asarray(g)
                 for g in out_grads
             )
-        (grads,) = self._vjp_fn(cot)
+        outs, new_aux, grads = self._jit_train_step(args, aux, rng, cot)
+        self._outputs = [NDArray(o) for o in outs]
+        for nd, arr in zip(self.aux_arrays, new_aux):
+            nd._set_data(arr)
         for name, nd, g in zip(self._arg_names, self.grad_arrays, grads):
             req = self._grad_req.get(name, "write")
             if req == "null" or nd is None:

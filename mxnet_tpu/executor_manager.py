@@ -23,6 +23,23 @@ from .context import cpu
 from .ndarray import NDArray, zeros
 
 
+def _reduce_blocks(blocks):
+    """Sum per-device copies onto the first block's device.  Committed
+    jax arrays on different devices cannot mix in one op — the explicit
+    device_put is the host-staged reduce of `KVStoreLocal` / the P2P copy of
+    `KVStoreDevice::MergePushValue`."""
+    import jax
+
+    dev = getattr(blocks[0].data, "device", None)
+    acc = blocks[0].data
+    for b in blocks[1:]:
+        arr = b.data
+        if getattr(arr, "device", None) != dev:
+            arr = jax.device_put(arr, dev)
+        acc = acc + arr
+    return acc
+
+
 def _split_input_slice(batch_size, work_load_list):
     """Split batch into per-device slices proportional to work load
     (`executor_manager.py:13-45`)."""
@@ -184,15 +201,11 @@ class DataParallelExecutorManager:
     def copy_to(self, arg_params, aux_params):
         """Average params over devices into host dicts (`copy_to`)."""
         for name, blocks in zip(self.param_names, self.param_arrays):
-            acc = blocks[0].data
-            for b in blocks[1:]:
-                acc = acc + b.data
+            acc = _reduce_blocks(blocks)
             arg_params[name]._set_data((acc / len(blocks)).astype(
                 arg_params[name].dtype))
         for name, blocks in zip(self.aux_names, self.aux_arrays):
-            acc = blocks[0].data
-            for b in blocks[1:]:
-                acc = acc + b.data
+            acc = _reduce_blocks(blocks)
             aux_params[name]._set_data((acc / len(blocks)).astype(
                 aux_params[name].dtype))
 

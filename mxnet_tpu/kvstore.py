@@ -64,11 +64,18 @@ class KVStore:
         return out
 
     def _merge(self, vals):
-        """Reduce a list of NDArrays.  Fixed left-to-right order for the
-        determinism gate (`tests/nightly/multi_lenet.py`; SURVEY §7)."""
+        """Reduce a list of NDArrays (possibly on different devices).  Fixed
+        left-to-right order for the determinism gate
+        (`tests/nightly/multi_lenet.py`; SURVEY §7)."""
+        import jax
+
+        dev = getattr(vals[0].data, "device", None)
         acc = vals[0].data
         for v in vals[1:]:
-            acc = acc + v.data
+            arr = v.data
+            if getattr(arr, "device", None) != dev:
+                arr = jax.device_put(arr, dev)
+            acc = acc + arr
         return acc
 
     # -- API ---------------------------------------------------------------

@@ -346,7 +346,7 @@ class FeedForward(BASE_ESTIMATOR):
         self._init_predictor(dict(data_shapes))
         batch_size = X.batch_size
         data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
-        output_list = [[] for _ in range(len(self._pred_exec.outputs))]
+        output_list = [[] for _ in range(len(self.symbol.list_outputs()))]
         data_list = [[] for _ in X.provide_data] if return_data else None
         label_list = [[] for _ in X.provide_label] if return_data else None
 

@@ -1,0 +1,10 @@
+"""Model zoo: symbol definitions of the reference's acceptance workloads
+(`example/image-classification/symbol_*.py`, `example/rnn/lstm.py`,
+`example/model-parallel-lstm/lstm.py`)."""
+from .mlp import get_mlp
+from .lenet import get_lenet
+from .alexnet import get_alexnet
+from .vgg import get_vgg
+from .inception_bn import get_inception_bn
+from .resnet import get_resnet
+from .lstm import lstm_unroll, lstm_cell

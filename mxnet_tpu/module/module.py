@@ -194,18 +194,14 @@ class Module(BaseModule):
     def get_params(self):
         arg = {k: v.copy() for k, v in self._arg_params.items()}
         aux = {k: v.copy() for k, v in self._aux_params.items()}
+        from ..executor_manager import _reduce_blocks
+
         # pull back the trained values from the devices
         for name, blocks in zip(self._param_names,
                                 self._exec_group.param_arrays):
-            acc = blocks[0].data
-            for b in blocks[1:]:
-                acc = acc + b.data
-            arg[name]._set_data(acc / len(blocks))
+            arg[name]._set_data(_reduce_blocks(blocks) / len(blocks))
         for name, blocks in zip(self._aux_names, self._exec_group.aux_arrays):
-            acc = blocks[0].data
-            for b in blocks[1:]:
-                acc = acc + b.data
-            aux[name]._set_data(acc / len(blocks))
+            aux[name]._set_data(_reduce_blocks(blocks) / len(blocks))
         return arg, aux
 
     def install_monitor(self, monitor):

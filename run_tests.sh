@@ -1,0 +1,6 @@
+#!/bin/bash
+# CPU test runner: strips the axon TPU sitecustomize (tests run on a virtual
+# 8-device CPU mesh; the TPU relay is only needed for bench.py).
+exec env PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest "$@"

@@ -65,7 +65,7 @@ def test_batchnorm_aux_update():
     np.random.seed(0)
     x = (np.random.randn(8, 3) * 2 + 5).astype(np.float32)
     exe.arg_dict["data"][:] = x
-    exe.forward(is_train=True)
+    exe.forward(is_train=True)[0].asnumpy()  # sync point (async dispatch)
     mm = exe.aux_dict["bn_moving_mean"].asnumpy()
     # moving_mean moved halfway toward batch mean (momentum 0.5)
     np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-3)

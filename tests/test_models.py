@@ -1,0 +1,85 @@
+"""Model zoo shape checks (reference `tests/python/common/models.py` role)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def test_mlp_shapes():
+    net = models.get_mlp()
+    _, out_shapes, _ = net.infer_shape(data=(32, 784))
+    assert out_shapes[0] == (32, 10)
+
+
+def test_lenet_shapes():
+    net = models.get_lenet()
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 1, 28, 28))
+    assert out_shapes[0] == (2, 10)
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (20, 1, 5, 5)
+    assert d["fc1_weight"][0] == 500
+
+
+def test_alexnet_shapes():
+    net = models.get_alexnet(num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_vgg_shapes():
+    net = models.get_vgg(num_classes=100)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 100)
+
+
+def test_inception_bn_shapes():
+    net = models.get_inception_bn(num_classes=10)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 28, 28))
+    assert out_shapes[0] == (1, 10)
+
+
+def test_resnet18_small_forward():
+    net = models.get_resnet(num_classes=10, num_layers=18,
+                            image_shape=(3, 32, 32))
+    _, out_shapes, _ = net.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes[0] == (2, 10)
+    exe = net.simple_bind(mx.cpu(), data=(2, 3, 32, 32))
+    for name, arr in exe.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = np.random.randn(*arr.shape).astype(np.float32) * 0.05
+        elif name.endswith("gamma"):
+            arr[:] = 1.0
+    for name, arr in exe.aux_dict.items():
+        if name.endswith("var"):
+            arr[:] = 1.0
+    exe.arg_dict["data"][:] = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_resnet50_shapes():
+    net = models.get_resnet(num_classes=1000, num_layers=50)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 224, 224))
+    assert out_shapes[0] == (2, 1000)
+    nparams = sum(int(np.prod(s)) for n, s in
+                  zip(net.list_arguments(), arg_shapes)
+                  if n not in ("data", "softmax_label"))
+    assert 2.4e7 < nparams < 2.7e7  # ~25.5M params for ResNet-50
+
+
+def test_lstm_unroll_shapes():
+    seq_len, batch, vocab, nh, ne = 4, 2, 50, 16, 8
+    net = models.lstm_unroll(num_lstm_layer=2, seq_len=seq_len,
+                             input_size=vocab, num_hidden=nh, num_embed=ne,
+                             num_label=vocab)
+    shapes = {"data": (batch, seq_len), "softmax_label": (batch, seq_len)}
+    for i in range(2):
+        shapes["l%d_init_c" % i] = (batch, nh)
+        shapes["l%d_init_h" % i] = (batch, nh)
+    arg_shapes, out_shapes, _ = net.infer_shape(**shapes)
+    assert out_shapes[0] == (seq_len * batch, vocab)
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["l0_i2h_weight"] == (4 * nh, ne)
+    assert d["l1_i2h_weight"] == (4 * nh, nh)

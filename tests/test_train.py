@@ -1,0 +1,110 @@
+"""Trainer integration tests — port of `tests/python/train/test_mlp.py`:
+train a small net and assert an accuracy threshold (no external data:
+synthetic gaussian blobs stand in for MNIST)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def make_blobs(n=800, num_classes=4, dim=20, seed=0):
+    centers = np.random.RandomState(42).randn(num_classes, dim) * 3
+    rng = np.random.RandomState(seed)  # noise seed only; centers fixed
+    X, y = [], []
+    for i in range(n):
+        c = i % num_classes
+        X.append(centers[c] + rng.randn(dim) * 0.8)
+        y.append(c)
+    return np.asarray(X, np.float32), np.asarray(y, np.float32)
+
+
+def _mlp(num_classes=4):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=32)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_feedforward_fit_accuracy():
+    mx.random.seed(0)
+    X, y = make_blobs()
+    Xv, yv = make_blobs(200, seed=1)
+    model = mx.model.FeedForward(
+        symbol=_mlp(), ctx=mx.cpu(), num_epoch=8, learning_rate=0.1,
+        momentum=0.9, wd=1e-4, numpy_batch_size=50,
+    )
+    model.fit(X, y, eval_data=(Xv, yv))
+    acc = model.score(mx.io.NDArrayIter(Xv, yv, batch_size=50))
+    assert acc > 0.9, "accuracy %f too low" % acc
+    # predict shape
+    preds = model.predict(Xv)
+    assert preds.shape == (200, 4)
+
+
+def test_feedforward_multi_device():
+    """DP over two (virtual CPU) devices — the reference's 4-GPU path
+    exercised on the host mesh."""
+    mx.random.seed(0)
+    X, y = make_blobs()
+    model = mx.model.FeedForward(
+        symbol=_mlp(), ctx=[mx.cpu(0), mx.cpu(1)], num_epoch=6,
+        learning_rate=0.1, momentum=0.9, numpy_batch_size=64,
+    )
+    model.fit(X, y)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=64))
+    assert acc > 0.9, "multi-device accuracy %f too low" % acc
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mx.random.seed(0)
+    X, y = make_blobs(200)
+    model = mx.model.FeedForward(symbol=_mlp(), ctx=mx.cpu(), num_epoch=2,
+                                 learning_rate=0.1, numpy_batch_size=50)
+    model.fit(X, y)
+    prefix = str(tmp_path / "mlp")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 2)
+    p1 = model.predict(X[:50])
+    p2 = loaded.predict(X[:50])
+    np.testing.assert_allclose(p1, p2, rtol=1e-4)
+
+
+def test_module_fit():
+    mx.random.seed(0)
+    X, y = make_blobs()
+    it = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=8,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=50), "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_update_on_kvstore_matches_local():
+    """update_on_kvstore vs local-updater numerics (SURVEY §7 hard part):
+    single device, same seed, both modes must train equivalently."""
+    X, y = make_blobs(400)
+
+    def run(kv):
+        mx.random.seed(7)
+        np.random.seed(7)
+        it = mx.io.NDArrayIter(X, y, batch_size=50)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(it, num_epoch=3, kvstore=kv,
+                optimizer_params={"learning_rate": 0.1})
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    local = run("local")
+    device = run(mx.kv.create("device"))
+    for k in local:
+        np.testing.assert_allclose(local[k], device[k], rtol=1e-3, atol=1e-5)
+
+
+def test_speedometer_runs(caplog):
+    X, y = make_blobs(200)
+    model = mx.model.FeedForward(symbol=_mlp(), ctx=mx.cpu(), num_epoch=1,
+                                 numpy_batch_size=20)
+    model.fit(X, y, batch_end_callback=mx.callback.Speedometer(20, 5))
