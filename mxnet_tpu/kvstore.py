@@ -5,7 +5,9 @@ Reference: `include/mxnet/kvstore.h`, `src/kvstore/kvstore_local.h`,
 `python/mxnet/kvstore.py`; architecture `docs/system/multi_node.md`.
 
 The user-visible contract is kept exactly: int or str keys, `init/push/pull`
-with priority, a pluggable updater (default `stored += merged`), worker
+with priority, a pluggable updater (with an updater set, push applies it to
+the stored weight; without one, push fills a merge buffer and pull serves the
+merged value — aggregation-only mode, `kvstore_local.h:39-80`), worker
 `rank`/`num_workers`, `barrier`, and `set_optimizer` installing a
 `get_updater(optimizer)` closure.
 
@@ -39,7 +41,8 @@ class KVStore:
 
     def __init__(self, kv_type="local"):
         self.type = kv_type
-        self._store = {}  # key -> NDArray (the "stored" weight)
+        self._store = {}  # key -> NDArray (the "stored" weight, `local_`)
+        self._merge_buf = {}  # key -> NDArray (last merged push, `merge_buf_`)
         self._updater = None
         self._on_device = "device" in kv_type
 
@@ -95,11 +98,12 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
             merged = NDArray(self._merge(vlist))
-            stored = self._store[k]
+            # semantics of `KVStoreLocal::Push` (`kvstore_local.h:39-55`):
+            # the merged value lands in the merge buffer; only with an
+            # updater does it modify the stored weight
+            self._merge_buf[k] = merged
             if self._updater is not None:
-                self._updater(k, merged, stored)
-            else:
-                stored._set_data(stored.data + merged.data)
+                self._updater(k, merged, self._store[k])
 
     def pull(self, key, out=None, priority=0):
         if out is None:
@@ -114,7 +118,13 @@ class KVStore:
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
-            src = self._store[k]
+            # `KVStoreLocal::Pull` (`kvstore_local.h:57-80`): with an updater,
+            # serve the stored weight; without one, serve the last merged
+            # push (aggregation-only mode used by `_update_params`)
+            if self._updater is not None or k not in self._merge_buf:
+                src = self._store[k]
+            else:
+                src = self._merge_buf[k]
             for o in olist:
                 src.copyto(o)
 
