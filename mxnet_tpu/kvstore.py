@@ -95,15 +95,17 @@ class KVStore:
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
         for k, vlist in zip(keys, vals):
-            if k not in self._store:
-                raise MXNetError("key %r not initialized" % k)
             merged = NDArray(self._merge(vlist))
             # semantics of `KVStoreLocal::Push` (`kvstore_local.h:39-55`):
-            # the merged value lands in the merge buffer; only with an
-            # updater does it modify the stored weight
-            self._merge_buf[k] = merged
+            # with an updater, the merged value updates the stored weight
+            # (init required); without one it only lands in the merge buffer
+            # (push-before-init is legal pure-aggregation usage)
             if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %r not initialized" % k)
                 self._updater(k, merged, self._store[k])
+            else:
+                self._merge_buf[k] = merged
 
     def pull(self, key, out=None, priority=0):
         if out is None:
@@ -116,15 +118,15 @@ class KVStore:
         else:
             outs = [[o] if isinstance(o, NDArray) else list(o) for o in out]
         for k, olist in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("key %r not initialized" % k)
             # `KVStoreLocal::Pull` (`kvstore_local.h:57-80`): with an updater,
             # serve the stored weight; without one, serve the last merged
             # push (aggregation-only mode used by `_update_params`)
-            if self._updater is not None or k not in self._merge_buf:
+            if self._updater is None and k in self._merge_buf:
+                src = self._merge_buf[k]
+            elif k in self._store:
                 src = self._store[k]
             else:
-                src = self._merge_buf[k]
+                raise MXNetError("key %r not initialized" % k)
             for o in olist:
                 src.copyto(o)
 

@@ -1,0 +1,155 @@
+"""Port of `tests/cpp/threaded_engine_test.cc`: random read/write workloads
+over N vars must produce results identical to serial execution, for every
+engine type."""
+import random
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu.engine import Engine, NaiveEngine
+
+
+def _random_workload(num_vars=20, num_ops=200, seed=0):
+    """Each op: reads some vars, writes some vars, applies a deterministic
+    update to a shared python list (the 'memory')."""
+    rng = random.Random(seed)
+    ops = []
+    for i in range(num_ops):
+        reads = rng.sample(range(num_vars), rng.randint(0, 3))
+        writes = rng.sample(range(num_vars), rng.randint(1, 2))
+        writes = [w for w in writes if w not in reads]
+        if not writes:
+            continue
+        ops.append((i, reads, writes))
+    return ops
+
+
+def _run_serial(ops, num_vars):
+    mem = [0] * num_vars
+    for i, reads, writes in ops:
+        s = sum(mem[r] for r in reads)
+        for w in writes:
+            mem[w] = mem[w] * 2 + s + i + 1
+    return mem
+
+
+def _run_engine(engine, ops, num_vars):
+    mem = [0] * num_vars
+    vars_ = [engine.new_variable() for _ in range(num_vars)]
+
+    def make_fn(i, reads, writes):
+        def fn():
+            s = sum(mem[r] for r in reads)
+            time.sleep(0.0001 * (i % 3))  # jitter to expose races
+            for w in writes:
+                mem[w] = mem[w] * 2 + s + i + 1
+        return fn
+
+    for i, reads, writes in ops:
+        engine.push(make_fn(i, reads, writes),
+                    const_vars=[vars_[r] for r in reads],
+                    mutable_vars=[vars_[w] for w in writes])
+    engine.wait_for_all()
+    return mem
+
+
+@pytest.mark.parametrize("engine_factory", [
+    lambda: Engine(num_workers=4),
+    lambda: NaiveEngine(),
+])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_workload_matches_serial(engine_factory, seed):
+    num_vars = 20
+    ops = _random_workload(num_vars=num_vars, seed=seed)
+    expected = _run_serial(ops, num_vars)
+    engine = engine_factory()
+    got = _run_engine(engine, ops, num_vars)
+    engine.shutdown()
+    assert got == expected
+
+
+def test_single_writer_multi_reader():
+    """Readers may run concurrently; a writer must be exclusive."""
+    engine = Engine(num_workers=4)
+    v = engine.new_variable()
+    state = {"readers": 0, "max_readers": 0, "writer_during_read": False}
+    lock = threading.Lock()
+
+    def reader():
+        with lock:
+            state["readers"] += 1
+            state["max_readers"] = max(state["max_readers"], state["readers"])
+        time.sleep(0.01)
+        with lock:
+            state["readers"] -= 1
+
+    def writer():
+        with lock:
+            if state["readers"] > 0:
+                state["writer_during_read"] = True
+
+    for _ in range(4):
+        engine.push(reader, const_vars=[v])
+    engine.push(writer, mutable_vars=[v])
+    for _ in range(4):
+        engine.push(reader, const_vars=[v])
+    engine.wait_for_all()
+    engine.shutdown()
+    assert state["max_readers"] >= 2, "readers should overlap"
+    assert not state["writer_during_read"], "writer overlapped readers"
+
+
+def test_wait_for_var():
+    engine = Engine(num_workers=2)
+    v = engine.new_variable()
+    log = []
+    engine.push(lambda: (time.sleep(0.05), log.append("write")),
+                mutable_vars=[v])
+    engine.wait_for_var(v)
+    assert log == ["write"]
+    engine.shutdown()
+
+
+def test_dedup_check():
+    """`CheckDuplicate` semantics (`threaded_engine.cc:205-237`)."""
+    from mxnet_tpu.base import MXNetError
+
+    engine = Engine(num_workers=1)
+    v = engine.new_variable()
+    with pytest.raises(MXNetError):
+        engine.push(lambda: None, const_vars=[v], mutable_vars=[v])
+    with pytest.raises(MXNetError):
+        engine.push(lambda: None, mutable_vars=[v, v])
+    engine.shutdown()
+
+
+def test_exception_surfaces_at_sync():
+    engine = Engine(num_workers=2)
+    v = engine.new_variable()
+
+    def boom():
+        raise ValueError("boom")
+
+    engine.push(boom, mutable_vars=[v])
+    with pytest.raises(ValueError):
+        engine.wait_for_all()
+    engine.shutdown()
+
+
+def test_priority_ordering():
+    """Higher priority ops should run first when queued together
+    (kCPUPrioritized analogue, `kvstore_local.h:165-168`)."""
+    engine = Engine(num_workers=1)
+    gate = threading.Event()
+    order = []
+    v0 = engine.new_variable()
+    engine.push(lambda: gate.wait(1), mutable_vars=[v0])  # occupy the worker
+    vars_ = [engine.new_variable() for _ in range(3)]
+    for i, pr in enumerate([0, 10, 5]):
+        engine.push(lambda i=i: order.append(i), mutable_vars=[vars_[i]],
+                    priority=pr)
+    gate.set()
+    engine.wait_for_all()
+    engine.shutdown()
+    assert order == [1, 2, 0]

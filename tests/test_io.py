@@ -1,0 +1,148 @@
+"""Port of `tests/python/unittest/test_io.py`: iterators + recordio."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import (CSVIter, MNISTIter, NDArrayIter, PrefetchingIter,
+                          ResizeIter)
+from mxnet_tpu import recordio
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(100 * 4).reshape(100, 4).astype(np.float32)
+    y = np.arange(100).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=10)
+    batches = list(it)
+    assert len(batches) == 10
+    assert batches[0].data[0].shape == (10, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), X[:10])
+    np.testing.assert_allclose(batches[3].label[0].asnumpy(), y[30:40])
+    it.reset()
+    assert len(list(it)) == 10
+
+
+def test_ndarray_iter_pad():
+    X = np.arange(25 * 2).reshape(25, 2).astype(np.float32)
+    it = NDArrayIter(X, np.zeros(25), batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 5
+    it2 = NDArrayIter(X, np.zeros(25), batch_size=10,
+                      last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    X = np.arange(40).reshape(40, 1).astype(np.float32)
+    it = NDArrayIter(X, np.zeros(40), batch_size=10, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(40))
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(20, 3).astype(np.float32)
+    labels = np.arange(20).astype(np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                 batch_size=5)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:5], rtol=1e-5)
+    np.testing.assert_allclose(b.label[0].asnumpy(), labels[:5])
+
+
+def _write_mnist(tmp_path, n=50):
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(n, 28, 28) * 255).astype(np.uint8)
+    lbls = (np.arange(n) % 10).astype(np.uint8)
+    ipath, lpath = str(tmp_path / "imgs"), str(tmp_path / "lbls")
+    with open(ipath, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lpath, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    return ipath, lpath, imgs, lbls
+
+
+def test_mnist_iter(tmp_path):
+    ipath, lpath, imgs, lbls = _write_mnist(tmp_path)
+    it = MNISTIter(image=ipath, label=lpath, batch_size=10, shuffle=False,
+                   flat=True)
+    b = next(iter(it))
+    assert b.data[0].shape == (10, 784)
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               imgs[:10].reshape(10, -1) / 255.0, rtol=1e-5)
+    it2 = MNISTIter(image=ipath, label=lpath, batch_size=10, shuffle=False)
+    assert next(iter(it2)).data[0].shape == (10, 1, 28, 28)
+
+
+def test_mnist_iter_sharded(tmp_path):
+    """part_index/num_parts distributed sharding
+    (`iter_image_recordio.cc:215-217` behavior)."""
+    ipath, lpath, imgs, lbls = _write_mnist(tmp_path, n=40)
+    parts = []
+    for p in range(2):
+        it = MNISTIter(image=ipath, label=lpath, batch_size=10, shuffle=False,
+                       flat=True, part_index=p, num_parts=2)
+        parts.append(np.concatenate([b.label[0].asnumpy() for b in it]))
+    all_labels = np.sort(np.concatenate(parts))
+    np.testing.assert_allclose(all_labels, np.sort(lbls.astype(np.float32)))
+
+
+def test_resize_iter():
+    X = np.zeros((30, 2), np.float32)
+    base = NDArrayIter(X, np.zeros(30), batch_size=10)
+    it = ResizeIter(base, size=7)
+    assert len(list(it)) == 7  # wraps around the 3-batch base iter
+    it.reset()
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    X = np.arange(60).reshape(60, 1).astype(np.float32)
+    base = NDArrayIter(X, np.zeros(60), batch_size=10)
+    it = PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 6
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), X[:10])
+    it.reset()
+    assert len(list(it)) == 6
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "x.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"record-%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == b"record-%d" % i
+    assert r.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    path, idx = str(tmp_path / "x.rec"), str(tmp_path / "x.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec-%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(3) == b"rec-3"
+    assert r.read_idx(0) == b"rec-0"
+    assert sorted(r.keys) == list(range(5))
+
+
+def test_recordio_pack_unpack_img(tmp_path):
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    img = (np.random.rand(4, 4, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(header, img)
+    h2, img2 = recordio.unpack_img(s)
+    assert h2.label == 3.0 and h2.id == 7
+    np.testing.assert_array_equal(img, img2)

@@ -1,0 +1,107 @@
+"""Port of `tests/python/unittest/test_kvstore.py` + the nightly local
+aggregation identities (`tests/nightly/test_kvstore.py`)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _check(a, b):
+    np.testing.assert_allclose(a.asnumpy(), b, rtol=1e-5)
+
+
+def test_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check(out, np.ones(SHAPE))
+
+
+def test_list_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [mx.nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        _check(o, np.ones(SHAPE) * 4)
+
+
+def test_aggregation_over_devices():
+    """Push from 4 'devices' -> pull returns the sum (aggregation-only
+    mode, no updater)."""
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    devs = [mx.cpu(i) for i in range(4)]
+    vals = [mx.nd.ones(SHAPE, ctx=d) * (i + 1) for i, d in enumerate(devs)]
+    kv.push(3, vals)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check(out, np.ones(SHAPE) * 10)
+
+
+def test_updater_mode():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv._set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check(out, np.ones(SHAPE) * 3)
+    # repeated pushes keep applying the updater to the stored weight
+    for _ in range(3):
+        kv.push(3, mx.nd.ones(SHAPE))
+    kv.pull(3, out=out)
+    _check(out, np.ones(SHAPE) * 9)
+
+
+def test_device_kvstore_aggregation():
+    kv = mx.kv.create("device")
+    kv.init(9, mx.nd.zeros(SHAPE))
+    vals = [mx.nd.ones(SHAPE, ctx=mx.cpu(i)) for i in range(4)]
+    kv.push(9, vals)
+    outs = [mx.nd.zeros(SHAPE, ctx=mx.cpu(i)) for i in range(4)]
+    kv.pull(9, out=outs)
+    for o in outs:
+        _check(o, np.ones(SHAPE) * 4)
+
+
+def test_set_optimizer_updates_weights():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.opt.create("test"))  # w += rescale_grad * grad
+    kv.push(0, mx.nd.ones(SHAPE) * 2)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    _check(out, np.ones(SHAPE) * 3)
+
+
+def test_closed_form_oracle_single_process():
+    """The dist_sync oracle (`tests/nightly/dist_sync_kvstore.py:30-46`)
+    run single-worker: after nrepeat pushes of grad=rate*(rank+1) with the
+    'test' optimizer, weight == 1 + rate * nrepeat (n=1 worker)."""
+    rate = 2.0
+    nrepeat = 3
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.opt.create("test", rescale_grad=1.0))
+    for _ in range(nrepeat):
+        kv.push(0, mx.nd.ones(SHAPE) * rate)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    _check(out, np.ones(SHAPE) * (1 + rate * nrepeat))
+
+
+def test_string_keys():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    _check(out, np.ones((2,)))

@@ -1,0 +1,93 @@
+"""Port of `tests/python/unittest/test_model_parallel.py:4-31`: two ctx_group
+groups mapped to cpu(0)/cpu(1) — model parallelism without a cluster."""
+import numpy as np
+
+import mxnet_tpu as mx
+from common import reldiff
+
+
+def test_chain_two_groups():
+    n = 5
+    data1 = mx.sym.Variable("data1")
+    data2 = mx.sym.Variable("data2")
+    with mx.AttrScope(ctx_group="dev1"):
+        net = data1 * 2.0
+        net = net + data2
+    with mx.AttrScope(ctx_group="dev2"):
+        net = net + data1
+    arr = [mx.nd.ones((n, n)) for _ in range(2)]
+    arr_grad = [mx.nd.zeros((n, n)) for _ in range(2)]
+
+    exec1 = net.bind(
+        mx.cpu(),
+        args=arr,
+        args_grad=arr_grad,
+        group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+    )
+    arr[0][:] = 1.0
+    arr[1][:] = 2.0
+    exec1.forward(is_train=True)
+    out1 = exec1.outputs[0].asnumpy()
+    np.testing.assert_allclose(out1, np.full((n, n), 5.0), rtol=1e-5)
+    exec1.backward([mx.nd.ones((n, n))])
+    np.testing.assert_allclose(arr_grad[0].asnumpy(), np.full((n, n), 3.0))
+    np.testing.assert_allclose(arr_grad[1].asnumpy(), np.full((n, n), 1.0))
+
+
+def test_group2ctx_matches_single_device():
+    """Placement must not change numerics (the reference's core contract)."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="embed"):
+        fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+        act = mx.sym.Activation(data=fc1, act_type="tanh")
+    with mx.AttrScope(ctx_group="decode"):
+        fc2 = mx.sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(data=fc2, name="sm")
+
+    np.random.seed(0)
+    vals = {
+        "data": np.random.randn(4, 6).astype(np.float32),
+        "fc1_weight": np.random.randn(8, 6).astype(np.float32) * 0.3,
+        "fc1_bias": np.zeros(8, np.float32),
+        "fc2_weight": np.random.randn(3, 8).astype(np.float32) * 0.3,
+        "fc2_bias": np.zeros(3, np.float32),
+        "sm_label": np.array([0, 1, 2, 0], np.float32),
+    }
+
+    def run(group2ctx):
+        args = {k: mx.nd.array(v) for k, v in vals.items()}
+        grads = {k: mx.nd.zeros(v.shape) for k, v in vals.items()}
+        exe = net.bind(mx.cpu(), args, grads, group2ctx=group2ctx)
+        out = exe.forward(is_train=True)[0].asnumpy()
+        exe.backward()
+        return out, grads["fc1_weight"].asnumpy()
+
+    out_a, g_a = run(None)
+    out_b, g_b = run({"embed": mx.cpu(0), "decode": mx.cpu(1)})
+    assert reldiff(out_a, out_b) < 1e-5
+    assert reldiff(g_a, g_b) < 1e-5
+
+
+def test_model_parallel_lstm_builds():
+    """The model-parallel stacked LSTM (`example/model-parallel-lstm/
+    lstm.py:180-181`) with per-layer ctx groups binds and runs."""
+    from mxnet_tpu.models import lstm_unroll
+
+    net = lstm_unroll(num_lstm_layer=2, seq_len=3, input_size=30,
+                      num_hidden=8, num_embed=6, num_label=30,
+                      ctx_groups=["layer0", "layer1"])
+    shapes = {"data": (2, 3), "softmax_label": (2, 3)}
+    for i in range(2):
+        shapes["l%d_init_c" % i] = (2, 8)
+        shapes["l%d_init_h" % i] = (2, 8)
+    exe = net.simple_bind(
+        mx.cpu(), grad_req="write",
+        **shapes,
+    )
+    for k, v in exe.arg_dict.items():
+        if k.endswith("weight"):
+            v[:] = np.random.randn(*v.shape).astype(np.float32) * 0.1
+    exe.forward(is_train=True)
+    exe.backward()
+    assert exe.outputs[0].shape == (6, 30)
+    assert abs(exe.grad_dict["l0_i2h_weight"].asnumpy()).sum() > 0

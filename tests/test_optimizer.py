@@ -1,0 +1,128 @@
+"""Optimizer unit tests (reference checks these through training; here also
+directly against closed-form updates)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.optimizer import SGD, Adam, AdaGrad, Optimizer, get_updater
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def test_sgd_no_momentum():
+    opt = SGD(learning_rate=0.1, wd=0.0, momentum=0.0, rescale_grad=1.0)
+    w, g = _nd([1.0, 2.0]), _nd([0.5, 0.5])
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), [0.95, 1.95], rtol=1e-6)
+
+
+def test_sgd_momentum_and_wd():
+    opt = SGD(learning_rate=0.1, wd=0.1, momentum=0.9, rescale_grad=1.0)
+    w, g = _nd([1.0]), _nd([1.0])
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    # mom = -0.1*(1 + 0.1*1) = -0.11 ; w = 1 - 0.11
+    np.testing.assert_allclose(w.asnumpy(), [0.89], rtol=1e-6)
+    opt.update(0, w, g, state)
+    # mom = 0.9*(-0.11) - 0.1*(1+0.1*0.89) = -0.099 - 0.1089 = -0.2079
+    np.testing.assert_allclose(w.asnumpy(), [0.89 - 0.2079], rtol=1e-5)
+
+
+def test_clip_gradient():
+    opt = SGD(learning_rate=1.0, momentum=0.0, clip_gradient=0.5,
+              rescale_grad=1.0)
+    w, g = _nd([0.0]), _nd([10.0])
+    opt.update(0, w, g, opt.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy(), [-0.5], rtol=1e-6)
+
+
+def test_rescale_grad():
+    opt = SGD(learning_rate=1.0, momentum=0.0, rescale_grad=0.1)
+    w, g = _nd([0.0]), _nd([10.0])
+    opt.update(0, w, g, opt.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy(), [-1.0], rtol=1e-6)
+
+
+def test_adam_first_step():
+    opt = Adam(learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+               rescale_grad=1.0, wd=0.0)
+    w, g = _nd([1.0]), _nd([0.5])
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    # first step of adam moves by ~lr regardless of grad scale
+    np.testing.assert_allclose(w.asnumpy(), [1.0 - 0.002], rtol=1e-4)
+
+
+def test_adagrad_accumulates():
+    opt = AdaGrad(learning_rate=1.0, eps=1e-7, rescale_grad=1.0, wd=0.0)
+    w, g = _nd([0.0]), _nd([1.0])
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), [-1.0], rtol=1e-3)
+    opt.update(0, w, g, state)
+    # second step smaller: 1/sqrt(2)
+    np.testing.assert_allclose(w.asnumpy(), [-1.0 - 1 / np.sqrt(2)], rtol=1e-3)
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    sched = FactorScheduler(step=2, factor=0.5)
+    opt = SGD(learning_rate=1.0, momentum=0.0, lr_scheduler=sched,
+              rescale_grad=1.0)
+    w, g = _nd([0.0]), _nd([1.0])
+    s = opt.create_state(0, w)
+    deltas = []
+    prev = 0.0
+    for _ in range(6):
+        opt.update(0, w, g, s)
+        cur = float(w.asnumpy()[0])
+        deltas.append(prev - cur)
+        prev = cur
+    assert deltas[0] == pytest.approx(1.0)
+    assert deltas[-1] < deltas[0]
+
+
+def test_lr_wd_mult_via_idx2name():
+    opt = SGD(learning_rate=1.0, momentum=0.0, wd=0.1, rescale_grad=1.0,
+              param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    # bias gets wd_mult 0 automatically (reference set_wd_mult behavior)
+    w, b = _nd([1.0]), _nd([1.0])
+    g0 = _nd([0.0])
+    opt.update(0, w, g0, opt.create_state(0, w))
+    opt.update(1, b, g0, opt.create_state(1, b))
+    assert w.asnumpy()[0] < 1.0  # decayed
+    np.testing.assert_allclose(b.asnumpy(), [1.0])  # no decay on bias
+
+
+def test_get_updater_state_per_key():
+    opt = SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    updater = get_updater(opt)
+    w1, w2 = _nd([1.0]), _nd([1.0])
+    g = _nd([1.0])
+    updater(0, g, w1)
+    updater(1, g, w2)
+    assert 0 in updater.states and 1 in updater.states
+    np.testing.assert_allclose(w1.asnumpy(), w2.asnumpy())
+
+
+def test_registry_create():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "sgld",
+                 "ccsgd", "test"]:
+        opt = Optimizer.create_optimizer(name)
+        assert isinstance(opt, Optimizer)
+    with pytest.raises(Exception):
+        Optimizer.create_optimizer("nope")
+
+
+def test_optimizer_picklable():
+    """Optimizers must pickle for the dist server protocol
+    (`kvstore.py:231`, `kvstore_server.py`)."""
+    import pickle
+
+    opt = SGD(learning_rate=0.1, momentum=0.9)
+    opt2 = pickle.loads(pickle.dumps(opt))
+    assert opt2.lr == 0.1

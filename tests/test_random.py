@@ -1,0 +1,43 @@
+"""Port of `tests/python/unittest/test_random.py`: seeded reproducibility."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_seed_reproducibility():
+    mx.random.seed(128)
+    a = mx.random.uniform(shape=(10, 10)).asnumpy()
+    mx.random.seed(128)
+    b = mx.random.uniform(shape=(10, 10)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = mx.random.uniform(shape=(10, 10)).asnumpy()
+    assert np.abs(a - c).max() > 0  # stream advances
+
+
+def test_uniform_range():
+    mx.random.seed(0)
+    x = mx.random.uniform(-10, 10, shape=(2000,)).asnumpy()
+    assert x.min() >= -10 and x.max() < 10
+    assert abs(x.mean()) < 0.5
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    x = mx.random.normal(loc=2.0, scale=3.0, shape=(5000,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.2
+    assert abs(x.std() - 3.0) < 0.2
+
+
+def test_dropout_reproducible_with_seed():
+    """Operator RNG (dropout) is reseeded by mx.random.seed, like the
+    reference's `mx.random.seed` contract."""
+    x = np.ones((50, 50), np.float32)
+    sym = mx.sym.Dropout(data=mx.sym.Variable("data"), p=0.5)
+
+    def run():
+        mx.random.seed(7)
+        exe = sym.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+        exe.arg_dict["data"][:] = x
+        return exe.forward(is_train=True)[0].asnumpy()
+
+    np.testing.assert_allclose(run(), run())
