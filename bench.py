@@ -34,8 +34,11 @@ def main():
         from mxnet_tpu.base import bfloat16 as dtype  # ml_dtypes bfloat16
 
     net = models.get_resnet(num_classes=1000, num_layers=50)
-    mesh = make_mesh(axis_names=("data",))
-    n_dev = mesh.devices.size
+    # use the largest device count that divides the batch (a 4-image debug
+    # batch on the 8-device CPU mesh must not fault)
+    n_avail = len(jax.devices())
+    n_dev = next(k for k in range(n_avail, 0, -1) if batch % k == 0)
+    mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
     trainer = SPMDTrainer(
         net, mesh,
         data_shapes={"data": (batch, 3, image, image),

@@ -8,3 +8,4 @@ from .vgg import get_vgg
 from .inception_bn import get_inception_bn
 from .resnet import get_resnet
 from .lstm import lstm_unroll, lstm_cell
+from .transformer import get_transformer_lm, transformer_block

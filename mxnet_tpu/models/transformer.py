@@ -1,0 +1,102 @@
+"""Decoder-only transformer LM — the TPU-era flagship sequence model.
+
+The reference's sequence workloads were unrolled LSTMs
+(`example/rnn/lstm.py`, `example/model-parallel-lstm/lstm.py`); this is
+their modern counterpart and the workload that exercises the long-context
+machinery: the fused `DotProductAttention` op (Pallas flash attention on
+TPU) and, under `SPMDTrainer`, ring / Ulysses sequence parallelism
+(`mxnet_tpu/parallel/sequence.py`).
+
+Pre-LN GPT-style blocks.  All projections run as (batch*seq, embed) matmuls
+so XLA tiles them onto the MXU in one pass per layer.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def _proj(x_flat, name, num_hidden, weight=None, bias=None):
+    kwargs = {}
+    if weight is not None:
+        kwargs["weight"] = weight
+    if bias is not None:
+        kwargs["bias"] = bias
+    return sym.FullyConnected(data=x_flat, num_hidden=num_hidden,
+                              name=name, **kwargs)
+
+
+def transformer_block(x, name, seq_len, num_heads, num_embed,
+                      num_ffn_hidden, dropout=0.0, causal=True):
+    """One pre-LN block.  x: (batch, seq, embed) symbol."""
+    head_dim = num_embed // num_heads
+
+    # --- attention sublayer ---
+    h = sym.LayerNorm(data=x, name=name + "_ln1")
+    hf = sym.Reshape(data=h, shape=(-1, num_embed), name=name + "_ln1_flat")
+
+    def heads(role):
+        p = _proj(hf, "%s_%s" % (name, role), num_embed)
+        p = sym.Reshape(data=p, shape=(-1, seq_len, num_heads, head_dim),
+                        name="%s_%s_split" % (name, role))
+        return sym.transpose(p, axes=(0, 2, 1, 3),
+                             name="%s_%s_t" % (name, role))
+
+    attn = sym.DotProductAttention(
+        query=heads("q"), key=heads("k"), value=heads("v"),
+        causal=causal, name=name + "_attn")
+    attn = sym.transpose(attn, axes=(0, 2, 1, 3), name=name + "_attn_t")
+    attn = sym.Reshape(data=attn, shape=(-1, num_embed),
+                       name=name + "_attn_merge")
+    attn = _proj(attn, name + "_attn_out", num_embed)
+    if dropout > 0.0:
+        attn = sym.Dropout(data=attn, p=dropout, name=name + "_attn_drop")
+    attn = sym.Reshape(data=attn, shape=(-1, seq_len, num_embed),
+                       name=name + "_attn_unflat")
+    x = x + attn
+
+    # --- feed-forward sublayer ---
+    h = sym.LayerNorm(data=x, name=name + "_ln2")
+    hf = sym.Reshape(data=h, shape=(-1, num_embed), name=name + "_ln2_flat")
+    ffn = _proj(hf, name + "_ffn1", num_ffn_hidden)
+    ffn = sym.Activation(data=ffn, act_type="gelu", name=name + "_gelu")
+    ffn = _proj(ffn, name + "_ffn2", num_embed)
+    if dropout > 0.0:
+        ffn = sym.Dropout(data=ffn, p=dropout, name=name + "_ffn_drop")
+    ffn = sym.Reshape(data=ffn, shape=(-1, seq_len, num_embed),
+                      name=name + "_ffn_unflat")
+    return x + ffn
+
+
+def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
+                       num_embed=128, num_ffn_hidden=None, dropout=0.0,
+                       causal=True):
+    """Decoder-only LM.  data: (batch, seq) token ids; softmax_label:
+    (batch, seq) next-token ids.  Loss rows are position-major like the
+    reference's unrolled-LSTM head (`example/rnn/lstm.py:102-104`) is
+    batch-major — here rows stay (batch*seq, vocab) with labels reshaped to
+    match."""
+    if num_embed % num_heads != 0:
+        raise ValueError("num_embed must be divisible by num_heads")
+    if num_ffn_hidden is None:
+        num_ffn_hidden = 4 * num_embed
+
+    data = sym.Variable("data")
+    embed = sym.Embedding(data=data, input_dim=vocab_size,
+                          output_dim=num_embed, name="embed")
+    pos_weight = sym.Variable("pos_embed_weight",
+                              shape=(1, seq_len, num_embed))
+    x = sym.broadcast_plus(embed, pos_weight, name="pos_add")
+    if dropout > 0.0:
+        x = sym.Dropout(data=x, p=dropout, name="embed_drop")
+
+    for i in range(num_layers):
+        x = transformer_block(x, "layer%d" % i, seq_len, num_heads,
+                              num_embed, num_ffn_hidden, dropout=dropout,
+                              causal=causal)
+
+    x = sym.LayerNorm(data=x, name="final_ln")
+    xf = sym.Reshape(data=x, shape=(-1, num_embed), name="final_flat")
+    logits = sym.FullyConnected(data=xf, num_hidden=vocab_size, name="pred")
+    label = sym.Variable("softmax_label")
+    label_flat = sym.Reshape(data=label, shape=(-1,), name="label_flat")
+    return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")

@@ -412,17 +412,21 @@ def save(fname, data):
 
 def load(fname):
     """Load NDArrays saved by :func:`save` -> list or dict (`MXNDArrayLoad`)."""
-    with open(fname, "rb") as f:
-        magic, _ = struct.unpack("<QQ", f.read(16))
-        if magic != _LIST_MAGIC:
-            raise MXNetError("invalid NDArray file (bad magic)")
-        (n,) = struct.unpack("<Q", f.read(8))
-        arrays = [_load_array(f) for _ in range(n)]
-        (nn,) = struct.unpack("<Q", f.read(8))
-        names = []
-        for _ in range(nn):
-            (ln,) = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode("utf-8"))
+    try:
+        with open(fname, "rb") as f:
+            magic, _ = struct.unpack("<QQ", f.read(16))
+            if magic != _LIST_MAGIC:
+                raise MXNetError("invalid NDArray file (bad magic)")
+            (n,) = struct.unpack("<Q", f.read(8))
+            arrays = [_load_array(f) for _ in range(n)]
+            (nn,) = struct.unpack("<Q", f.read(8))
+            names = []
+            for _ in range(nn):
+                (ln,) = struct.unpack("<Q", f.read(8))
+                names.append(f.read(ln).decode("utf-8"))
+    except (struct.error, UnicodeDecodeError, ValueError, EOFError) as e:
+        raise MXNetError(
+            "corrupt or truncated NDArray file %r: %s" % (fname, e))
     if names:
         if len(names) != len(arrays):
             raise MXNetError("corrupt NDArray file: name/array count mismatch")

@@ -16,6 +16,7 @@ from . import elementwise  # noqa: F401  (registers ops)
 from . import nn  # noqa: F401
 from . import tensor  # noqa: F401
 from . import loss  # noqa: F401
+from . import attention  # noqa: F401
 from .registry import OpCtx, OpDef, Param, get, list_ops, register
 
 

@@ -1,0 +1,107 @@
+"""Attention-family operators (TPU-era additions to the op set).
+
+The reference predates attention; the long-context mandate of this rebuild
+makes it a first-class op family instead of a composed graph of batch_dot +
+Softmax (which would materialize the S x S score matrix in HBM).  The op
+lowers to the fused Pallas flash kernel on TPU
+(`mxnet_tpu/ops/pallas_kernels/flash_attention.py`) and to a blockwise
+lax.scan elsewhere; sequence-parallel variants live in
+`mxnet_tpu/parallel/sequence.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import OpDef, Param, register
+from .pallas_kernels import flash_attention
+
+
+class DotProductAttention(OpDef):
+    """Fused scaled-dot-product attention on (batch, heads, seq, head_dim).
+
+    softmax(Q K^T * scale) V without materializing the score matrix.
+    ``scale`` defaults to 1/sqrt(head_dim); ``causal=True`` applies a lower
+    triangular mask (positions attend to themselves and the past).
+    """
+
+    name = "DotProductAttention"
+    params = {
+        "causal": Param(bool, default=False),
+        "scale": Param(float, default=None),
+        "block_q": Param(int, default=128),
+        "block_k": Param(int, default=128),
+    }
+
+    def list_arguments(self, params):
+        return ["query", "key", "value"]
+
+    def infer_shape(self, params, in_shapes):
+        q, k, v = in_shapes
+        if k is None and v is not None:
+            k = v
+        if v is None and k is not None:
+            v = k
+        for name, s in (("query", q), ("key", k), ("value", v)):
+            if s is not None and len(s) != 4:
+                raise MXNetError(
+                    "DotProductAttention: %s must be (batch, heads, seq, "
+                    "head_dim), got %s" % (name, s))
+        if k is not None and v is not None and k != v:
+            raise MXNetError(
+                "DotProductAttention: key %s and value %s must match"
+                % (k, v))
+        if q is not None and k is not None and (
+                q[0] != k[0] or q[1] != k[1] or q[3] != k[3]):
+            raise MXNetError(
+                "DotProductAttention: query %s and key %s must agree on "
+                "(batch, heads, head_dim)" % (q, k))
+        out = None
+        if q is not None:
+            out = tuple(q)
+        return [q, k, v], [out], []
+
+    def apply(self, octx, params, inputs, aux):
+        q, k, v = inputs
+        out = flash_attention(
+            q, k, v,
+            causal=params["causal"],
+            scale=params["scale"],
+            block_q=params["block_q"],
+            block_k=params["block_k"],
+        )
+        return [out], []
+
+
+register(DotProductAttention, aliases=("Attention",))
+
+
+class LayerNorm(OpDef):
+    """Layer normalization over the last axis (transformer-era counterpart
+    of `src/operator/batch_norm-inl.h`; no running stats, so it is SPMD- and
+    scan-friendly)."""
+
+    name = "LayerNorm"
+    params = {"eps": Param(float, default=1e-5)}
+
+    def list_arguments(self, params):
+        return ["data", "gamma", "beta"]
+
+    def infer_shape(self, params, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        c = (d[-1],)
+        return [d, c, c], [d], []
+
+    def apply(self, octx, params, inputs, aux):
+        x, gamma, beta = inputs
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        xn = (x - mean) * jax.lax.rsqrt(var + params["eps"])
+        shape = (1,) * (x.ndim - 1) + (-1,)
+        return [xn * gamma.reshape(shape) + beta.reshape(shape)], []
+
+
+register(LayerNorm)

@@ -141,6 +141,42 @@ class _BatchDot(OpDef):
 register(_BatchDot)
 
 
+class _BroadcastBinary(OpDef):
+    """Numpy-broadcasting binary op (later-mxnet `broadcast_*` family;
+    needed e.g. to add positional embeddings to a (batch, seq, embed)
+    activation)."""
+
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+        self.params = {}
+
+    def list_arguments(self, params):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, params, in_shapes):
+        a, b = in_shapes
+        if a is None or b is None:
+            return in_shapes, [None], []
+        try:
+            out = tuple(np.broadcast_shapes(a, b))
+        except ValueError:
+            raise MXNetError(
+                "%s: shapes %s and %s do not broadcast" % (self.name, a, b))
+        return [a, b], [out], []
+
+    def apply(self, octx, params, inputs, aux):
+        return [self._fn(inputs[0], inputs[1])], []
+
+
+register(_BroadcastBinary("broadcast_plus", jnp.add),
+         aliases=("broadcast_add",))
+register(_BroadcastBinary("broadcast_minus", jnp.subtract),
+         aliases=("broadcast_sub",))
+register(_BroadcastBinary("broadcast_mul", jnp.multiply))
+register(_BroadcastBinary("broadcast_div", jnp.divide))
+
+
 # -- reductions (broadcast_reduce_op-inl.h:143-181) ----------------------
 
 

@@ -50,6 +50,8 @@ class Activation(OpDef):
         "sigmoid": jax.nn.sigmoid,
         "tanh": jnp.tanh,
         "softrelu": jax.nn.softplus,
+        # TPU-era addition (transformers); not in the reference op set.
+        "gelu": jax.nn.gelu,
     }
 
     def apply(self, octx, params, inputs, aux):

@@ -1,0 +1,14 @@
+"""Hand-written TPU Pallas kernels for the hot ops.
+
+The reference's hot path was mshadow expression templates + cuDNN
+(`src/operator/fully_connected-inl.h`, `cudnn_convolution-inl.h`).  On TPU
+XLA already fuses elementwise chains into matmuls/convs; these kernels cover
+the cases where explicit VMEM blocking beats XLA's default schedule —
+attention above all (the S x S score matrix must never touch HBM).
+
+Every kernel has a pure-jnp blockwise fallback with identical math, used on
+non-TPU backends (the 8-device CPU test mesh) and as the reference in tests.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

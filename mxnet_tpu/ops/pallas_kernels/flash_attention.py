@@ -1,0 +1,319 @@
+"""Flash attention: fused blockwise softmax(Q K^T) V.
+
+TPU-native replacement for what the reference era did with full S x S
+score materialization (there is no attention op in the reference — this is
+part of the long-context mandate).  Design:
+
+* **Forward, TPU**: a Pallas kernel.  Grid = (batch, heads, Sq/block_q); each
+  program holds one Q block in VMEM and streams K/V blocks from the full
+  (per-head) K/V, maintaining the online-softmax recurrence
+  (m, l, acc) so the S x S matrix never exists.  Scores accumulate in
+  float32 on the MXU (`preferred_element_type`).  For causal masks the
+  K-block loop is truncated at the diagonal (the diagonal position is
+  computed from the q/k position offsets, so the same kernel serves ring
+  attention where the offsets are traced per-device values).
+* **Forward, non-TPU**: the same recurrence as a `lax.scan` over K blocks —
+  identical math, used on the CPU test mesh.
+* **Backward (both)**: flash-style recompute from the saved
+  (q, k, v, o, lse) residuals, as a scan over K blocks:
+  memory is O(S * block_k), never O(S^2).  The lse output's cotangent is
+  propagated (d lse_i / d s_ij = p_ij), so ring attention's
+  lse-weighted combination differentiates exactly.
+
+`q_offset`/`k_offset` give the global position of row/col 0 for causal
+masking: a query at global position q_offset+i attends to keys at global
+positions <= q_offset+i.  They may be traced scalars (ring attention
+passes `axis_index * shard_len`).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _use_pallas(q):
+    if jax.default_backend() != "tpu":
+        return False
+    # Pallas path wants the blocked dims tile-aligned; the wrapper pads S,
+    # but tiny head_dim is better served by XLA.
+    return q.shape[-1] >= 32
+
+
+try:  # pallas is TPU-only in some builds; import lazily and gate on backend
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale, causal, block_q, block_k, kv_len):
+    # q_ref: (1, 1, block_q, D); k_ref/v_ref: (1, 1, Skv_padded, D)
+    qi = pl.program_id(2)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32) * scale           # (bq, D)
+    bq, d = q.shape
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    num_kb = pl.cdiv(kv_len, block_k)
+    if causal:
+        # K blocks whose every key position exceeds the last query position
+        # of this block contribute nothing: key j is visible iff
+        # k_off + j <= q_off + i, max i = (qi+1)*block_q - 1.
+        last_q = q_off + (qi + 1) * block_q - 1
+        hi = (last_q - k_off) // block_k + 1
+        num_kb = jnp.clip(hi, 0, num_kb)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                   # (bq, bk)
+        q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_rel < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
+                      block_q, block_k):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=skv)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+            pl.BlockSpec((1, 1, skv_p, d), lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, skv_p, d), lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, k_, qo, ko: (i, j, k_)),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * sq_p * skv_p * d,
+            bytes_accessed=(qp.size + kp.size + vp.size) * qp.dtype.itemsize,
+            transcendentals=b * h * sq_p * skv_p,
+        ),
+    )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
+      qp, kp, vp)
+    if pad_q:
+        out, lse = out[:, :, :sq], lse[:, :, :sq]
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# jnp blockwise fallback (same online-softmax recurrence)
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_jnp(q, k, v, q_off, k_off, scale, causal, block_k):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_k = min(block_k, skv)
+    pad_k = (-skv) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    num_kb = (skv + pad_k) // block_k
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32).reshape(b, h, num_kb, block_k, d)
+    vf = v.astype(jnp.float32).reshape(b, h, num_kb, block_k, d)
+    q_pos = q_off + jnp.arange(sq)[:, None]
+
+    def body(carry, xs):
+        m, l, acc = carry
+        kb, k_blk, v_blk = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk)
+        k_rel = kb * block_k + jnp.arange(block_k)[None, :]
+        mask = k_rel < skv
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        return (m_new, l, acc), None
+
+    # derive the initial carry from q (not fresh constants) so its
+    # varying-manual-axes type matches the body output under shard_map
+    acc0 = qf * 0.0
+    m0 = acc0[..., 0] + _NEG_INF
+    l0 = acc0[..., 0]
+    (m, l, acc), _ = lax.scan(
+        body, (m0, l0, acc0),
+        (jnp.arange(num_kb),
+         jnp.moveaxis(kf, 2, 0), jnp.moveaxis(vf, 2, 0)))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward: flash-style recompute, scan over K blocks
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd(scale, causal, block_k, res, grads):
+    q, k, v, o, lse, q_off, k_off = res
+    g, glse = grads  # cotangents of (out, lse)
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_k = min(block_k, skv)
+    pad_k = (-skv) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    num_kb = (skv + pad_k) // block_k
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    glse_f = glse.astype(jnp.float32)
+    kf = k.astype(jnp.float32).reshape(b, h, num_kb, block_k, d)
+    vf = v.astype(jnp.float32).reshape(b, h, num_kb, block_k, d)
+    # dL/ds_ij = p_ij * (dp_ij - delta_i) from the out cotangent plus
+    # p_ij * glse_i from the lse cotangent (d lse_i / d s_ij = p_ij).
+    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1) - glse_f  # (b,h,sq)
+    q_pos = q_off + jnp.arange(sq)[:, None]
+
+    def body(dq, xs):
+        kb, k_blk, v_blk = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk) * scale
+        k_rel = kb * block_k + jnp.arange(block_k)[None, :]
+        mask = k_rel < skv
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                       # (b,h,q,k)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = qf * 0.0  # see forward: carry type must match under shard_map
+    dq, (dk_blks, dv_blks) = lax.scan(
+        body, dq0,
+        (jnp.arange(num_kb),
+         jnp.moveaxis(kf, 2, 0), jnp.moveaxis(vf, 2, 0)))
+    dk = jnp.moveaxis(dk_blks, 0, 2).reshape(b, h, skv + pad_k, d)
+    dv = jnp.moveaxis(dv_blks, 0, 2).reshape(b, h, skv + pad_k, d)
+    if pad_k:
+        dk, dv = dk[:, :, :skv], dv[:, :, :skv]
+    # zero tangents derived from the offsets themselves so their
+    # varying-manual-axes type matches under shard_map
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            (q_off * 0).astype(jnp.float32), (k_off * 0).astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
+    qo = jnp.asarray(q_off, jnp.int32)
+    ko = jnp.asarray(k_off, jnp.int32)
+    if _HAS_PALLAS and _use_pallas(q):
+        return _flash_fwd_pallas(q, k, v, qo, ko, scale, causal,
+                                 block_q, block_k)
+    return _flash_fwd_jnp(q, k, v, qo, ko, scale, causal, block_k)
+
+
+def _flash_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
+    out, lse = _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k)
+    qo = jnp.asarray(q_off, jnp.int32)
+    ko = jnp.asarray(k_off, jnp.int32)
+    return (out, lse), (q, k, v, out, lse, qo, ko)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, grads):
+    return _flash_bwd(scale, causal, block_k, res, grads)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None,
+                    q_offset=0.0, k_offset=0.0,
+                    block_q=128, block_k=128, with_lse=False):
+    """Fused attention over (batch, heads, seq, head_dim) arrays.
+
+    ``scale`` defaults to 1/sqrt(head_dim).  ``q_offset``/``k_offset`` are
+    the global positions of row/col 0 for causal masking (may be traced;
+    passed as floats so gradients flow cleanly through `custom_vjp`).
+    Returns the attention output; with ``with_lse=True`` also returns the
+    per-row logsumexp of the scaled scores (float32, (batch, heads, seq))
+    for cross-device combination (see `parallel/sequence.py`).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError("flash_attention expects (B, H, S, D) inputs")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    q_off = jnp.asarray(q_offset, jnp.float32)
+    k_off = jnp.asarray(k_offset, jnp.float32)
+    out, lse = _flash(q, k, v, q_off, k_off, float(scale), bool(causal),
+                      int(block_q), int(block_k))
+    return (out, lse) if with_lse else out

@@ -18,6 +18,7 @@ visualization tooling carry over.
 """
 from __future__ import annotations
 
+import ast
 import json
 
 import numpy as np
@@ -221,6 +222,10 @@ class Symbol:
             for node in order:
                 if node.is_variable:
                     s = var_shape.get(node.name)
+                    if s is None and node.attrs.get("__shape__"):
+                        # shape hint given at Variable() creation time
+                        s = check_shape(ast.literal_eval(node.attrs["__shape__"]))
+                        var_shape[node.name] = s
                     if entry_shape.get((id(node), 0)) != s:
                         entry_shape[(id(node), 0)] = s
                         changed = True
@@ -391,7 +396,8 @@ def Variable(name, attr=None, shape=None, **kwargs):
         raise TypeError("Variable name must be a string")
     attrs = attribute.current().get(attr)
     if shape is not None:
-        attrs["__shape__"] = str(tuple(shape))
+        # normalize (numpy ints etc.) so ast.literal_eval can parse it back
+        attrs["__shape__"] = str(tuple(int(d) for d in shape))
     for k, v in kwargs.items():
         if k in ("lr_mult", "wd_mult"):
             attrs["__%s__" % k] = str(v)

@@ -1,15 +1,26 @@
-"""Test configuration: force an 8-device CPU platform BEFORE jax imports.
+"""Test configuration: force an 8-device CPU platform.
 
 This is the TPU build's version of the reference's hardware fakes (SURVEY §4):
 multi-device logic (DP executor groups, mesh sharding, model parallelism)
 runs on 8 virtual CPU devices, the same way the reference tested
 model-parallel code on cpu(0)/cpu(1).
+
+NOTE: the environment's ``sitecustomize`` imports jax and registers the real
+TPU platform at interpreter startup, so setting ``JAX_PLATFORMS`` in
+``os.environ`` here is already too late — and initializing the TPU from a
+test process blocks on the (single-tenant) device tunnel.
+``jax.config.update`` still works after import; XLA_FLAGS is read at first
+backend init, which has not happened yet at conftest time.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
