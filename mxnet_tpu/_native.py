@@ -1,0 +1,103 @@
+"""ctypes binding of the native runtime (`native/libmxtpu.so`).
+
+The reference exposed its C++ core through a C ABI consumed by ctypes
+(`python/mxnet/base.py`); this module is the same boundary for the TPU
+build's native pieces: host dependency engine, recordio, threaded batch
+loader.  Everything degrades gracefully: `LIB` is None when the library is
+not built and callers fall back to the pure-Python implementations.
+
+Build: ``make -C native`` at the repo root (no external deps).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from .base import MXNetError
+
+_FN_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _find_lib():
+    cands = []
+    env = os.environ.get("MXNET_TPU_NATIVE_LIB")
+    if env:
+        cands.append(env)
+    here = os.path.dirname(os.path.abspath(__file__))
+    cands.append(os.path.join(here, "..", "native", "libmxtpu.so"))
+    cands.append(os.path.join(here, "libmxtpu.so"))
+    for c in cands:
+        if c and os.path.exists(c):
+            return c
+    return None
+
+
+def _load():
+    path = _find_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    H = ctypes.c_int64
+    lib.mxtpu_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_engine_create.restype = H
+    lib.mxtpu_engine_create.argtypes = [ctypes.c_int]
+    lib.mxtpu_engine_destroy.argtypes = [H]
+    lib.mxtpu_var_create.restype = H
+    lib.mxtpu_var_create.argtypes = [H]
+    lib.mxtpu_var_delete.argtypes = [H, H]
+    lib.mxtpu_push.restype = ctypes.c_int
+    lib.mxtpu_push.argtypes = [H, _FN_T, ctypes.c_void_p,
+                               ctypes.POINTER(H), ctypes.c_int,
+                               ctypes.POINTER(H), ctypes.c_int,
+                               ctypes.c_int]
+    lib.mxtpu_wait_for_var.argtypes = [H, H]
+    lib.mxtpu_wait_all.argtypes = [H]
+    lib.mxtpu_engine_num_executed.restype = ctypes.c_int64
+    lib.mxtpu_engine_num_executed.argtypes = [H]
+
+    lib.mxtpu_recio_writer_open.restype = H
+    lib.mxtpu_recio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_recio_write.restype = ctypes.c_int
+    lib.mxtpu_recio_write.argtypes = [H, ctypes.c_void_p, ctypes.c_uint64]
+    lib.mxtpu_recio_writer_close.argtypes = [H]
+    lib.mxtpu_recio_reader_open.restype = H
+    lib.mxtpu_recio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                            ctypes.c_int]
+    lib.mxtpu_recio_read.restype = ctypes.c_void_p
+    lib.mxtpu_recio_read.argtypes = [H, ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxtpu_recio_reader_seek0.argtypes = [H]
+    lib.mxtpu_recio_reader_close.argtypes = [H]
+
+    lib.mxtpu_loader_open.restype = H
+    lib.mxtpu_loader_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_uint64, ctypes.c_int,
+                                      ctypes.c_int]
+    lib.mxtpu_loader_next.restype = ctypes.c_int
+    lib.mxtpu_loader_next.argtypes = [H, ctypes.POINTER(ctypes.c_float),
+                                      ctypes.POINTER(ctypes.c_float)]
+    lib.mxtpu_loader_reset.argtypes = [H]
+    lib.mxtpu_loader_close.argtypes = [H]
+    return lib
+
+
+LIB = _load()
+
+
+def available() -> bool:
+    return LIB is not None
+
+
+def last_error() -> str:
+    if LIB is None:
+        return "native library not built (make -C native)"
+    return LIB.mxtpu_last_error().decode("utf-8", "replace")
+
+
+def check(cond, ctx=""):
+    if not cond:
+        raise MXNetError("native runtime error%s: %s"
+                         % ((" (%s)" % ctx) if ctx else "", last_error()))

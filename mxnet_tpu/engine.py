@@ -268,6 +268,130 @@ class NaiveEngine(Engine):
         pass
 
 
+class NativeVar:
+    """Variable handle owned by the native engine (C++ `ThreadedVar`)."""
+
+    __slots__ = ("handle", "_eng")
+
+    def __init__(self, eng):
+        self._eng = eng
+        self.handle = eng._lib.mxtpu_var_create(eng._handle)
+
+    def __del__(self):
+        try:
+            if self.handle and self._eng._handle:
+                self._eng._lib.mxtpu_var_delete(self._eng._handle, self.handle)
+        except Exception:
+            pass
+
+
+class NativeEngine:
+    """C++ dependency engine (`native/engine.cc`) behind the same API.
+
+    The scheduler, var bookkeeping and worker pool run in native threads
+    (the reference's architecture, `src/engine/threaded_engine.cc`); Python
+    callables are invoked from those threads via a ctypes trampoline.
+    Select with ``MXNET_ENGINE_TYPE=NativeEngine`` (requires
+    ``make -C native``).
+    """
+
+    def __init__(self, num_workers=None):
+        from . import _native
+        if not _native.available():
+            raise MXNetError(
+                "native engine requested but native/libmxtpu.so is not "
+                "built; run `make -C native`")
+        self._lib = _native.LIB
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4"))
+        self._handle = self._lib.mxtpu_engine_create(num_workers)
+        self._lock = threading.Lock()
+        self._exceptions = []
+        self._callbacks = {}  # token -> callable (kept alive until run)
+        self._tokens = itertools.count(1)
+
+        def _trampoline(arg):
+            token = int(arg)
+            with self._lock:
+                fn = self._callbacks.pop(token, None)
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception as e:  # surfaced at next sync point
+                with self._lock:
+                    self._exceptions.append(e)
+
+        self._c_trampoline = _native._FN_T(_trampoline)  # keep alive
+
+    def new_variable(self):
+        return NativeVar(self)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             name="opr"):
+        import ctypes
+        const_vars = list(const_vars)
+        mutable_vars = list(mutable_vars)
+        mset = set(id(v) for v in mutable_vars)
+        if len(mset) != len(mutable_vars):
+            raise MXNetError("duplicate variables in mutable_vars")
+        if any(id(v) in mset for v in const_vars):
+            raise MXNetError("const_vars and mutable_vars overlap")
+        token = next(self._tokens)
+        with self._lock:
+            self._callbacks[token] = fn
+        H = ctypes.c_int64
+        cv = (H * max(1, len(const_vars)))(*[v.handle for v in const_vars])
+        mv = (H * max(1, len(mutable_vars)))(*[v.handle for v in mutable_vars])
+        rc = self._lib.mxtpu_push(
+            self._handle, self._c_trampoline, ctypes.c_void_p(token),
+            cv, len(const_vars), mv, len(mutable_vars), priority)
+        if rc != 0:
+            from . import _native
+            with self._lock:
+                self._callbacks.pop(token, None)
+            raise MXNetError("native push failed: %s" % _native.last_error())
+
+    def push_sync(self, fn, const_vars=(), mutable_vars=(), priority=0,
+                  name="opr"):
+        done = threading.Event()
+        box = {}
+
+        def run():
+            try:
+                box["v"] = fn()
+            finally:
+                done.set()
+
+        self.push(run, const_vars, mutable_vars, priority, name)
+        done.wait()
+        self._raise_pending()
+        return box.get("v")
+
+    def wait_for_var(self, var):
+        self._lib.mxtpu_wait_for_var(self._handle, var.handle)
+        self._raise_pending()
+
+    def wait_for_all(self):
+        self._lib.mxtpu_wait_all(self._handle)
+        self._raise_pending()
+
+    def num_executed(self):
+        return self._lib.mxtpu_engine_num_executed(self._handle)
+
+    def shutdown(self):
+        if self._handle:
+            self._lib.mxtpu_engine_destroy(self._handle)
+            self._handle = 0
+
+    def _raise_pending(self):
+        with self._lock:
+            if self._exceptions:
+                exc = self._exceptions[0]
+                self._exceptions.clear()
+                raise exc
+
+
 _engine = None
 _engine_lock = threading.Lock()
 
@@ -283,7 +407,12 @@ def get() -> Engine:
     with _engine_lock:
         if _engine is None:
             etype = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
-            _engine = NaiveEngine() if etype == "NaiveEngine" else Engine()
+            if etype == "NaiveEngine":
+                _engine = NaiveEngine()
+            elif etype == "NativeEngine":
+                _engine = NativeEngine()
+            else:
+                _engine = Engine()
         return _engine
 
 

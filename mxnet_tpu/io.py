@@ -379,3 +379,155 @@ class PrefetchingIter(DataIter):
             label=sum([b.label for b in batches], []),
             pad=batches[0].pad,
         )
+
+
+class ImageRecordIter(DataIter):
+    """Batches from a recordio pack (reference `ImageRecordIter`,
+    `src/io/iter_image_recordio.cc`): sharded reading via
+    part_index/num_parts, multi-threaded decode, prefetching.
+
+    Records are IRHeader + raw .npy payloads (`recordio.pack_img`).  When
+    `native/libmxtpu.so` is built the C++ threaded loader
+    (`native/loader.cc`) does read+decode+batch off the Python thread; the
+    pure-Python fallback decodes inline.  Augmentations (crop/mirror) of
+    the reference run on-device in this build — random crops/flips vectorize
+    far better as jax ops inside the input pipeline than per-image host
+    loops.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 part_index=0, num_parts=1, preprocess_threads=4,
+                 prefetch_buffer=4, data_name="data",
+                 label_name="softmax_label", use_native=None):
+        super().__init__()
+        from . import _native
+        from . import recordio as _recordio
+
+        self.batch_size = batch_size
+        self._data_shape = tuple(int(x) for x in check_shape(data_shape))
+        self._sample_len = int(np.prod(self._data_shape))
+        self._path = path_imgrec
+        self._part_index = part_index
+        self._num_parts = num_parts
+        self._data_name = data_name
+        self._label_name = label_name
+        if use_native is None:
+            use_native = _native.available()
+        self._native = bool(use_native) and _native.available()
+        if self._native:
+            self._lib = _native.LIB
+            self._handle = self._lib.mxtpu_loader_open(
+                path_imgrec.encode(), part_index, num_parts, batch_size,
+                self._sample_len, preprocess_threads, prefetch_buffer)
+            _native.check(self._handle != 0, "loader_open")
+            import ctypes
+            self._data_buf = np.zeros((batch_size,) + self._data_shape,
+                                      np.float32)
+            self._label_buf = np.zeros((batch_size,), np.float32)
+            self._data_ptr = self._data_buf.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_float))
+            self._label_ptr = self._label_buf.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_float))
+        else:
+            self._recordio_mod = _recordio
+            self._f = open(path_imgrec, "rb")
+            self._f.seek(0, 2)
+            fsize = self._f.tell()
+            chunk = fsize // num_parts
+            raw_begin = chunk * part_index
+            self._end = fsize if part_index == num_parts - 1 \
+                else chunk * (part_index + 1)
+            self._begin = 0 if part_index == 0 \
+                else self._resync(raw_begin, fsize)
+            self._f.seek(self._begin)
+
+    @property
+    def provide_data(self):
+        return [(self._data_name, (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [(self._label_name, (self.batch_size,))]
+
+    def _resync(self, pos, fsize):
+        """Scan to the next record magic at 4-byte alignment (the byte-range
+        shard boundary rule shared with `native/recordio.cc` Resync)."""
+        magic = struct.pack("<I", 0xCED7230A)
+        pos = (pos + 3) & ~3
+        while pos + 8 <= fsize:
+            self._f.seek(pos)
+            head = self._f.read(8)
+            if head[:4] == magic:
+                ln = struct.unpack("<I", head[4:])[0] & ((1 << 29) - 1)
+                if pos + 8 + ln <= fsize:
+                    return pos
+            pos += 4
+        return fsize
+
+    def _read_record(self):
+        pos = self._f.tell()
+        if pos >= self._end:
+            return None
+        head = self._f.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != 0xCED7230A:
+            raise MXNetError("bad record magic in %s" % self._path)
+        ln = lrec & ((1 << 29) - 1)
+        buf = self._f.read(ln)
+        pad = (4 - ln % 4) % 4
+        if pad:
+            self._f.read(pad)
+        return buf
+
+    def reset(self):
+        if self._native:
+            self._lib.mxtpu_loader_reset(self._handle)
+        else:
+            self._f.seek(self._begin)
+
+    def next(self):
+        if self._native:
+            n = self._lib.mxtpu_loader_next(self._handle, self._data_ptr,
+                                            self._label_ptr)
+            if n <= 0:
+                raise StopIteration
+            return DataBatch(
+                data=[array(self._data_buf.copy())],
+                label=[array(self._label_buf.copy())],
+                pad=self.batch_size - n,
+                provide_data=self.provide_data,
+                provide_label=self.provide_label,
+            )
+        # ---- pure-python fallback ----
+        data = np.zeros((self.batch_size,) + self._data_shape, np.float32)
+        label = np.zeros((self.batch_size,), np.float32)
+        n = 0
+        while n < self.batch_size:
+            buf = self._read_record()
+            if buf is None:
+                break
+            header, img = self._recordio_mod.unpack_img(buf)
+            data[n] = np.asarray(img, np.float32).reshape(self._data_shape)
+            label[n] = header.label
+            n += 1
+        if n == 0:
+            raise StopIteration
+        return DataBatch(
+            data=[array(data)], label=[array(label)],
+            pad=self.batch_size - n,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label,
+        )
+
+    def close(self):
+        if self._native and self._handle:
+            self._lib.mxtpu_loader_close(self._handle)
+            self._handle = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,276 @@
+/*
+ * Threaded prefetching batch loader.
+ *
+ * Counterpart of the reference's decode+batch pipeline
+ * (`src/io/iter_image_recordio.cc` OMP decode, `src/io/iter_batchloader.h`,
+ * `src/io/iter_prefetcher.h` ThreadedIter double-buffering): a producer
+ * thread streams records from a (sharded) recordio pack, decodes the
+ * IRHeader+npy payloads with a small worker pool, assembles fixed-size
+ * float32 batches, and keeps `prefetch` batches ready ahead of the
+ * consumer.  The consumer (`mxnet_tpu/io.py` RecordFileIter) copies into
+ * numpy and hands jax the host buffer — keeping HBM feeding off the
+ * Python thread.
+ *
+ * Payload format: IRHeader 'IfQQ' (flag, label, id, id2) followed by a raw
+ * .npy blob (see `mxnet_tpu/recordio.py` pack_img).  Supported dtypes:
+ * <f4, <f8, |u1, <i1, <i4, <i8 — converted to float32.
+ */
+#include "mxtpu.h"
+#include "error.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<float> data;
+  std::vector<float> label;
+  int n = 0;
+  bool epoch_end = false;
+};
+
+/* minimal .npy header parse: returns element count and a converter */
+bool ParseNpy(const char* buf, uint64_t len, uint64_t sample_len,
+              float* out, std::string* err) {
+  if (len < 10 || memcmp(buf, "\x93NUMPY", 6) != 0) {
+    *err = "payload is not a .npy blob";
+    return false;
+  }
+  int major = buf[6];
+  uint64_t hlen, hoff;
+  if (major == 1) {
+    uint16_t h;
+    memcpy(&h, buf + 8, 2);
+    hlen = h;
+    hoff = 10;
+  } else {
+    uint32_t h;
+    memcpy(&h, buf + 8, 4);
+    hlen = h;
+    hoff = 12;
+  }
+  if (hoff + hlen > len) { *err = "truncated npy header"; return false; }
+  std::string hdr(buf + hoff, hlen);
+  if (hdr.find("'fortran_order': True") != std::string::npos) {
+    *err = "fortran-order npy not supported";
+    return false;
+  }
+  auto dpos = hdr.find("'descr':");
+  if (dpos == std::string::npos) { *err = "npy: no descr"; return false; }
+  auto q1 = hdr.find('\'', dpos + 8);
+  auto q2 = hdr.find('\'', q1 + 1);
+  std::string descr = hdr.substr(q1 + 1, q2 - q1 - 1);
+  const char* body = buf + hoff + hlen;
+  uint64_t blen = len - hoff - hlen;
+
+  auto fill = [&](auto type_tag, uint64_t esize) -> bool {
+    using T = decltype(type_tag);
+    if (blen < sample_len * esize) {
+      *err = "npy payload smaller than sample_len";
+      return false;
+    }
+    const T* p = reinterpret_cast<const T*>(body);
+    for (uint64_t i = 0; i < sample_len; ++i) out[i] = (float)p[i];
+    return true;
+  };
+  if (descr == "<f4") return fill(float{}, 4);
+  if (descr == "<f8") return fill(double{}, 8);
+  if (descr == "|u1") return fill(uint8_t{}, 1);
+  if (descr == "|i1") return fill(int8_t{}, 1);
+  if (descr == "<i4") return fill(int32_t{}, 4);
+  if (descr == "<i8") return fill(int64_t{}, 8);
+  *err = "unsupported npy dtype " + descr;
+  return false;
+}
+
+class Loader {
+ public:
+  Loader(mxtpu_handle reader, int batch_size, uint64_t sample_len,
+         int n_threads, int prefetch)
+      : reader_(reader), batch_size_(batch_size), sample_len_(sample_len),
+        n_threads_(n_threads < 1 ? 1 : n_threads),
+        prefetch_(prefetch < 1 ? 1 : prefetch) {
+    Start();
+  }
+
+  ~Loader() {
+    Stop();
+    mxtpu_recio_reader_close(reader_);
+  }
+
+  int Next(float* data, float* label) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_cons_.wait(lk, [this] { return !queue_.empty(); });
+    Batch b = std::move(queue_.front());
+    queue_.pop_front();
+    cv_prod_.notify_one();
+    if (b.epoch_end) {
+      // keep returning 0 until reset
+      queue_.push_front(Batch{{}, {}, 0, true});
+      return 0;
+    }
+    memcpy(data, b.data.data(), b.data.size() * sizeof(float));
+    memcpy(label, b.label.data(), b.label.size() * sizeof(float));
+    return b.n;
+  }
+
+  void Reset() {
+    Stop();
+    mxtpu_recio_reader_seek0(reader_);
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      queue_.clear();
+    }
+    Start();
+  }
+
+ private:
+  void Start() {
+    stop_ = false;
+    producer_ = std::thread([this] { Produce(); });
+  }
+
+  void Stop() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_prod_.notify_all();
+    if (producer_.joinable()) producer_.join();
+  }
+
+  void Produce() {
+    std::vector<std::vector<char>> raw;
+    bool eof = false;
+    while (!eof) {
+      raw.clear();
+      for (int i = 0; i < batch_size_; ++i) {
+        uint64_t len = 0;
+        const void* rec = mxtpu_recio_read(reader_, &len);
+        if (!rec) { eof = true; break; }
+        raw.emplace_back((const char*)rec, (const char*)rec + len);
+      }
+      if (!raw.empty()) {
+        Batch b;
+        b.n = (int)raw.size();
+        b.data.assign((size_t)batch_size_ * sample_len_, 0.0f);
+        b.label.assign(batch_size_, 0.0f);
+        DecodeBatch(raw, &b);
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_prod_.wait(lk, [this] {
+          return stop_ || (int)queue_.size() < prefetch_;
+        });
+        if (stop_) return;
+        queue_.push_back(std::move(b));
+        cv_cons_.notify_one();
+      }
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    queue_.push_back(Batch{{}, {}, 0, true});
+    cv_cons_.notify_one();
+  }
+
+  void DecodeBatch(const std::vector<std::vector<char>>& raw, Batch* b) {
+    std::atomic<size_t> next{0};
+    auto work = [&] {
+      for (;;) {
+        size_t i = next.fetch_add(1);
+        if (i >= raw.size()) return;
+        DecodeOne(raw[i], b, (int)i);
+      }
+    };
+    if (n_threads_ <= 1 || raw.size() <= 1) {
+      work();
+      return;
+    }
+    std::vector<std::thread> pool;
+    int nt = std::min<int>(n_threads_, (int)raw.size());
+    for (int t = 0; t < nt - 1; ++t) pool.emplace_back(work);
+    work();
+    for (auto& t : pool) t.join();
+  }
+
+  void DecodeOne(const std::vector<char>& rec, Batch* b, int slot) {
+    // IRHeader 'IfQQ': u32 flag, f32 label, u64 id, u64 id2 (24 bytes)
+    if (rec.size() < 24) return;
+    float lbl;
+    memcpy(&lbl, rec.data() + 4, 4);
+    b->label[slot] = lbl;
+    std::string err;
+    if (!ParseNpy(rec.data() + 24, rec.size() - 24, sample_len_,
+                  b->data.data() + (size_t)slot * sample_len_, &err)) {
+      mxtpu_err() = err;  // sample left zero-filled
+    }
+  }
+
+  mxtpu_handle reader_;
+  int batch_size_;
+  uint64_t sample_len_;
+  int n_threads_;
+  int prefetch_;
+
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_cons_, cv_prod_;
+  std::deque<Batch> queue_;
+  bool stop_ = false;
+};
+
+std::mutex g_lmu;
+std::deque<std::pair<mxtpu_handle, Loader*>> g_loaders;
+mxtpu_handle g_lnext = 2000000001;
+
+Loader* FindLoader(mxtpu_handle h) {
+  std::unique_lock<std::mutex> lk(g_lmu);
+  for (auto& kv : g_loaders)
+    if (kv.first == h) return kv.second;
+  return nullptr;
+}
+
+}  // namespace
+
+mxtpu_handle mxtpu_loader_open(const char* path, int part_index,
+                               int num_parts, int batch_size,
+                               uint64_t sample_len, int n_threads,
+                               int prefetch) {
+  mxtpu_handle rd = mxtpu_recio_reader_open(path, part_index, num_parts);
+  if (!rd) return 0;
+  Loader* l = new Loader(rd, batch_size, sample_len, n_threads, prefetch);
+  std::unique_lock<std::mutex> lk(g_lmu);
+  mxtpu_handle h = g_lnext++;
+  g_loaders.emplace_back(h, l);
+  return h;
+}
+
+int mxtpu_loader_next(mxtpu_handle h, float* data, float* label) {
+  Loader* l = FindLoader(h);
+  if (!l) { mxtpu_err() = "bad loader handle"; return -1; }
+  return l->Next(data, label);
+}
+
+void mxtpu_loader_reset(mxtpu_handle h) {
+  Loader* l = FindLoader(h);
+  if (l) l->Reset();
+}
+
+void mxtpu_loader_close(mxtpu_handle h) {
+  Loader* l = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(g_lmu);
+    for (auto it = g_loaders.begin(); it != g_loaders.end(); ++it)
+      if (it->first == h) {
+        l = it->second;
+        g_loaders.erase(it);
+        break;
+      }
+  }
+  delete l;
+}

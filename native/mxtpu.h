@@ -1,0 +1,79 @@
+/*
+ * mxtpu native runtime — C ABI.
+ *
+ * TPU-native counterpart of the reference's native runtime layers: the
+ * dependency engine (`include/mxnet/engine.h`, `src/engine/threaded_engine*`),
+ * dmlc recordio (`src/io/`), and the threaded batch loader
+ * (`src/io/iter_prefetcher.h`).  Device compute scheduling belongs to
+ * XLA/JAX; this library owns *host-side* systems work: dependency-ordered
+ * async host tasks (IO, reductions, checkpoints), record IO, and
+ * prefetching/decode pipelines.
+ *
+ * Everything is exposed through a flat C ABI consumed via ctypes
+ * (`mxnet_tpu/_native.py`); no pybind dependency.
+ */
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <cstdint>
+
+#if defined(__GNUC__)
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+#else
+#define MXTPU_API extern "C"
+#endif
+
+typedef int64_t mxtpu_handle;
+typedef void (*mxtpu_fn_t)(void* arg);
+
+/* ---- error reporting (c_api_error pattern: TLS last-error string) ---- */
+MXTPU_API const char* mxtpu_last_error();
+
+/* ---- dependency engine ---- */
+MXTPU_API mxtpu_handle mxtpu_engine_create(int nthreads);
+MXTPU_API void mxtpu_engine_destroy(mxtpu_handle eng);
+MXTPU_API mxtpu_handle mxtpu_var_create(mxtpu_handle eng);
+/* schedules deletion after all pending ops on the var complete */
+MXTPU_API void mxtpu_var_delete(mxtpu_handle eng, mxtpu_handle var);
+/* fn(arg) runs on a worker thread once all deps are satisfied.
+ * const_vars: read deps; mutable_vars: write deps.  priority: higher runs
+ * first among ready tasks. Returns 0 on success. */
+MXTPU_API int mxtpu_push(mxtpu_handle eng, mxtpu_fn_t fn, void* arg,
+                         const mxtpu_handle* const_vars, int n_const,
+                         const mxtpu_handle* mutable_vars, int n_mutable,
+                         int priority);
+MXTPU_API void mxtpu_wait_for_var(mxtpu_handle eng, mxtpu_handle var);
+MXTPU_API void mxtpu_wait_all(mxtpu_handle eng);
+/* stats: number of ops executed since creation */
+MXTPU_API int64_t mxtpu_engine_num_executed(mxtpu_handle eng);
+
+/* ---- recordio ---- */
+MXTPU_API mxtpu_handle mxtpu_recio_writer_open(const char* path);
+MXTPU_API int mxtpu_recio_write(mxtpu_handle w, const void* data,
+                                uint64_t len);
+MXTPU_API void mxtpu_recio_writer_close(mxtpu_handle w);
+
+/* part_index/num_parts shard the file by byte ranges with resync to the
+ * next record magic, like dmlc::InputSplit (sharded distributed reads). */
+MXTPU_API mxtpu_handle mxtpu_recio_reader_open(const char* path,
+                                               int part_index, int num_parts);
+/* returns pointer valid until next call; len=0 and NULL at EOF */
+MXTPU_API const void* mxtpu_recio_read(mxtpu_handle r, uint64_t* len);
+MXTPU_API void mxtpu_recio_reader_seek0(mxtpu_handle r);
+MXTPU_API void mxtpu_recio_reader_close(mxtpu_handle r);
+
+/* ---- threaded prefetching batch loader ----
+ * Reads recordio records (IRHeader 'IfQQ' + raw npy payload), decodes on
+ * n_threads workers, assembles float32 batches of batch_size x sample_len
+ * (+ labels), double-buffered ahead of the consumer. */
+MXTPU_API mxtpu_handle mxtpu_loader_open(const char* path, int part_index,
+                                         int num_parts, int batch_size,
+                                         uint64_t sample_len, int n_threads,
+                                         int prefetch);
+/* copies next batch into caller buffers; returns number of valid samples
+ * (0 at epoch end; < batch_size on last partial batch, rest zero-padded) */
+MXTPU_API int mxtpu_loader_next(mxtpu_handle l, float* data, float* label);
+MXTPU_API void mxtpu_loader_reset(mxtpu_handle l);
+MXTPU_API void mxtpu_loader_close(mxtpu_handle l);
+
+#endif  /* MXTPU_H_ */
