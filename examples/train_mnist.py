@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""MNIST training (reference `example/image-classification/train_mnist.py`).
+
+Runs on real MNIST idx files when --data-dir holds them, else on synthetic
+separable data so the script is self-contained.  Network: --network mlp
+(default) or lenet.  Multi-device DP: --gpus "0,1" maps to multiple local
+devices (`mx.tpu(i)`/cpu(i)); distributed: --kv-store dist_sync under
+tools/launch.py.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+from mxnet_tpu.io import MNISTIter, NDArrayIter  # noqa: E402
+
+
+def get_iters(args, flat):
+    dd = args.data_dir
+    img = os.path.join(dd, "train-images-idx3-ubyte")
+    if dd and os.path.exists(img):
+        train = MNISTIter(image=img,
+                          label=os.path.join(dd, "train-labels-idx1-ubyte"),
+                          batch_size=args.batch_size, flat=flat, shuffle=True)
+        val = MNISTIter(image=os.path.join(dd, "t10k-images-idx3-ubyte"),
+                        label=os.path.join(dd, "t10k-labels-idx1-ubyte"),
+                        batch_size=args.batch_size, flat=flat, shuffle=False)
+        return train, val
+    logging.warning("no MNIST at %r - using synthetic separable data", dd)
+    rng = np.random.RandomState(0)
+    n, n_classes = 2048, 10
+    dim = 784 if flat else (1, 28, 28)
+    y = rng.randint(0, n_classes, n)
+    shape = (n, dim) if flat else (n,) + dim
+    X = rng.randn(*shape).astype(np.float32) * 0.1
+    flatX = X.reshape(n, -1)
+    flatX[np.arange(n), y * 7] += 3.0
+    mk = lambda s: NDArrayIter(data=X[s], label=y[s].astype(np.float32),
+                               batch_size=args.batch_size, shuffle=True)
+    return mk(slice(0, n * 3 // 4)), mk(slice(n * 3 // 4, n))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--gpus", default=None,
+                    help="comma list of device ids for multi-device DP")
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    head = "%(asctime)-15s Node[" + os.environ.get("DMLC_RANK", "0") + "] %(message)s"
+    logging.basicConfig(level=logging.INFO, format=head)
+
+    flat = args.network == "mlp"
+    net = models.get_mlp() if flat else models.get_lenet()
+    train, val = get_iters(args, flat)
+
+    if args.gpus:
+        ndev = len(args.gpus.split(","))
+        ctx = [mx.Context(mx.current_context().device_type, int(i))
+               for i in args.gpus.split(",")]
+    else:
+        ctx = mx.cpu()
+
+    model = mx.model.FeedForward(
+        net, ctx=ctx, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=1e-5,
+        initializer=mx.init.Xavier())
+    kv = mx.kv.create(args.kv_store)
+    model.fit(X=train, eval_data=val, kvstore=kv,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+              epoch_end_callback=(mx.callback.do_checkpoint(args.model_prefix)
+                                  if args.model_prefix else None))
+    acc = model.score(val)
+    logging.info("final validation accuracy: %.4f", acc)
+
+
+if __name__ == "__main__":
+    main()

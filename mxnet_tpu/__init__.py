@@ -49,8 +49,11 @@ from . import callback
 from . import monitor
 from . import io
 from . import recordio
+from . import rtc
 from . import kvstore
 from . import kvstore as kv
+from . import predictor
+from .predictor import Predictor
 from . import model
 from .model import FeedForward
 from . import module as mod

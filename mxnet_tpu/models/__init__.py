@@ -9,3 +9,5 @@ from .inception_bn import get_inception_bn
 from .resnet import get_resnet
 from .lstm import lstm_unroll, lstm_cell
 from .transformer import get_transformer_lm, transformer_block
+from .googlenet import get_googlenet
+from .inception_v3 import get_inception_v3

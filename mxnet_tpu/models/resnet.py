@@ -46,6 +46,9 @@ def _basic(data, num_filter, stride, dim_match, name):
 
 
 _UNITS = {
+    # 28 = the reference's symbol_resnet-28-small.py CIFAR variant
+    # (3 stages x n blocks); served by the small-image stem below.
+    28: ([4, 4, 4], _basic, [64, 128, 256]),
     18: ([2, 2, 2, 2], _basic, [64, 128, 256, 512]),
     34: ([3, 4, 6, 3], _basic, [64, 128, 256, 512]),
     50: ([3, 4, 6, 3], _bottleneck, [256, 512, 1024, 2048]),

@@ -1,0 +1,196 @@
+"""Inference-only serving surface.
+
+Reference: the C predict ABI (`src/c_api/c_predict_api.cc`,
+`include/mxnet/c_predict_api.h`): `MXPredCreate(symbol_json, param_bytes,
+dev, input_shapes)` / `SetInput` / `Forward` / `GetOutput` /
+`PartialForward`, the surface the amalgamation builds shipped to
+Android/iOS/JS.
+
+TPU-first redesign: instead of binding a NaiveEngine executor
+(`MXNET_PREDICT_ONLY`, `src/engine/engine.cc:20-30`), the graph is traced
+once and AOT-compiled by XLA for the given input shapes; `forward` is one
+cached executable launch.  `partial_forward` (step debugging,
+`graph_executor.cc:892-899`) runs the uncompiled traced plan up to a node
+index — debugging doesn't need the compiled path.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import cpu
+from .executor import _build_graph_fn
+from .symbol import Symbol, loads as _sym_loads
+from . import ndarray as nd
+
+
+class Predictor:
+    """AOT-compiled inference session (`MXPredCreate` analogue)."""
+
+    def __init__(self, symbol, params, input_shapes, ctx=None,
+                 output_index=None, dtype=np.float32):
+        """symbol: Symbol | json str | path to -symbol.json.
+        params: dict name->array | path to .params file (arg:/aux: keys).
+        input_shapes: dict name -> shape for all non-parameter inputs."""
+        if isinstance(symbol, str):
+            if symbol.lstrip().startswith("{"):
+                symbol = _sym_loads(symbol)
+            else:
+                with open(symbol) as f:
+                    symbol = _sym_loads(f.read())
+        if not isinstance(symbol, Symbol):
+            raise MXNetError("Predictor: need a Symbol or its JSON")
+        if output_index is not None:
+            symbol = symbol[output_index]
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else cpu()
+        self._dtype = dtype
+
+        if isinstance(params, str):
+            loaded = nd.load(params)
+            arg_params, aux_params = {}, {}
+            for k, v in loaded.items():
+                if k.startswith("arg:"):
+                    arg_params[k[4:]] = v.asnumpy()
+                elif k.startswith("aux:"):
+                    aux_params[k[4:]] = v.asnumpy()
+                else:
+                    arg_params[k] = v.asnumpy()
+        else:
+            arg_params = {k: np.asarray(getattr(v, "asnumpy", lambda: v)())
+                          for k, v in params.items() if not k.startswith("aux:")}
+            aux_params = {k[4:]: np.asarray(getattr(v, "asnumpy", lambda: v)())
+                          for k, v in params.items() if k.startswith("aux:")}
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self._input_names = [n for n in arg_names if n not in arg_params]
+
+        known = {n: tuple(s) for n, s in input_shapes.items()
+                 if n in self._input_names}
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape_partial(
+            **known)
+        # inputs whose shape inference completed without being provided are
+        # optional (label heads at inference time — SoftmaxOutput ignores
+        # its label outside training, like the reference predict ABI which
+        # only takes data inputs); they stay zero-filled.
+        missing = [n for n, s in zip(arg_names, arg_shapes)
+                   if n in self._input_names and n not in known
+                   and s is None]
+        if missing:
+            raise MXNetError(
+                "Predictor: missing input_shapes for %s" % missing)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            bad = [n for n, s in zip(arg_names, arg_shapes or [None])
+                   if s is None]
+            raise MXNetError(
+                "Predictor: cannot infer shapes for %s" % bad)
+
+        self._arg_arrays = []
+        for n, s in zip(arg_names, arg_shapes):
+            if n in arg_params:
+                a = np.asarray(arg_params[n])
+                if tuple(a.shape) != tuple(s):
+                    raise MXNetError(
+                        "Predictor: param %s has shape %s, expected %s"
+                        % (n, a.shape, s))
+                self._arg_arrays.append(jnp.asarray(a))
+            else:
+                self._arg_arrays.append(
+                    jnp.zeros(s, dtype))  # placeholder until set_input
+        self._aux_arrays = []
+        for n, s in zip(aux_names, aux_shapes):
+            if n not in aux_params:
+                raise MXNetError("Predictor: missing aux param %s" % n)
+            self._aux_arrays.append(jnp.asarray(np.asarray(aux_params[n])))
+        self._arg_index = {n: i for i, n in enumerate(arg_names)}
+        self._out_shapes = out_shapes
+
+        graph_fn, self._order, _ = _build_graph_fn(symbol)
+
+        def infer(args, aux):
+            outs, _ = graph_fn(args, aux, None, False)
+            return outs
+
+        # AOT compile for the fixed shapes (the TPU replacement for the
+        # predict ABI's pre-bound NaiveEngine executor)
+        self._compiled = jax.jit(infer).lower(
+            self._arg_arrays, self._aux_arrays).compile()
+        self._graph_fn = graph_fn
+        self._outputs = None
+
+    # -- MXPred* surface --------------------------------------------------
+    def set_input(self, name, array):
+        """`MXPredSetInput`: stage one input by name."""
+        if name not in self._input_names:
+            raise MXNetError(
+                "Predictor: %r is not an input (inputs: %s)"
+                % (name, self._input_names))
+        i = self._arg_index[name]
+        expected = self._arg_arrays[i].shape
+        a = np.asarray(getattr(array, "asnumpy", lambda: array)())
+        if tuple(a.shape) != tuple(expected):
+            raise MXNetError(
+                "Predictor: input %s has shape %s, expected %s"
+                % (name, a.shape, tuple(expected)))
+        self._arg_arrays[i] = jnp.asarray(a.astype(self._dtype, copy=False))
+        self._outputs = None
+
+    def forward(self, **inputs):
+        """`MXPredForward`; inputs may also be passed as kwargs."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._outputs = self._compiled(self._arg_arrays, self._aux_arrays)
+        return self
+
+    def get_output(self, index=0):
+        """`MXPredGetOutput` -> numpy array."""
+        if self._outputs is None:
+            raise MXNetError("Predictor: call forward() first")
+        return np.asarray(self._outputs[index])
+
+    @property
+    def num_outputs(self):
+        return len(self._out_shapes)
+
+    @property
+    def output_shapes(self):
+        return list(self._out_shapes)
+
+    def partial_forward(self, num_nodes):
+        """`MXPredPartialForward` (`graph_executor.cc:892-899`): evaluate
+        only the first ``num_nodes`` graph ops and return
+        [(node_name, numpy output)] — step debugging, uncompiled path."""
+        order = [n for n in self._order if not n.is_variable]
+        num_nodes = min(num_nodes, len(order))
+        if num_nodes <= 0:
+            return []
+        heads = Symbol([(n, 0) for n in order[:num_nodes]])
+        graph_fn, _, _ = _build_graph_fn(heads)
+        # the sub-symbol's own argument/aux ordering indexes into ours
+        aux_index = {n: i for i, n in
+                     enumerate(self.symbol.list_auxiliary_states())}
+        sub_args = [self._arg_arrays[self._arg_index[n]]
+                    for n in heads.list_arguments()]
+        sub_aux = [self._aux_arrays[aux_index[n]]
+                   for n in heads.list_auxiliary_states()]
+        outs, _ = graph_fn(sub_args, sub_aux, None, False)
+        return [(n.name, np.asarray(o))
+                for n, o in zip(order[:num_nodes], outs)]
+
+    def predict(self, **inputs):
+        """Convenience: forward + first output."""
+        return self.forward(**inputs).get_output(0)
+
+
+def load(prefix, epoch, input_shapes, ctx=None, **kwargs):
+    """Create a Predictor from a FeedForward checkpoint
+    (`prefix-symbol.json` + `prefix-%04d.params`)."""
+    return Predictor("%s-symbol.json" % prefix,
+                     "%s-%04d.params" % (prefix, epoch),
+                     input_shapes, ctx=ctx, **kwargs)

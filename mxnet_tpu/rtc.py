@@ -1,0 +1,74 @@
+"""Runtime-compiled user kernels.
+
+Reference: `mx.rtc` (`python/mxnet/rtc.py`, `src/common/mxrtc.cc`) let
+users hand NVRTC a CUDA source string and push it on NDArrays.  The TPU
+equivalent of "bring your own kernel" is a **Pallas kernel** (or any
+jax-traceable function): XLA is the runtime compiler, `jax.jit` the cache.
+
+    kern = mx.rtc.Rtc("scale_add",
+                      lambda x, y: x * 2 + y)          # jnp / pallas body
+    kern.push([a, b], [out])
+
+The body receives jax arrays for every input and must return one array per
+output (shapes fixed per compilation; new shapes recompile and cache, like
+MXRtc cached PTX per name).  For real Pallas kernels pass a function that
+calls `pl.pallas_call` — see `ops/pallas_kernels/flash_attention.py` for
+the house style.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+
+class Rtc:
+    """User kernel wrapper (`MXRtcCreate`/`MXRtcPush` analogue)."""
+
+    def __init__(self, name, body, num_outputs=None):
+        if not callable(body):
+            raise MXNetError(
+                "Rtc: body must be a callable taking jax arrays (the CUDA "
+                "source path is meaningless on TPU; write jnp or Pallas)")
+        self.name = name
+        self._body = body
+        self._num_outputs = num_outputs
+        self._jitted = jax.jit(self._call)
+
+    def _call(self, *inputs):
+        out = self._body(*inputs)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+    def push(self, inputs, outputs, grid_dims=None, block_dims=None):
+        """Run the kernel: reads `inputs`, overwrites `outputs` in place.
+
+        grid_dims/block_dims are accepted for API compatibility and
+        ignored — XLA/Mosaic choose the schedule (BlockSpecs inside a
+        Pallas body control tiling explicitly)."""
+        del grid_dims, block_dims
+        ins = []
+        for a in inputs:
+            if not isinstance(a, NDArray):
+                raise MXNetError("Rtc.push: inputs must be NDArrays")
+            ins.append(a.data)
+        results = self._jitted(*ins)
+        if self._num_outputs is not None \
+                and len(results) != self._num_outputs:
+            raise MXNetError(
+                "Rtc %s: body returned %d outputs, declared %d"
+                % (self.name, len(results), self._num_outputs))
+        if len(results) != len(outputs):
+            raise MXNetError(
+                "Rtc %s: body returned %d outputs, %d output arrays given"
+                % (self.name, len(results), len(outputs)))
+        for o, r in zip(outputs, results):
+            if not isinstance(o, NDArray):
+                raise MXNetError("Rtc.push: outputs must be NDArrays")
+            if tuple(o.shape) != tuple(r.shape):
+                raise MXNetError(
+                    "Rtc %s: output shape %s != kernel result %s"
+                    % (self.name, o.shape, r.shape))
+            o[:] = np.asarray(r)
+        return outputs

@@ -83,3 +83,23 @@ def test_lstm_unroll_shapes():
     d = dict(zip(net.list_arguments(), arg_shapes))
     assert d["l0_i2h_weight"] == (4 * nh, ne)
     assert d["l1_i2h_weight"] == (4 * nh, nh)
+
+
+def test_googlenet_shapes():
+    net = models.get_googlenet(num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+
+
+def test_inception_v3_shapes():
+    net = models.get_inception_v3(num_classes=1000)
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 1000)
+    assert len(aux_shapes) > 0  # BN moving stats present
+
+
+def test_transformer_lm_shapes():
+    net = models.get_transformer_lm(vocab_size=100, seq_len=12,
+                                    num_layers=2, num_heads=4, num_embed=32)
+    _, out_shapes, _ = net.infer_shape(data=(4, 12), softmax_label=(4, 12))
+    assert out_shapes[0] == (48, 100)

@@ -1,0 +1,84 @@
+"""Predict/serving ABI tests (reference `src/c_api/c_predict_api.cc`
+contract: create from symbol json + params, SetInput/Forward/GetOutput,
+PartialForward, and `tests/python/predict` usage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import NDArrayIter
+
+
+def _trained_checkpoint(tmp_path, num_classes=3):
+    np.random.seed(0)
+    mx.random.seed(0)
+    N, D = 128, 8
+    centers = np.random.randn(num_classes, D) * 3
+    y = np.random.randint(0, num_classes, N)
+    X = (centers[y] + 0.1 * np.random.randn(N, D)).astype(np.float32)
+    net = models.get_mlp(num_classes=num_classes)
+    model = mx.model.FeedForward(
+        net, ctx=mx.cpu(), num_epoch=3, learning_rate=0.5,
+        initializer=mx.init.Xavier())
+    model.fit(X=NDArrayIter(data=X, label=y.astype(np.float32),
+                            batch_size=32))
+    prefix = str(tmp_path / "mdl")
+    model.save(prefix, 3)
+    return prefix, X, y
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    prefix, X, y = _trained_checkpoint(tmp_path)
+    pred = mx.predictor.load(prefix, 3, input_shapes={"data": (16, 8)})
+    assert pred.num_outputs == 1
+    probs = pred.predict(data=X[:16])
+    assert probs.shape == (16, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+    acc = (probs.argmax(1) == y[:16]).mean()
+    assert acc > 0.9
+
+    # matches FeedForward.predict
+    model = mx.model.FeedForward.load(prefix, 3)
+    want = model.predict(NDArrayIter(data=X[:16], batch_size=16))
+    np.testing.assert_allclose(probs, want, rtol=1e-5)
+
+
+def test_predictor_set_input_and_reuse(tmp_path):
+    prefix, X, _ = _trained_checkpoint(tmp_path)
+    pred = mx.predictor.load(prefix, 3, input_shapes={"data": (4, 8)})
+    pred.set_input("data", X[:4])
+    pred.forward()
+    p1 = pred.get_output(0)
+    pred.forward(data=X[4:8])
+    p2 = pred.get_output(0)
+    assert not np.allclose(p1, p2)
+
+
+def test_predictor_errors(tmp_path):
+    prefix, X, _ = _trained_checkpoint(tmp_path)
+    pred = mx.predictor.load(prefix, 3, input_shapes={"data": (4, 8)})
+    with pytest.raises(MXNetError, match="not an input"):
+        pred.set_input("fc1_weight", np.zeros((1,)))
+    with pytest.raises(MXNetError, match="expected"):
+        pred.set_input("data", np.zeros((5, 8), np.float32))
+    with pytest.raises(MXNetError, match="forward"):
+        mx.predictor.load(prefix, 3,
+                          input_shapes={"data": (4, 8)}).get_output(0)
+    with pytest.raises(MXNetError, match="missing input_shapes"):
+        mx.Predictor("%s-symbol.json" % prefix,
+                     "%s-%04d.params" % (prefix, 3), input_shapes={})
+
+
+def test_partial_forward(tmp_path):
+    prefix, X, _ = _trained_checkpoint(tmp_path)
+    pred = mx.predictor.load(prefix, 3, input_shapes={"data": (4, 8)})
+    pred.set_input("data", X[:4])
+    steps = pred.partial_forward(2)
+    assert len(steps) == 2
+    name0, out0 = steps[0]
+    assert out0.shape[0] == 4
+    # prefix evaluation is consistent with the full forward
+    full = pred.forward(data=X[:4]).get_output(0)
+    all_steps = pred.partial_forward(10**6)
+    np.testing.assert_allclose(all_steps[-1][1], full, rtol=1e-5)

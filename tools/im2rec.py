@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Build RecordIO packs from an image list (reference `tools/im2rec.py`).
+
+List file format (same as the reference): `index\tlabel\tpath` per line.
+Payloads are stored as raw .npy blobs (`recordio.pack_img`); .npy/.npz
+inputs are read directly, other image formats need PIL if available.
+
+Usage:
+    python tools/im2rec.py LISTFILE IMAGE_ROOT OUTPUT.rec [--shuffle]
+    python tools/im2rec.py --make-list DIR OUTPUT.lst   # build a list file
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path, allow_pickle=False)
+    if path.endswith(".npz"):
+        z = np.load(path, allow_pickle=False)
+        return z[list(z.keys())[0]]
+    try:
+        from PIL import Image  # optional
+    except ImportError:
+        raise SystemExit(
+            "reading %r needs PIL; only .npy/.npz supported without it"
+            % path)
+    img = np.asarray(Image.open(path))
+    if img.ndim == 3:  # HWC -> CHW like the reference pack
+        img = img.transpose(2, 0, 1)
+    return img
+
+
+def make_list(root, out):
+    exts = (".npy", ".npz", ".jpg", ".jpeg", ".png")
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    rows = []
+    for c in classes:
+        for f in sorted(os.listdir(os.path.join(root, c))):
+            if f.lower().endswith(exts):
+                rows.append((len(rows), label_of[c], os.path.join(c, f)))
+    with open(out, "w") as fo:
+        for i, lbl, path in rows:
+            fo.write("%d\t%f\t%s\n" % (i, lbl, path))
+    print("wrote %d entries, %d classes -> %s" % (len(rows), len(classes),
+                                                  out))
+
+
+def pack(listfile, root, out, shuffle=False):
+    rows = []
+    with open(listfile) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            rows.append((int(parts[0]), float(parts[1]), parts[2]))
+    if shuffle:
+        random.shuffle(rows)
+    w = recordio.MXRecordIO(out, "w")
+    idx_w = open(out.rsplit(".", 1)[0] + ".idx", "w")
+    for n, (i, label, rel) in enumerate(rows):
+        img = load_image(os.path.join(root, rel))
+        rec = recordio.pack_img((0, label, i, 0), img)
+        idx_w.write("%d\t%d\n" % (i, w.tell()))
+        w.write(rec)
+        if (n + 1) % 1000 == 0:
+            print("packed %d/%d" % (n + 1, len(rows)))
+    w.close()
+    idx_w.close()
+    print("wrote %d records -> %s" % (len(rows), out))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--make-list", action="store_true")
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("args", nargs="+")
+    a = ap.parse_args()
+    if a.make_list:
+        make_list(a.args[0], a.args[1])
+    else:
+        if len(a.args) != 3:
+            ap.error("need LISTFILE IMAGE_ROOT OUTPUT.rec")
+        pack(a.args[0], a.args[1], a.args[2], shuffle=a.shuffle)
+
+
+if __name__ == "__main__":
+    main()

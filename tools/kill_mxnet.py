@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Kill stray training/server processes (reference `tools/kill-mxnet.py`).
+
+Terminates processes whose command line references mxnet_tpu dist roles
+(DMLC_ROLE env or parallel.dist server loop).  SIGTERM first, SIGKILL after
+a grace period.  Never touches the calling process.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+def find_victims():
+    victims = []
+    me = os.getpid()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        if pid == me:
+            continue
+        try:
+            with open("/proc/%d/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(errors="replace")
+            with open("/proc/%d/environ" % pid, "rb") as f:
+                env = f.read().replace(b"\x00", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if "parallel.dist" in cmd or "run_server" in cmd \
+                or "DMLC_ROLE=" in env and "mxnet_tpu" in cmd:
+            victims.append(pid)
+    return victims
+
+
+def main():
+    victims = find_victims()
+    if not victims:
+        print("nothing to kill")
+        return
+    for pid in victims:
+        print("SIGTERM", pid)
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    time.sleep(2)
+    for pid in victims:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            continue
+        print("SIGKILL", pid)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Cluster launcher (reference `tools/launch.py` + dmlc-core tracker).
+
+Starts a parameter server + N worker processes with the `DMLC_*` env
+contract (`include/mxnet/kvstore.h:157-206`) and runs the user command in
+each worker.  Localhost multi-process is the primary mode (the reference's
+nightly distributed tests ran exactly this way,
+`tests/nightly/test_all.sh:34-37`); `--hostfile` runs workers over ssh.
+
+Usage:
+    python tools/launch.py -n 4 [-s 1] [--sync-dst-dir DIR] CMD...
+
+Each worker gets DMLC_ROLE=worker, DMLC_RANK, DMLC_NUM_WORKER,
+DMLC_PS_ROOT_URI/PORT; the server process runs the kvstore server loop and
+exits on kStopServer (sent by rank 0 teardown).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1,
+                    help="only 1 server supported by the TCP backend")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--hostfile", default=None,
+                    help="file with one host per line; workers run via ssh")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for all processes")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    port = args.port or _free_port()
+    base_env = dict(os.environ)
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        base_env[k] = v
+    base_env.update({
+        "DMLC_PS_ROOT_URI": args.host,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(max(1, args.num_servers)),
+    })
+
+    procs = []
+
+    # server process (single TCP server; kvstore_dist_server analogue)
+    senv = dict(base_env)
+    senv["DMLC_ROLE"] = "server"
+    server_cmd = [sys.executable, "-c",
+                  "from mxnet_tpu.parallel.dist import run_server; run_server()"]
+    procs.append(subprocess.Popen(server_cmd, env=senv))
+
+    hosts = None
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+
+    for rank in range(args.num_workers):
+        wenv = dict(base_env)
+        wenv["DMLC_ROLE"] = "worker"
+        wenv["DMLC_RANK"] = str(rank)
+        if hosts:
+            host = hosts[rank % len(hosts)]
+            envs = " ".join("%s=%s" % (k, v) for k, v in wenv.items()
+                            if k.startswith("DMLC_"))
+            cmd = ["ssh", host, "cd %s && env %s %s"
+                   % (os.getcwd(), envs, " ".join(args.command))]
+            procs.append(subprocess.Popen(cmd))
+        else:
+            procs.append(subprocess.Popen(args.command, env=wenv))
+
+    def _terminate(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
+    rc = 0
+    # wait for workers (skip the server, procs[0]: it exits on kStopServer)
+    for p in procs[1:]:
+        p.wait()
+        rc = rc or p.returncode
+    # workers that never created a dist kvstore never send kStopServer;
+    # don't hang on the server in that case
+    try:
+        procs[0].wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        procs[0].terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

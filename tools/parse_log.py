@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Scrape accuracy/speed from training logs (reference `tools/parse_log.py`;
+used by the nightly `check_val` gates, `tests/nightly/test_all.sh:44-52`).
+
+Usage: python tools/parse_log.py LOGFILE [--metric validation-accuracy]
+Prints `epoch value` rows and the final value on the last line (the value
+the accuracy gates compare against)."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+PATTERNS = {
+    "validation-accuracy":
+        re.compile(r"Epoch\[(\d+)\].*?Validation-accuracy=([0-9.]+)"),
+    "train-accuracy":
+        re.compile(r"Epoch\[(\d+)\].*?Train-accuracy=([0-9.]+)"),
+    "speed":
+        re.compile(r"Epoch\[(\d+)\].*?Speed:\s*([0-9.]+)\s*samples"),
+    "time":
+        re.compile(r"Epoch\[(\d+)\].*?Time cost=([0-9.]+)"),
+}
+
+
+def parse(path, metric):
+    pat = PATTERNS[metric]
+    rows = []
+    with open(path) as f:
+        for line in f:
+            m = pat.search(line)
+            if m:
+                rows.append((int(m.group(1)), float(m.group(2))))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--metric", default="validation-accuracy",
+                    choices=sorted(PATTERNS))
+    a = ap.parse_args()
+    rows = parse(a.logfile, a.metric)
+    for epoch, v in rows:
+        print(epoch, v)
+    if rows:
+        print(rows[-1][1])
+    else:
+        print("no matches", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
