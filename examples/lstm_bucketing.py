@@ -77,6 +77,21 @@ class BucketSentenceIter(DataIter):
             provide_label=[("softmax_label", (self.batch_size, b))])
 
 
+def load_text_sentences(path):
+    """Tokenize a PTB-style text file (one sentence per line) into word-id
+    sequences, like `bucket_io.py`'s default_text2id over ptb.train.txt."""
+    vocab = {}
+    sentences = []
+    with open(path) as f:
+        for line in f:
+            words = line.split()
+            if not words:
+                continue
+            ids = [vocab.setdefault(w, len(vocab)) for w in words]
+            sentences.append(ids[:BUCKETS[-1]])
+    return sentences, len(vocab)
+
+
 def synthetic_sentences(n=400, vocab=64, seed=0):
     rng = np.random.RandomState(seed)
     out = []
@@ -96,10 +111,17 @@ def main():
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--num-epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--data", default="./data/ptb.train.txt",
+                    help="PTB-style text file; synthetic sequences if absent")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    sentences = synthetic_sentences()
+    if os.path.exists(args.data):
+        logging.info("loading text from %s", args.data)
+        sentences, _ = load_text_sentences(args.data)
+    else:
+        logging.info("%s not found, using synthetic sequences", args.data)
+        sentences = synthetic_sentences()
     it = BucketSentenceIter(sentences, args.batch_size)
     vocab = it.vocab_size
 

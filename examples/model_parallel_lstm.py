@@ -34,8 +34,6 @@ def main():
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    n_dev = len(mx.context.num_devices() * [0]) \
-        if hasattr(mx.context, "num_devices") else 2
     import jax
     n_dev = min(len(jax.devices()), args.num_layers)
 

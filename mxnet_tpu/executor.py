@@ -26,6 +26,8 @@ compile it.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -92,14 +94,21 @@ def _build_graph_fn(symbol: Symbol):
     return fn, order, internal_entries
 
 
-def _as_list(arrays, names, what):
+def _mirror_saveable(prim, *_, **__):
+    """jax.checkpoint policy for MXNET_BACKWARD_DO_MIRROR: save MXU-heavy
+    primitive results, rematerialize the rest (the reference's rule that
+    Convolution/FullyConnected are never mirrored, `static_graph.cc:423-438`)."""
+    return prim.name in ("dot_general", "conv_general_dilated")
+
+
+def _as_list(arrays, names, what, allow_missing=False):
     if arrays is None:
         return None
     if isinstance(arrays, dict):
         missing = [n for n in names if n not in arrays]
-        if missing:
+        if missing and not allow_missing:
             raise MXNetError("%s missing entries for %s" % (what, missing))
-        return [arrays[n] for n in names]
+        return [arrays.get(n) for n in names]
     arrays = list(arrays)
     if len(arrays) != len(names):
         raise MXNetError(
@@ -140,7 +149,10 @@ class Executor:
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
         self.arg_arrays = _as_list(args, self._arg_names, "args")
-        self.grad_arrays = _as_list(args_grad, self._arg_names, "args_grad")
+        # a dict args_grad may omit entries: those args get no gradient,
+        # like the reference's bind (grad_req forced to null below)
+        self.grad_arrays = _as_list(args_grad, self._arg_names, "args_grad",
+                                    allow_missing=isinstance(args_grad, dict))
         self.aux_arrays = _as_list(aux_states, self._aux_names, "aux_states") or []
         if isinstance(grad_req, str):
             self._grad_req = {n: grad_req for n in self._arg_names}
@@ -148,6 +160,10 @@ class Executor:
             self._grad_req = {n: grad_req.get(n, "null") for n in self._arg_names}
         else:
             self._grad_req = dict(zip(self._arg_names, grad_req))
+        if self.grad_arrays is not None:
+            for n, g in zip(self._arg_names, self.grad_arrays):
+                if g is None:
+                    self._grad_req[n] = "null"
         # group2ctx (model-parallel ctx_group placement) is honored by the
         # sharded executor in parallel/; single-program binds run on ctx and
         # rely on XLA fusion. Recorded for introspection.
@@ -163,10 +179,22 @@ class Executor:
         # (`graph_executor.cc:769-806`).  jax.vjp re-traces per call, so the
         # vjp is taken *inside* jit where it is traced once and cached; XLA
         # then shares activations between fwd and bwd in one program.
+        #
+        # MXNET_BACKWARD_DO_MIRROR (read at bind time, like the reference's
+        # `static_graph.cc:410-560` mirroring plan): recompute cheap
+        # activations in backward instead of storing them.  The reference
+        # excludes Convolution/FullyConnected/BatchNorm outputs from
+        # mirroring (`static_graph.cc:423-438`); the jax.checkpoint policy
+        # below is the same trade — MXU-heavy primitive results are saved,
+        # everything else is rematerialized.
+        do_mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").lower() in (
+            "1", "true", "yes")
+
         def train_step(args, aux, rng, cots):
-            outs, vjp_fn, new_aux = jax.vjp(
-                lambda a: fn(a, aux, rng, True), args, has_aux=True
-            )
+            f = lambda a: fn(a, aux, rng, True)
+            if do_mirror:
+                f = jax.checkpoint(f, policy=_mirror_saveable)
+            outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
             (grads,) = vjp_fn(cots)
             return outs, new_aux, grads
 

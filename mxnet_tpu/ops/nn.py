@@ -309,6 +309,23 @@ class Deconvolution(OpDef):
 register(Deconvolution)
 
 
+def _pool_out_hw(d, k, s, p, name="Pooling"):
+    """The reference's clamped ceil-mode pooled size (`pooling-inl.h:191-197`),
+    shared by Pooling and Unpooling so the contract can't desynchronize."""
+    oh = min(d[2] + 2 * p[0] - k[0] + s[0] - 1, d[2] + 2 * p[0] - 1) // s[0] + 1
+    ow = min(d[3] + 2 * p[1] - k[1] + s[1] - 1, d[3] + 2 * p[1] - 1) // s[1] + 1
+    if oh <= 0 or ow <= 0:
+        raise MXNetError("%s: kernel size exceeds input" % name)
+    return oh, ow
+
+
+def _pool_overhang(d, ohw, k, s, p):
+    """Bottom/right ceil-mode extension so every output window fits."""
+    eh = max(0, (ohw[0] - 1) * s[0] + k[0] - (d[2] + 2 * p[0]))
+    ew = max(0, (ohw[1] - 1) * s[1] + k[1] - (d[3] + 2 * p[1]))
+    return eh, ew
+
+
 class Pooling(OpDef):
     """`src/operator/pooling-inl.h` — max/avg/sum, NCHW, the reference's
     clamped ceil-mode output size (`pooling-inl.h:191-197`).  avg divides by
@@ -329,11 +346,7 @@ class Pooling(OpDef):
         p = _pair(params["pad"], "pad")
         if params["global_pool"]:
             return (1, 1), (d[2], d[3]), (1, 1), (0, 0)
-        oh = min(d[2] + 2 * p[0] - k[0] + s[0] - 1, d[2] + 2 * p[0] - 1) // s[0] + 1
-        ow = min(d[3] + 2 * p[1] - k[1] + s[1] - 1, d[3] + 2 * p[1] - 1) // s[1] + 1
-        if oh <= 0 or ow <= 0:
-            raise MXNetError("Pooling: kernel size exceeds input")
-        return (oh, ow), k, s, p
+        return _pool_out_hw(d, k, s, p), k, s, p
 
     def infer_shape(self, params, in_shapes):
         d = in_shapes[0]
@@ -349,8 +362,7 @@ class Pooling(OpDef):
         d = x.shape
         (oh, ow), k, s, p = self._out_hw(params, d)
         # ceil-mode: extend bottom/right padding so every output window fits
-        eh = max(0, (oh - 1) * s[0] + k[0] - (d[2] + 2 * p[0]))
-        ew = max(0, (ow - 1) * s[1] + k[1] - (d[3] + 2 * p[1]))
+        eh, ew = _pool_overhang(d, (oh, ow), k, s, p)
         pads = ((0, 0), (0, 0), (p[0], p[0] + eh), (p[1], p[1] + ew))
         pt = params["pool_type"]
         if pt == "max":
@@ -370,6 +382,98 @@ class Pooling(OpDef):
 
 
 register(Pooling)
+
+
+class Unpooling(OpDef):
+    """`src/operator/unpooling-inl.h` + `guided_unpooling.h`/`guided_pooling.h`
+    — SegNet-style max-unpooling without explicit switch storage.
+
+    Inputs: ``data`` (at pooled resolution), ``data_pool`` (the original
+    pre-pooling feature map) and ``data_pooled`` (its max-pooled result).
+    The argmax locations are re-derived by comparing ``data_pool`` against
+    ``data_pooled``; each window's contribution of ``data`` is scattered to
+    the row-major-first position whose value equals the pooled max (the
+    caffe/cudnn tie-break, `guided_unpooling.h:120-167`).  Backward w.r.t.
+    ``data`` is the matching gather (`guided_pooling.h:103-135`);
+    ``data_pool``/``data_pooled`` get zero gradient (`unpooling-inl.h:117-120`).
+
+    TPU design note: instead of the reference's per-output-pixel scalar
+    search loops, the window is unrolled into k_y*k_x strided slices of the
+    padded map; the first-match mask is a `cumsum`-based one-hot and the
+    scatter is k_y*k_x strided `.at[].add` updates — all static-shape,
+    XLA-fusable vector code.
+    """
+
+    name = "Unpooling"
+    params = {
+        "kernel": Param("shape", required=True),
+        "stride": Param("shape", default=(1, 1)),
+        "pad": Param("shape", default=(0, 0)),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "data_pool", "data_pooled"]
+
+    def _pooled_hw(self, params, pd):
+        k = _pair(params["kernel"], "kernel")
+        s = _pair(params["stride"], "stride")
+        p = _pair(params["pad"], "pad")
+        return _pool_out_hw(pd, k, s, p, name="Unpooling"), k, s, p
+
+    def infer_shape(self, params, in_shapes):
+        d, pd, pdd = in_shapes
+        if pd is None:
+            return in_shapes, [None], []
+        if len(pd) != 4:
+            raise MXNetError("Unpooling: data_pool must be NCHW 4D")
+        (ph, pw), _, _, _ = self._pooled_hw(params, pd)
+        expect = (pd[0], pd[1], ph, pw)
+        if d is not None and tuple(d) != expect:
+            raise MXNetError(
+                "Unpooling: differing expected unpool size %s vs %s"
+                % (tuple(d), expect)
+            )
+        if pdd is not None and tuple(pdd) != expect:
+            raise MXNetError(
+                "Unpooling: data_pooled shape %s does not match pooled size %s"
+                % (tuple(pdd), expect)
+            )
+        return [expect, pd, expect], [pd], []
+
+    def apply(self, octx, params, inputs, aux):
+        x, pool_in, pooled = inputs
+        (ph, pw), k, s, p = self._pooled_hw(params, pool_in.shape)
+        n, c, h, w = pool_in.shape
+        hp, wp = h + 2 * p[0], w + 2 * p[1]
+        # zero padding like mshadow `pad()`; the clamped-ceil overhang is
+        # NaN-padded so it can never win an equality match
+        eh, ew = _pool_overhang(pool_in.shape, (ph, pw), k, s, p)
+        src = jnp.pad(pool_in, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        if eh or ew:
+            src = jnp.pad(src, ((0, 0), (0, 0), (0, eh), (0, ew)),
+                          constant_values=jnp.nan)
+        # eq[i]: does window position i (row-major) hold the pooled max?
+        wins = [
+            src[:, :, ky:ky + (ph - 1) * s[0] + 1:s[0],
+                kx:kx + (pw - 1) * s[1] + 1:s[1]]
+            for ky in range(k[0]) for kx in range(k[1])
+        ]
+        eq = jnp.stack([wv == pooled for wv in wins])
+        first = jnp.logical_and(eq, jnp.cumsum(eq, axis=0) == 1)
+        first = jax.lax.stop_gradient(first)
+        out = jnp.zeros((n, c, hp + eh, wp + ew), x.dtype)
+        i = 0
+        for ky in range(k[0]):
+            for kx in range(k[1]):
+                out = out.at[:, :, ky:ky + (ph - 1) * s[0] + 1:s[0],
+                             kx:kx + (pw - 1) * s[1] + 1:s[1]].add(
+                    jnp.where(first[i], x, jnp.zeros((), x.dtype)))
+                i += 1
+        out = out[:, :, p[0]:p[0] + h, p[1]:p[1] + w]
+        return [out], []
+
+
+register(Unpooling)
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,7 @@ class Predictor:
             symbol = symbol[output_index]
         self.symbol = symbol
         self.ctx = ctx if ctx is not None else cpu()
+        self._device = self.ctx.jax_device()
         self._dtype = dtype
 
         if isinstance(params, str):
@@ -99,15 +100,18 @@ class Predictor:
                     raise MXNetError(
                         "Predictor: param %s has shape %s, expected %s"
                         % (n, a.shape, s))
-                self._arg_arrays.append(jnp.asarray(a))
+                self._arg_arrays.append(jax.device_put(a, self._device))
             else:
+                # placeholder until set_input; committed to ctx's device so
+                # the AOT compile below and every forward stay on ctx
                 self._arg_arrays.append(
-                    jnp.zeros(s, dtype))  # placeholder until set_input
+                    jax.device_put(jnp.zeros(s, dtype), self._device))
         self._aux_arrays = []
         for n, s in zip(aux_names, aux_shapes):
             if n not in aux_params:
                 raise MXNetError("Predictor: missing aux param %s" % n)
-            self._aux_arrays.append(jnp.asarray(np.asarray(aux_params[n])))
+            self._aux_arrays.append(
+                jax.device_put(np.asarray(aux_params[n]), self._device))
         self._arg_index = {n: i for i, n in enumerate(arg_names)}
         self._out_shapes = out_shapes
 
@@ -138,7 +142,8 @@ class Predictor:
             raise MXNetError(
                 "Predictor: input %s has shape %s, expected %s"
                 % (name, a.shape, tuple(expected)))
-        self._arg_arrays[i] = jnp.asarray(a.astype(self._dtype, copy=False))
+        self._arg_arrays[i] = jax.device_put(
+            a.astype(self._dtype, copy=False), self._device)
         self._outputs = None
 
     def forward(self, **inputs):

@@ -110,3 +110,35 @@ def test_reshape_executor():
     exe2 = exe.reshape(a=(8, 5))
     assert exe2.arg_dict["a"].shape == (8, 5)
     assert exe2.arg_dict["fc_weight"].shape == (3, 5)
+
+
+def test_backward_mirror_env(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR (selective rematerialization, the
+    reference's `static_graph.cc:410-560`) must not change numerics."""
+    import numpy as np
+    np.random.seed(3)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=fc2, label=mx.sym.Variable("label"))
+    shapes = {"data": (4, 6), "label": (4,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    loc = {n: np.random.randn(*s).astype(np.float32)
+           for n, s in zip(net.list_arguments(), arg_shapes)}
+    loc["label"] = np.array([0, 1, 2, 0], np.float32)
+
+    def run():
+        args = {k: mx.nd.array(v) for k, v in loc.items()}
+        grads = {n: mx.nd.zeros(s) for n, s in
+                 zip(net.list_arguments(), arg_shapes) if n != "label"}
+        exe = net.bind(mx.cpu(), args, grads)
+        exe.forward(is_train=True)
+        exe.backward()
+        return {k: g.asnumpy() for k, g in grads.items()}
+
+    base = run()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    mirrored = run()
+    for k in base:
+        np.testing.assert_allclose(base[k], mirrored[k], rtol=1e-5, atol=1e-6)

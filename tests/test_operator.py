@@ -346,3 +346,67 @@ def test_reductions():
     np.testing.assert_allclose(am, x.argmax(axis=1).astype(np.float32))
     tr = _fwd(mx.sym.transpose(mx.sym.Variable("x")), {"x": x})[0]
     np.testing.assert_allclose(tr, x.T)
+
+
+def _np_unpool_oracle(x, pool_in, pooled, kernel, stride, pad):
+    """Scalar-loop oracle of `guided_unpooling.h` semantics: scatter each
+    pooled cell's value of ``x`` to the row-major-first window position of
+    the zero-padded ``pool_in`` equal to ``pooled``, accumulating over
+    windows; crop the padding afterwards."""
+    n, c, h, w = pool_in.shape
+    ph, pw = x.shape[2], x.shape[3]
+    ky, kx = kernel
+    sy, sx = stride
+    py, px = pad
+    src = np.zeros((n, c, h + 2 * py, w + 2 * px), pool_in.dtype)
+    src[:, :, py:py + h, px:px + w] = pool_in
+    out = np.zeros_like(src)
+    for b in range(n):
+        for ch in range(c):
+            for iy in range(ph):
+                for ix in range(pw):
+                    v = pooled[b, ch, iy, ix]
+                    done = False
+                    for wy in range(iy * sy, min(iy * sy + ky, src.shape[2])):
+                        for wx in range(ix * sx, min(ix * sx + kx, src.shape[3])):
+                            if src[b, ch, wy, wx] == v:
+                                out[b, ch, wy, wx] += x[b, ch, iy, ix]
+                                done = True
+                                break
+                        if done:
+                            break
+    return out[:, :, py:py + h, px:px + w]
+
+
+@pytest.mark.parametrize("kernel,stride,pad,hw", [
+    ((2, 2), (2, 2), (0, 0), (4, 4)),
+    ((3, 3), (2, 2), (1, 1), (5, 5)),   # overlapping windows + padding
+    ((2, 2), (2, 2), (0, 0), (5, 5)),   # clamped-ceil overhang
+])
+def test_unpooling(kernel, stride, pad, hw):
+    np.random.seed(0)
+    n, c = 2, 3
+    pool_in = np.random.randn(n, c, *hw).astype(np.float32)
+    pool = mx.sym.Pooling(data=mx.sym.Variable("data"), kernel=kernel,
+                          stride=stride, pad=pad, pool_type="max")
+    pooled = _fwd(pool, {"data": pool_in})[0]
+    x = np.random.randn(*pooled.shape).astype(np.float32)
+
+    up = mx.sym.Unpooling(
+        data=mx.sym.Variable("data"),
+        data_pool=mx.sym.Variable("data_pool"),
+        data_pooled=mx.sym.Variable("data_pooled"),
+        kernel=kernel, stride=stride, pad=pad)
+    loc = {"data": x, "data_pool": pool_in, "data_pooled": pooled}
+    out = _fwd(up, loc)[0]
+    expect = _np_unpool_oracle(x, pool_in, pooled, kernel, stride, pad)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    # shape inference completes data/data_pooled from data_pool alone
+    arg_shapes, out_shapes, _ = up.infer_shape(data_pool=pool_in.shape)
+    assert tuple(out_shapes[0]) == pool_in.shape
+    assert tuple(arg_shapes[0]) == pooled.shape
+
+    # backward: gradient flows to `data` only (guided gather); the guide
+    # inputs get zero gradient like `unpooling-inl.h:117-120`
+    check_numeric_gradient(up, loc, grad_nodes=["data"])

@@ -73,6 +73,10 @@ def main():
     if args.hostfile:
         with open(args.hostfile) as f:
             hosts = [h.strip() for h in f if h.strip()]
+        if args.host == "127.0.0.1":
+            ap.error("--hostfile requires an explicit --host (the address "
+                     "remote workers use to reach the parameter server); "
+                     "127.0.0.1 would point each worker at itself")
 
     for rank in range(args.num_workers):
         wenv = dict(base_env)
@@ -80,8 +84,9 @@ def main():
         wenv["DMLC_RANK"] = str(rank)
         if hosts:
             host = hosts[rank % len(hosts)]
+            extra_keys = {kv.partition("=")[0] for kv in args.env}
             envs = " ".join("%s=%s" % (k, v) for k, v in wenv.items()
-                            if k.startswith("DMLC_"))
+                            if k.startswith("DMLC_") or k in extra_keys)
             cmd = ["ssh", host, "cd %s && env %s %s"
                    % (os.getcwd(), envs, " ".join(args.command))]
             procs.append(subprocess.Popen(cmd))
