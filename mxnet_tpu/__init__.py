@@ -32,6 +32,9 @@ from .symbol import Symbol
 from . import executor
 from .executor import Executor
 
+from . import torch_bridge  # registers TorchModule/TorchCriterion
+from .torch_bridge import th
+
 # Attach registry-driven functions to both namespaces (the reference's
 # auto-generated API surfaces).
 ops.populate_nd(nd.__dict__)

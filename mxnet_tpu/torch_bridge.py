@@ -1,0 +1,333 @@
+"""Torch interoperability (the reference's torch plugin, rebuilt on PyTorch).
+
+Reference surface (`plugin/torch/`, `python/mxnet/torch.py`):
+
+* `mx.th.*` — Torch tensor math invoked on NDArrays (`torch_function.cc`,
+  `_th_*` registered functions auto-exposed in `python/mxnet/torch.py:20-120`).
+* `TorchModule` — run a Torch nn module as an operator whose parameters are
+  ordinary framework arguments (`torch_module-inl.h:25-41,264-319`): args are
+  `data_0..data_{num_data-1}` followed by the module's parameter tensors.
+* `TorchCriterion` — a Torch loss as a training head: args `data`/`label`,
+  output is the scalar loss broadcast to `(batch,)`, backward ignores the
+  incoming gradient and emits `d loss/d data * grad_scale`
+  (`torch_criterion-inl.h:94-183`).
+
+TPU-first mapping: the Lua/THC FFI becomes PyTorch-on-host behind
+`jax.pure_callback` + `jax.custom_vjp` (same bridge as NumpyOp — these are
+escape hatches that deliberately step outside XLA; each call is a host
+round-trip).  `lua_string` becomes `module_string`, a Python expression over
+`torch`/`nn` (e.g. ``"nn.Linear(4, 3)"``).  Gradients come from
+`torch.autograd` instead of a hand-written Backward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ops.registry import OpDef, Param, register
+
+# torch is imported lazily on first use: `import mxnet_tpu` must not pay
+# torch's multi-second import for users who never touch the bridge
+_torch = None
+_nn = None
+
+
+def _require_torch():
+    global _torch, _nn
+    if _torch is None:
+        try:
+            import torch
+            import torch.nn
+        except Exception as e:
+            raise MXNetError(
+                "torch is not available; TorchModule/mx.th need it (%s)" % e)
+        _torch = torch
+        _nn = torch.nn
+    return _torch
+
+
+def available():
+    try:
+        _require_torch()
+        return True
+    except MXNetError:
+        return False
+
+
+_MODULE_CACHE: dict[str, "object"] = {}
+
+
+def _get_module(expr):
+    """Instantiate (once) the torch module/criterion given by a Python
+    expression over `torch`/`nn` — the `lua_string` analogue."""
+    th = _require_torch()
+    mod = _MODULE_CACHE.get(expr)
+    if mod is None:
+        try:
+            mod = eval(expr, {"torch": th, "nn": _nn})  # noqa: S307
+        except Exception as e:
+            raise MXNetError("TorchModule: bad module_string %r: %s" % (expr, e))
+        if not isinstance(mod, th.nn.Module):
+            raise MXNetError(
+                "TorchModule: %r did not evaluate to a torch.nn.Module" % expr)
+        mod = mod.double()  # f64 master copy; cast per call
+        _MODULE_CACHE[expr] = mod
+    return mod
+
+
+def _load_params(mod, arrays):
+    th = _require_torch()
+    ps = list(mod.parameters())
+    if len(ps) != len(arrays):
+        raise MXNetError(
+            "TorchModule: module has %d parameters, got %d arrays"
+            % (len(ps), len(arrays)))
+    with th.no_grad():
+        for p, v in zip(ps, arrays):
+            p.copy_(th.from_numpy(np.asarray(v, np.float64)))
+    return ps
+
+
+class TorchModule(OpDef):
+    """`plugin/torch/torch_module-inl.h` — torch nn module as an operator."""
+
+    name = "TorchModule"
+    params = {
+        "module_string": Param(str, required=True,
+                               doc="python expression over torch/nn"),
+        "num_data": Param(int, default=1),
+        "num_params": Param(int, default=-1,
+                            doc="declared parameter count; -1 = derive"),
+        "num_outputs": Param(int, default=1),
+    }
+
+    def _nparams(self, params):
+        n = params["num_params"]
+        if n < 0:
+            n = len(list(_get_module(params["module_string"]).parameters()))
+        return n
+
+    def list_arguments(self, params):
+        # parameter args carry the torch module's own names (weight/bias/...)
+        # so initializer patterns apply, like reference ListArguments pulling
+        # names out of `module:parameters()` (`torch_module-inl.h:270-300`)
+        mod = _get_module(params["module_string"])
+        pnames = [n.replace(".", "_") for n, _ in mod.named_parameters()]
+        return (["data_%d" % i for i in range(params["num_data"])] + pnames)
+
+    def list_outputs(self, params):
+        n = params["num_outputs"]
+        return ["output"] if n == 1 else ["output_%d" % i for i in range(n)]
+
+    def infer_shape(self, params, in_shapes):
+        nd_ = params["num_data"]
+        mod = _get_module(params["module_string"])
+        ps = list(mod.parameters())
+        np_ = self._nparams(params)
+        if len(ps) != np_:
+            raise MXNetError(
+                "TorchModule: num_params=%d but module has %d parameters"
+                % (np_, len(ps)))
+        out = list(in_shapes)
+        for i, p in enumerate(ps):
+            want = tuple(p.shape)
+            got = in_shapes[nd_ + i]
+            if got is not None and tuple(got) != want:
+                raise MXNetError(
+                    "TorchModule: param_%d shape %s != module's %s"
+                    % (i, tuple(got), want))
+            out[nd_ + i] = want
+        data_shapes = in_shapes[:nd_]
+        n_out = params["num_outputs"]
+        if any(s is None for s in data_shapes):
+            return out, [None] * n_out, []
+        th = _require_torch()
+        mod.eval()  # dry run must not mutate running stats
+        with th.no_grad():
+            outs = mod(*[th.zeros(*s, dtype=th.float64) for s in data_shapes])
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        if len(outs) != n_out:
+            raise MXNetError(
+                "TorchModule: module returned %d outputs, num_outputs=%d"
+                % (len(outs), n_out))
+        return out, [tuple(o.shape) for o in outs], []
+
+    def apply(self, octx, params, inputs, aux):
+        _require_torch()
+        expr = params["module_string"]
+        nd_ = params["num_data"]
+        is_train = bool(octx.is_train)
+        in_shapes = [tuple(x.shape) for x in inputs]
+        _, out_shapes, _ = self.infer_shape(params, in_shapes)
+        dtype = inputs[0].dtype
+        out_avals = tuple(jax.ShapeDtypeStruct(s, dtype) for s in out_shapes)
+
+        def host_fwd(*arrs):
+            th = _require_torch()
+            mod = _get_module(expr)
+            # honor is_train like every native op (Dropout/BatchNorm do):
+            # eval() stops dropout firing and running stats mutating
+            mod.train(is_train)
+            _load_params(mod, arrs[nd_:])
+            datas = [th.from_numpy(np.asarray(a, np.float64)) for a in arrs[:nd_]]
+            with th.no_grad():
+                outs = mod(*datas)
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            return tuple(np.asarray(o.numpy(), dtype) for o in outs)
+
+        @jax.custom_vjp
+        def _op(*xs):
+            return jax.pure_callback(host_fwd, out_avals, *xs)
+
+        def _fwd(*xs):
+            return _op(*xs), xs
+
+        def _bwd(xs, gs):
+            def host_bwd(*arrs):
+                th = _require_torch()
+                k = len(xs)
+                mod = _get_module(expr)
+                mod.train(True)  # backward only exists for training
+                ps = _load_params(mod, arrs[nd_:k])
+                datas = [th.from_numpy(np.asarray(a, np.float64))
+                         .requires_grad_(True) for a in arrs[:nd_]]
+                for p in ps:
+                    p.requires_grad_(True)
+                outs = mod(*datas)
+                outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+                cots = [th.from_numpy(np.asarray(g, np.float64))
+                        for g in arrs[k:]]
+                grads = th.autograd.grad(
+                    outs, datas + ps, grad_outputs=cots, allow_unused=True)
+                for p in ps:
+                    p.requires_grad_(False)
+                return tuple(
+                    np.zeros(s, dtype) if g is None
+                    else np.asarray(g.detach().numpy(), dtype)
+                    for g, s in zip(grads, [a.shape for a in arrs[:k]]))
+
+            in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+            return jax.pure_callback(host_bwd, in_avals, *(xs + tuple(gs)))
+
+        _op.defvjp(_fwd, _bwd)
+        return list(_op(*inputs)), []
+
+
+register(TorchModule)
+
+
+class TorchCriterion(OpDef):
+    """`plugin/torch/torch_criterion-inl.h` — torch loss as a training head."""
+
+    name = "TorchCriterion"
+    params = {
+        "criterion_string": Param(str, required=True,
+                                  doc="python expression over torch/nn"),
+        "label_shape": Param("shape", default=()),
+        "grad_scale": Param(float, default=1.0),
+    }
+
+    def list_arguments(self, params):
+        return ["data", "label"]
+
+    def infer_shape(self, params, in_shapes):
+        d, l = in_shapes
+        if d is None:
+            return in_shapes, [None], []
+        lshape = (d[0],) + tuple(params["label_shape"])
+        if l is not None and tuple(l) != lshape:
+            raise MXNetError(
+                "TorchCriterion: label shape %s != expected %s"
+                % (tuple(l), lshape))
+        # loss broadcast to (batch,), `torch_criterion-inl.h:181`
+        return [d, lshape], [(d[0],)], []
+
+    def apply(self, octx, params, inputs, aux):
+        _require_torch()
+        expr = params["criterion_string"]
+        scale = params["grad_scale"]
+        data, label = inputs
+        batch = data.shape[0]
+        dtype = data.dtype
+
+        def host_loss(d, l):
+            th = _require_torch()
+            crit = _get_module(expr)
+            with th.no_grad():
+                loss = crit(th.from_numpy(np.asarray(d, np.float64)),
+                            th.from_numpy(np.asarray(l, np.float64)))
+            return np.full((batch,), float(loss) * scale, dtype)
+
+        def host_grad(d, l):
+            th = _require_torch()
+            crit = _get_module(expr)
+            dt = th.from_numpy(np.asarray(d, np.float64)).requires_grad_(True)
+            loss = crit(dt, th.from_numpy(np.asarray(l, np.float64)))
+            (g,) = th.autograd.grad(loss, [dt])
+            return (np.asarray(g.numpy()) * scale).astype(dtype)
+
+        @jax.custom_vjp
+        def _op(d, l):
+            return jax.pure_callback(
+                host_loss, jax.ShapeDtypeStruct((batch,), dtype), d, l)
+
+        def _fwd(d, l):
+            return _op(d, l), (d, l)
+
+        def _bwd(res, _g):
+            d, l = res
+            # training heads ignore the incoming gradient, like
+            # SoftmaxOutput and `torch_criterion-inl.h` Backward
+            gd = jax.pure_callback(
+                host_grad, jax.ShapeDtypeStruct(d.shape, d.dtype), d, l)
+            return gd, jnp.zeros_like(l)
+
+        _op.defvjp(_fwd, _bwd)
+        return [_op(data, label)], []
+
+
+register(TorchCriterion)
+
+
+class _TorchFunctions:
+    """`mx.th` — Torch tensor math over NDArrays (`python/mxnet/torch.py`).
+
+    Any `torch.<name>` function is reachable: NDArray/numpy arguments are
+    converted to torch tensors on host, the result converted back.  This is
+    an eager host-side bridge (no jit), matching the reference where every
+    `_th_*` call was an engine-scheduled host/devicefunction."""
+
+    def __getattr__(self, name):
+        th = _require_torch()
+        fn = getattr(th, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError("torch has no function %r" % name)
+
+        def wrapper(*args, **kwargs):
+            def conv(a):
+                if isinstance(a, NDArray):
+                    # copy: jax buffers are non-writable, torch wants mutable
+                    return th.from_numpy(np.array(a.asnumpy()))
+                if isinstance(a, np.ndarray):
+                    return th.from_numpy(np.array(a))
+                return a
+
+            out = fn(*[conv(a) for a in args],
+                     **{k: conv(v) for k, v in kwargs.items()})
+            if isinstance(out, th.Tensor):
+                return NDArray(jnp.asarray(out.numpy()))
+            if isinstance(out, (tuple, list)):
+                return type(out)(
+                    NDArray(jnp.asarray(o.numpy()))
+                    if isinstance(o, th.Tensor) else o for o in out)
+            return out
+
+        wrapper.__name__ = name
+        return wrapper
+
+
+th = _TorchFunctions()

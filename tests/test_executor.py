@@ -137,6 +137,7 @@ def test_backward_mirror_env(monkeypatch):
         exe.backward()
         return {k: g.asnumpy() for k, g in grads.items()}
 
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
     base = run()
     monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
     mirrored = run()

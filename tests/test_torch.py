@@ -1,0 +1,83 @@
+"""Torch interop plugin tests (reference `plugin/torch/`,
+`python/mxnet/torch.py`, `tests/python/unittest` torch paths +
+`example/torch` usage patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from common import check_numeric_gradient
+
+torch = pytest.importorskip("torch")
+
+
+def test_th_function_namespace():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = mx.th.exp(mx.nd.array(x))
+    np.testing.assert_allclose(out.asnumpy(), np.exp(x), rtol=1e-5)
+    # tuple-returning torch functions convert element-wise
+    vals, idx = mx.th.sort(mx.nd.array(x))
+    np.testing.assert_allclose(vals.asnumpy(), np.sort(x, axis=-1), rtol=1e-6)
+
+
+def test_torch_module_linear():
+    np.random.seed(0)
+    sym = mx.sym.TorchModule(
+        data_0=mx.sym.Variable("data"),
+        module_string="nn.Linear(4, 3)", num_data=1, num_outputs=1,
+        name="tm")
+    # param shapes come from the torch module itself
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(5, 4))
+    assert tuple(out_shapes[0]) == (5, 3)
+    assert tuple(arg_shapes[1]) == (3, 4)  # weight
+    assert tuple(arg_shapes[2]) == (3,)    # bias
+
+    loc = {
+        "data": np.random.randn(5, 4).astype(np.float32),
+        "tm_weight": np.random.randn(3, 4).astype(np.float32),
+        "tm_bias": np.random.randn(3).astype(np.float32),
+    }
+    args = {k: mx.nd.array(v) for k, v in loc.items()}
+    exe = sym.bind(mx.cpu(), args, None, "null")
+    out = exe.forward(is_train=False)[0].asnumpy()
+    expect = loc["data"].dot(loc["tm_weight"].T) + loc["tm_bias"]
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    # torch.autograd-derived backward vs finite differences
+    check_numeric_gradient(sym, loc)
+
+
+def test_torch_criterion_mse():
+    np.random.seed(1)
+    sym = mx.sym.TorchCriterion(
+        data=mx.sym.Variable("data"), label=mx.sym.Variable("label"),
+        criterion_string="nn.MSELoss()", label_shape=(3,), grad_scale=2.0)
+    d = np.random.randn(4, 3).astype(np.float32)
+    l = np.random.randn(4, 3).astype(np.float32)
+    args = {"data": mx.nd.array(d), "label": mx.nd.array(l)}
+    grads = {"data": mx.nd.zeros(d.shape)}
+    exe = sym.bind(mx.cpu(), args, grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    # scalar loss broadcast to (batch,) like `torch_criterion-inl.h:181`
+    expect_loss = 2.0 * np.mean((d - l) ** 2)
+    np.testing.assert_allclose(out, np.full(4, expect_loss), rtol=1e-5)
+    exe.backward()
+    # MSE grad: 2*(d-l)/numel, scaled by grad_scale
+    np.testing.assert_allclose(
+        grads["data"].asnumpy(), 2.0 * 2 * (d - l) / d.size, rtol=1e-4)
+
+
+def test_torch_module_trains():
+    """TorchModule parameters are ordinary args: an optimizer can train
+    through the host bridge (the plugin's raison d'etre)."""
+    np.random.seed(2)
+    data = mx.sym.Variable("data")
+    tm = mx.sym.TorchModule(data_0=data, module_string="nn.Linear(2, 2)",
+                            name="tm")
+    net = mx.sym.SoftmaxOutput(data=tm, label=mx.sym.Variable("softmax_label"))
+    x = np.random.randn(32, 2).astype(np.float32)
+    y = (x[:, 0] > x[:, 1]).astype(np.float32)
+    model = mx.model.FeedForward(net, num_epoch=6, learning_rate=0.5)
+    model.fit(X=mx.io.NDArrayIter(x, y, batch_size=8))
+    pred = model.predict(mx.io.NDArrayIter(x, batch_size=8))
+    acc = ((pred.argmax(axis=1) == y).mean())
+    assert acc > 0.9, acc

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -37,7 +38,9 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1,
                     help="only 1 server supported by the TCP backend")
-    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--host", default=None,
+                    help="address workers use to reach the parameter server "
+                         "(default 127.0.0.1; required with --hostfile)")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--hostfile", default=None,
                     help="file with one host per line; workers run via ssh")
@@ -47,6 +50,16 @@ def main():
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+
+    hosts = None
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        if args.host is None:
+            ap.error("--hostfile requires an explicit --host (the address "
+                     "remote workers use to reach the parameter server)")
+    if args.host is None:
+        args.host = "127.0.0.1"
 
     port = args.port or _free_port()
     base_env = dict(os.environ)
@@ -69,23 +82,15 @@ def main():
                   "from mxnet_tpu.parallel.dist import run_server; run_server()"]
     procs.append(subprocess.Popen(server_cmd, env=senv))
 
-    hosts = None
-    if args.hostfile:
-        with open(args.hostfile) as f:
-            hosts = [h.strip() for h in f if h.strip()]
-        if args.host == "127.0.0.1":
-            ap.error("--hostfile requires an explicit --host (the address "
-                     "remote workers use to reach the parameter server); "
-                     "127.0.0.1 would point each worker at itself")
-
+    extra_keys = {kv.partition("=")[0] for kv in args.env}
     for rank in range(args.num_workers):
         wenv = dict(base_env)
         wenv["DMLC_ROLE"] = "worker"
         wenv["DMLC_RANK"] = str(rank)
         if hosts:
             host = hosts[rank % len(hosts)]
-            extra_keys = {kv.partition("=")[0] for kv in args.env}
-            envs = " ".join("%s=%s" % (k, v) for k, v in wenv.items()
+            envs = " ".join("%s=%s" % (k, shlex.quote(v))
+                            for k, v in wenv.items()
                             if k.startswith("DMLC_") or k in extra_keys)
             cmd = ["ssh", host, "cd %s && env %s %s"
                    % (os.getcwd(), envs, " ".join(args.command))]
