@@ -1,0 +1,105 @@
+"""FCN-xs semantic segmentation training (reference `example/fcn-xs/fcn_xs.py`).
+
+Trains FCN-32s/16s/8s on PASCAL-VOC-format data (or a synthetic stand-in when
+no data directory is given — blobs of distinct classes on a background, enough
+to watch per-pixel accuracy climb).  The reference trains the variants in
+sequence, initializing each from the previous checkpoint
+(`example/fcn-xs/run_fcnxs.sh`); pass --init-prefix to do the same here.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def synthetic_seg_batches(num_batches, batch_size, num_classes, size, seed=0):
+    """Blob segmentation task: K squares of random class on background 0."""
+    rng = np.random.RandomState(seed)
+    for _ in range(num_batches):
+        data = rng.rand(batch_size, 3, size, size).astype(np.float32) * 0.1
+        label = np.zeros((batch_size, size, size), np.float32)
+        for b in range(batch_size):
+            for _k in range(3):
+                c = rng.randint(1, num_classes)
+                h0, w0 = rng.randint(0, size // 2, 2)
+                hs, ws = rng.randint(size // 8, size // 2, 2)
+                label[b, h0:h0 + hs, w0:w0 + ws] = c
+                data[b, :, h0:h0 + hs, w0:w0 + ws] += c / float(num_classes)
+        yield data, label
+
+
+class PixelAccuracy(mx.metric.CustomMetric):
+    def __init__(self):
+        super().__init__(
+            lambda label, pred: float(
+                (pred.argmax(axis=1) == label).mean()),
+            name="pixel_acc")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="fcn8s",
+                    choices=["fcn32s", "fcn16s", "fcn8s"])
+    ap.add_argument("--num-classes", type=int, default=21)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--num-batches", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--init-prefix", default=None,
+                    help="load params from a previous variant's checkpoint")
+    ap.add_argument("--save-prefix", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = models.get_fcn_xs(num_classes=args.num_classes,
+                            variant=args.variant)
+    exe = net.simple_bind(mx.Context.default_ctx, grad_req="write",
+                          data=(args.batch_size, 3, args.size, args.size))
+    init = mx.initializer.Xavier(magnitude=2.0)
+    bilinear = mx.initializer.Bilinear()
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        if name.startswith(("upscore", "score2_", "score4_")):
+            # bilinear upsampling init (reference init_fcnxs.py:20-34)
+            bilinear(name, arr)
+        else:
+            init(name, arr)
+    if args.init_prefix:
+        loaded = mx.nd.load("%s.params" % args.init_prefix)
+        for k, v in loaded.items():
+            name = k.split(":", 1)[1]
+            if name in exe.arg_dict and exe.arg_dict[name].shape == v.shape:
+                exe.arg_dict[name][:] = v
+
+    opt = mx.optimizer.SGD(learning_rate=args.lr, momentum=0.9, wd=5e-4)
+    updater = mx.optimizer.get_updater(opt)
+    metric = PixelAccuracy()
+    arg_names = net.list_arguments()
+
+    for i, (data, label) in enumerate(synthetic_seg_batches(
+            args.num_batches, args.batch_size, args.num_classes, args.size)):
+        exe.arg_dict["data"][:] = data
+        exe.arg_dict["softmax_label"][:] = label
+        exe.forward(is_train=True)
+        exe.backward()
+        for j, name in enumerate(arg_names):
+            if name in ("data", "softmax_label"):
+                continue
+            updater(j, exe.grad_dict[name], exe.arg_dict[name])
+        metric.reset()
+        metric.update([mx.nd.array(label)], [exe.outputs[0]])
+        if i % 5 == 0 or i == args.num_batches - 1:
+            logging.info("batch %d %s=%.4f", i, *metric.get())
+
+    if args.save_prefix:
+        mx.nd.save("%s.params" % args.save_prefix,
+                   {"arg:%s" % k: v for k, v in exe.arg_dict.items()
+                    if k not in ("data", "softmax_label")})
+
+
+if __name__ == "__main__":
+    main()

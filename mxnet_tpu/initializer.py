@@ -164,6 +164,18 @@ class MSRAPrelu(Xavier):
         super().__init__("gaussian", factor_type, magnitude)
 
 
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernels for any parameter name: the public form
+    of the `upsampling*`-prefix dispatch, for Deconvolution weights whose
+    names do not carry the prefix (FCN-xs `init_fcnxs.py:20-34`)."""
+
+    def __call__(self, name, arr):
+        self._init_bilinear(name, arr)
+
+    def _init_weight(self, name, arr):
+        self._init_bilinear(name, arr)
+
+
 class Load:
     """Initialize from a dict of saved arrays, fall back to `default_init`
     (`initializer.py` Load)."""

@@ -11,3 +11,4 @@ from .lstm import lstm_unroll, lstm_cell
 from .transformer import get_transformer_lm, transformer_block
 from .googlenet import get_googlenet
 from .inception_v3 import get_inception_v3
+from .fcn_xs import get_fcn_xs

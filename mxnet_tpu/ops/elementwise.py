@@ -87,7 +87,7 @@ class _Dot(OpDef):
     """2-D matrix product (`ndarray.cc` Dot; mshadow `dot`).
 
     The canonical MXU op: on TPU this is a single `jnp.dot` lowered to the
-    systolic array; accumulate in float32 even for bf16 inputs.
+    systolic array (which accumulates bf16 products in f32 natively).
     """
 
     name = "dot"
@@ -104,11 +104,7 @@ class _Dot(OpDef):
         return [a, b], [(a[0], b[1])], []
 
     def apply(self, octx, params, inputs, aux):
-        return [
-            jnp.dot(inputs[0], inputs[1], preferred_element_type=jnp.float32).astype(
-                inputs[0].dtype
-            )
-        ], []
+        return [jnp.dot(inputs[0], inputs[1])], []
 
 
 register(_Dot)
@@ -131,11 +127,7 @@ class _BatchDot(OpDef):
         return [a, b], [(a[0], a[1], b[2])], []
 
     def apply(self, octx, params, inputs, aux):
-        return [
-            jnp.matmul(inputs[0], inputs[1], preferred_element_type=jnp.float32).astype(
-                inputs[0].dtype
-            )
-        ], []
+        return [jnp.matmul(inputs[0], inputs[1])], []
 
 
 register(_BatchDot)

@@ -165,6 +165,10 @@ class FullyConnected(OpDef):
                 # partial backward inference: batch unknown
                 out = None
             return in_shapes, [None], []
+        if len(d) < 2:
+            raise MXNetError(
+                "FullyConnected: data must be (batch, ...) with at least 2 "
+                "dims, got %s" % (d,))
         flat = int(np.prod(d[1:]))
         shapes = [d, (nh, flat)]
         if not params["no_bias"]:
@@ -174,7 +178,10 @@ class FullyConnected(OpDef):
     def apply(self, octx, params, inputs, aux):
         x = inputs[0].reshape(inputs[0].shape[0], -1)
         w = inputs[1]
-        y = jnp.dot(x, w.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        # no explicit accumulation dtype: the TPU MXU accumulates bf16
+        # matmuls in f32 natively, and preferred_element_type!=operand dtype
+        # is not transposable through lax.conv/astype chains
+        y = jnp.dot(x, w.T)
         if not params["no_bias"]:
             y = y + inputs[2]
         return [y], []
@@ -240,8 +247,7 @@ class Convolution(OpDef):
             rhs_dilation=dil,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=params["num_group"],
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if not params["no_bias"]:
             y = y + inputs[2].reshape(1, -1, 1, 1)
         return [y], []
@@ -299,8 +305,7 @@ class Deconvolution(OpDef):
             lhs_dilation=s,
             dimension_numbers=("NCHW", "IOHW", "NCHW"),
             feature_group_count=params["num_group"],
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if not params["no_bias"]:
             y = y + inputs[2].reshape(1, -1, 1, 1)
         return [y], []
@@ -526,21 +531,30 @@ class BatchNorm(OpDef):
         if params["fix_gamma"]:
             gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
         if octx.is_train and not params["use_global_stats"]:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # batch statistics and the EMA always accumulate in f32: under
+            # bf16 compute, bf16 variance loses ~8 mantissa bits and EMA
+            # deltas below 2^-8 vanish entirely
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             m = params["momentum"]
-            new_mean = moving_mean * m + mean * (1 - m)
-            new_var = moving_var * m + var * (1 - m)
+            new_mean = (moving_mean.astype(jnp.float32) * m
+                        + mean * (1 - m)).astype(moving_mean.dtype)
+            new_var = (moving_var.astype(jnp.float32) * m
+                       + var * (1 - m)).astype(moving_var.dtype)
             aux_updates = [jax.lax.stop_gradient(new_mean),
                            jax.lax.stop_gradient(new_var)]
         else:
             mean, var = moving_mean, moving_var
             aux_updates = [None, None]
-        inv = jax.lax.rsqrt(var.reshape(bshape) + params["eps"])
-        out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(
-            bshape
-        )
-        return [out, mean, var], aux_updates
+        # normalize in the compute dtype (stats cast down at the use site)
+        mean_c = mean.astype(x.dtype)
+        inv = jax.lax.rsqrt(var.astype(x.dtype).reshape(bshape)
+                            + jnp.asarray(params["eps"], x.dtype))
+        out = (x - mean_c.reshape(bshape)) * inv \
+            * gamma.astype(x.dtype).reshape(bshape) \
+            + beta.astype(x.dtype).reshape(bshape)
+        return [out, mean_c, var.astype(x.dtype)], aux_updates
 
 
 register(BatchNorm)

@@ -61,6 +61,10 @@ class SPMDTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.lr, self.momentum, self.wd = lr, momentum, wd
+        # Mixed precision, the TPU way: master params/momenta/aux stay f32,
+        # compute casts to `dtype` (bf16 on the MXU) inside the jitted step,
+        # and vjp's cast-transpose returns f32 gradients for the f32 update.
+        self._compute_dtype = jnp.dtype(dtype)
         arg_shapes, _, aux_shapes = symbol.infer_shape(**data_shapes)
         if arg_shapes is None:
             raise MXNetError("cannot infer shapes from %s" % (data_shapes,))
@@ -80,7 +84,7 @@ class SPMDTrainer:
         self._param_sharding = {}
         params = {}
         for n in self.param_names:
-            host = zeros(shape_of[n], dtype=dtype)
+            host = zeros(shape_of[n], dtype=np.float32)
             initializer(n, host)
             sh = (param_sharding or {}).get(n, repl)
             self._param_sharding[n] = sh
@@ -91,7 +95,7 @@ class SPMDTrainer:
             for n, v in params.items()
         }
         self.aux = {
-            n: jax.device_put(jnp.zeros(s, dtype=dtype), repl)
+            n: jax.device_put(jnp.zeros(s, dtype=np.float32), repl)
             for n, s in zip(self.aux_names, aux_shapes)
         }
         for n in self.aux_names:  # aux init: means 0, vars 1
@@ -102,14 +106,28 @@ class SPMDTrainer:
         graph_fn, _, _ = _build_graph_fn(symbol)
         batch_sharding = NamedSharding(mesh, P("data"))
         self._batch_sharding = batch_sharding
+        # stacked (nsteps, batch, ...) inputs for run_steps: steps axis
+        # replicated, batch axis sharded over "data"
+        self._stacked_sharding = NamedSharding(mesh, P(None, "data"))
+        self._shape_of = shape_of
         self._base_key = _random.next_key()
         global_batch = shape_of[self.data_names[0]][0]
         rescale = 1.0 / global_batch
 
+        cd = self._compute_dtype
+
+        def cast_arg(name, x):
+            # labels stay in their own dtype (class ids > 256 are not exact
+            # in bf16); everything else floating casts to the compute dtype
+            if "label" in name or not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            return x.astype(cd)
+
         def step(params, momenta, aux, batch, rng):
             def f(p):
                 args = [
-                    batch[n] if n in batch else p[n] for n in self.arg_names
+                    cast_arg(n, batch[n] if n in batch else p[n])
+                    for n in self.arg_names
                 ]
                 aux_list = [aux[n] for n in self.aux_names]
                 outs, new_aux = graph_fn(args, aux_list, rng, True)
@@ -127,11 +145,51 @@ class SPMDTrainer:
 
         self._step = jax.jit(step, donate_argnums=(0, 1, 2))
 
+        def multi_step(params, momenta, aux, batch, rng, nsteps):
+            """nsteps fused train steps in ONE XLA program (lax.scan), so
+            dispatch/host latency is paid once per call instead of per step.
+            `batch` leaves either have a leading (nsteps, ...) axis (fresh
+            data each step) or are a single step's batch reused every step."""
+            stacked = {
+                n: v.ndim > len(shape_of.get(n, v.shape)) for n, v in batch.items()
+            }
+
+            def body(carry, i):
+                params, momenta, aux = carry
+                b = {n: (v[i] if stacked[n] else v) for n, v in batch.items()}
+                rng_i = jax.random.fold_in(rng, i)
+
+                def f(p):
+                    args = [
+                        cast_arg(n, b[n] if n in b else p[n])
+                        for n in self.arg_names
+                    ]
+                    aux_list = [aux[n] for n in self.aux_names]
+                    outs, new_aux = graph_fn(args, aux_list, rng_i, True)
+                    return outs, new_aux
+
+                outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
+                cot = tuple(jnp.ones_like(o) for o in outs)
+                (grads,) = vjp(cot)
+                new_params, new_momenta = _sgd_update(
+                    params, grads, momenta, self.lr, self.momentum, self.wd,
+                    rescale,
+                )
+                aux_out = dict(zip(self.aux_names, new_aux))
+                return (new_params, new_momenta, aux_out), ()
+
+            (params, momenta, aux), _ = jax.lax.scan(
+                body, (params, momenta, aux), jnp.arange(nsteps))
+            return params, momenta, aux
+
+        self._multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2),
+                                   static_argnums=(5,))
+
         def fwd(params, aux, batch, rng):
-            args = [batch[n] if n in batch else params[n]
+            args = [cast_arg(n, batch[n] if n in batch else params[n])
                     for n in self.arg_names]
-            outs, _ = graph_fn(args, [aux[n] for n in self.aux_names], rng,
-                               False)
+            outs, _ = graph_fn(args, [aux[n] for n in self.aux_names],
+                               rng, False)
             return outs
 
         self._fwd = jax.jit(fwd)
@@ -143,7 +201,11 @@ class SPMDTrainer:
         out = {}
         for n, v in batch.items():
             arr = v.data if isinstance(v, NDArray) else jnp.asarray(v)
-            out[n] = jax.device_put(arr, self._batch_sharding)
+            stacked = (n in self._shape_of
+                       and arr.ndim > len(self._shape_of[n]))
+            out[n] = jax.device_put(
+                arr, self._stacked_sharding if stacked
+                else self._batch_sharding)
         return out
 
     def step(self, batch):
@@ -154,6 +216,15 @@ class SPMDTrainer:
             self.params, self.momenta, self.aux, self.shard_batch(batch), rng
         )
         return outs
+
+    def run_steps(self, batch, nsteps):
+        """nsteps fused steps in one dispatch (see multi_step).  `batch`
+        leaves may carry a leading (nsteps, ...) axis for per-step data."""
+        self._nstep += nsteps
+        rng = jax.random.fold_in(self._base_key, self._nstep)
+        self.params, self.momenta, self.aux = self._multi_step(
+            self.params, self.momenta, self.aux, self.shard_batch(batch),
+            rng, nsteps)
 
     def forward(self, batch):
         rng = jax.random.fold_in(self._base_key, 0)

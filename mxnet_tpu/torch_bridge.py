@@ -96,6 +96,7 @@ class TorchModule(OpDef):
     """`plugin/torch/torch_module-inl.h` — torch nn module as an operator."""
 
     name = "TorchModule"
+    need_rng = True
     params = {
         "module_string": Param(str, required=True,
                                doc="python expression over torch/nn"),
@@ -165,13 +166,22 @@ class TorchModule(OpDef):
         _, out_shapes, _ = self.infer_shape(params, in_shapes)
         dtype = inputs[0].dtype
         out_avals = tuple(jax.ShapeDtypeStruct(s, dtype) for s in out_shapes)
+        # Stochastic modules (dropout): forward and the backward's re-forward
+        # must draw the SAME torch RNG stream, or the gradients would belong
+        # to a different loss than the one computed.  Derive a per-application
+        # seed from the executor rng and thread it through both callbacks.
+        if is_train and octx.rng is not None:
+            seed = jax.random.randint(octx.require_rng(), (), 0, 2 ** 31 - 1)
+        else:
+            seed = jnp.zeros((), jnp.int32)
 
-        def host_fwd(*arrs):
+        def host_fwd(seed_arr, *arrs):
             th = _require_torch()
             mod = _get_module(expr)
             # honor is_train like every native op (Dropout/BatchNorm do):
             # eval() stops dropout firing and running stats mutating
             mod.train(is_train)
+            th.manual_seed(int(seed_arr))
             _load_params(mod, arrs[nd_:])
             datas = [th.from_numpy(np.asarray(a, np.float64)) for a in arrs[:nd_]]
             with th.no_grad():
@@ -180,18 +190,25 @@ class TorchModule(OpDef):
             return tuple(np.asarray(o.numpy(), dtype) for o in outs)
 
         @jax.custom_vjp
-        def _op(*xs):
-            return jax.pure_callback(host_fwd, out_avals, *xs)
+        def _op(seed, *xs):
+            return jax.pure_callback(host_fwd, out_avals, seed, *xs)
 
-        def _fwd(*xs):
-            return _op(*xs), xs
+        def _fwd(seed, *xs):
+            return _op(seed, *xs), (seed, xs)
 
-        def _bwd(xs, gs):
-            def host_bwd(*arrs):
+        def _bwd(res, gs):
+            seed, xs = res
+
+            def host_bwd(seed_arr, *arrs):
                 th = _require_torch()
                 k = len(xs)
                 mod = _get_module(expr)
-                mod.train(True)  # backward only exists for training
+                mod.train(is_train)
+                th.manual_seed(int(seed_arr))  # same masks as host_fwd
+                # snapshot buffers (BN running stats): host_fwd already
+                # applied this step's update; the re-forward must not
+                # apply it a second time
+                buffers = {n: b.clone() for n, b in mod.named_buffers()}
                 ps = _load_params(mod, arrs[nd_:k])
                 datas = [th.from_numpy(np.asarray(a, np.float64))
                          .requires_grad_(True) for a in arrs[:nd_]]
@@ -205,16 +222,22 @@ class TorchModule(OpDef):
                     outs, datas + ps, grad_outputs=cots, allow_unused=True)
                 for p in ps:
                     p.requires_grad_(False)
+                with th.no_grad():
+                    for n, b in mod.named_buffers():
+                        b.copy_(buffers[n])
                 return tuple(
                     np.zeros(s, dtype) if g is None
                     else np.asarray(g.detach().numpy(), dtype)
                     for g, s in zip(grads, [a.shape for a in arrs[:k]]))
 
             in_avals = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
-            return jax.pure_callback(host_bwd, in_avals, *(xs + tuple(gs)))
+            # integer primal (seed) takes a float0 cotangent
+            seed_cot = np.zeros((), dtype=jax.dtypes.float0)
+            return (seed_cot,) + tuple(jax.pure_callback(
+                host_bwd, in_avals, seed, *(xs + tuple(gs))))
 
         _op.defvjp(_fwd, _bwd)
-        return list(_op(*inputs)), []
+        return list(_op(seed, *inputs)), []
 
 
 register(TorchModule)

@@ -103,3 +103,29 @@ def test_transformer_lm_shapes():
                                     num_layers=2, num_heads=4, num_embed=32)
     _, out_shapes, _ = net.infer_shape(data=(4, 12), softmax_label=(4, 12))
     assert out_shapes[0] == (48, 100)
+
+
+@pytest.mark.parametrize("variant,stride", [("fcn32s", 32), ("fcn16s", 16),
+                                            ("fcn8s", 8)])
+def test_fcn_xs_shapes(variant, stride):
+    net = models.get_fcn_xs(num_classes=21, variant=variant)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 64, 64))
+    assert out_shapes[0] == (1, 21, 64, 64)
+
+
+def test_fcn8s_train_step():
+    net = models.get_fcn_xs(num_classes=5, variant="fcn8s")
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=(1, 3, 32, 32))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.05
+    exe.arg_dict["data"][:] = rng.randn(1, 3, 32, 32).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = rng.randint(0, 5, (1, 32, 32)).astype(np.float32)
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    assert out.shape == (1, 5, 32, 32)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-4)
+    exe.backward()
+    g = exe.grad_dict["score_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

@@ -93,7 +93,8 @@ def main():
                             for k, v in wenv.items()
                             if k.startswith("DMLC_") or k in extra_keys)
             cmd = ["ssh", host, "cd %s && env %s %s"
-                   % (os.getcwd(), envs, " ".join(args.command))]
+                   % (shlex.quote(os.getcwd()), envs,
+                      " ".join(shlex.quote(c) for c in args.command))]
             procs.append(subprocess.Popen(cmd))
         else:
             procs.append(subprocess.Popen(args.command, env=wenv))
