@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Fast-gradient-sign adversarial examples (reference `example/adversary/`).
+
+Trains a small classifier, then perturbs inputs by `eps * sign(dL/dx)` and
+reports the accuracy drop.  Exercises gradients with respect to *data*:
+`bind(args_grad=...)` includes the data entry, the capability the reference
+demonstrates by binding data with grad (`adversary_generation.ipynb`).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_net(num_classes):
+    import mxnet_tpu.symbol as sym
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act1 = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(data=act1, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--eps", type=float, default=0.3)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epoch", type=int, default=10)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n, d, k = 2048, 64, 10
+    y = rng.randint(0, k, n)
+    X = rng.randn(n, d).astype(np.float32) * 0.3
+    X[np.arange(n), y * 6] += 2.5
+
+    net = build_net(k)
+    exe = net.simple_bind(mx.Context.default_ctx(), grad_req="write",
+                          data=(args.batch_size, d))
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    updater = mx.optimizer.get_updater(opt)
+    arg_names = net.list_arguments()
+
+    nb = n // args.batch_size
+    for epoch in range(args.num_epoch):
+        correct = 0
+        for i in range(nb):
+            s = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            exe.arg_dict["data"][:] = X[s]
+            exe.arg_dict["softmax_label"][:] = y[s].astype(np.float32)
+            exe.forward(is_train=True)
+            exe.backward()
+            for j, nm in enumerate(arg_names):
+                if nm not in ("data", "softmax_label"):
+                    updater(j, exe.grad_dict[nm], exe.arg_dict[nm])
+            correct += (exe.outputs[0].asnumpy().argmax(1) == y[s]).sum()
+        logging.info("epoch %d train-acc %.4f", epoch, correct / (nb * args.batch_size))
+
+    # FGSM attack: one forward/backward to get dL/dx, then x' = x + eps*sign
+    clean_ok = adv_ok = 0
+    for i in range(nb):
+        s = slice(i * args.batch_size, (i + 1) * args.batch_size)
+        exe.arg_dict["data"][:] = X[s]
+        exe.arg_dict["softmax_label"][:] = y[s].astype(np.float32)
+        exe.forward(is_train=True)
+        clean_ok += (exe.outputs[0].asnumpy().argmax(1) == y[s]).sum()
+        exe.backward()
+        gsign = np.sign(exe.grad_dict["data"].asnumpy())
+        exe.arg_dict["data"][:] = X[s] + args.eps * gsign
+        exe.forward(is_train=False)
+        adv_ok += (exe.outputs[0].asnumpy().argmax(1) == y[s]).sum()
+    total = nb * args.batch_size
+    logging.info("clean accuracy    %.4f", clean_ok / total)
+    logging.info("FGSM(eps=%.2f) accuracy %.4f", args.eps, adv_ok / total)
+
+
+if __name__ == "__main__":
+    main()

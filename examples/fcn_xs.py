@@ -56,7 +56,7 @@ def main():
 
     net = models.get_fcn_xs(num_classes=args.num_classes,
                             variant=args.variant)
-    exe = net.simple_bind(mx.Context.default_ctx, grad_req="write",
+    exe = net.simple_bind(mx.Context.default_ctx(), grad_req="write",
                           data=(args.batch_size, 3, args.size, args.size))
     init = mx.initializer.Xavier(magnitude=2.0)
     bilinear = mx.initializer.Bilinear()

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Multi-task training with a grouped symbol (reference
+`example/multi-task/example_multi_task.py`).
+
+One shared trunk, two softmax heads (the reference predicts the MNIST digit
+and digit%2 simultaneously); the loss group is `sym.Group([head1, head2])`
+and both gradients flow into the trunk in one backward pass.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+import mxnet_tpu.symbol as sym  # noqa: E402
+
+
+def build_net(num_classes):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act1 = sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc_digit = sym.FullyConnected(data=act1, num_hidden=num_classes,
+                                  name="fc_digit")
+    sm_digit = sym.SoftmaxOutput(data=fc_digit, name="softmax_digit")
+    fc_par = sym.FullyConnected(data=act1, num_hidden=2, name="fc_parity")
+    sm_par = sym.SoftmaxOutput(data=fc_par, name="softmax_parity")
+    return sym.Group([sm_digit, sm_par])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epoch", type=int, default=12)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    n, d, k = 2048, 64, 10
+    y = rng.randint(0, k, n)
+    X = rng.randn(n, d).astype(np.float32) * 0.3
+    X[np.arange(n), y * 6] += 2.5
+    y_par = (y % 2).astype(np.float32)
+
+    net = build_net(k)
+    exe = net.simple_bind(mx.Context.default_ctx(), grad_req="write",
+                          data=(args.batch_size, d))
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if "label" not in name and name != "data":
+            init(name, arr)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    updater = mx.optimizer.get_updater(opt)
+    arg_names = net.list_arguments()
+
+    nb = n // args.batch_size
+    for epoch in range(args.num_epoch):
+        ok_d = ok_p = 0
+        for i in range(nb):
+            s = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            exe.arg_dict["data"][:] = X[s]
+            exe.arg_dict["softmax_digit_label"][:] = y[s].astype(np.float32)
+            exe.arg_dict["softmax_parity_label"][:] = y_par[s]
+            exe.forward(is_train=True)
+            exe.backward()
+            for j, nm in enumerate(arg_names):
+                if "label" not in nm and nm != "data":
+                    updater(j, exe.grad_dict[nm], exe.arg_dict[nm])
+            ok_d += (exe.outputs[0].asnumpy().argmax(1) == y[s]).sum()
+            ok_p += (exe.outputs[1].asnumpy().argmax(1) == y_par[s]).sum()
+        logging.info("epoch %d digit-acc %.4f parity-acc %.4f", epoch,
+                     ok_d / (nb * args.batch_size), ok_p / (nb * args.batch_size))
+
+
+if __name__ == "__main__":
+    main()

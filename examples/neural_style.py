@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Neural style transfer (reference `example/neural-style/nstyle.py`).
+
+Optimizes the *input image* — not the network weights — to match the content
+activations of one image and the Gram-matrix style statistics of another,
+through a fixed convnet.  Exercises: grad w.r.t. data, `GetInternals()` to
+tap intermediate activations, and executor `backward(out_grads)` with
+custom head gradients (the reference pushes style/content loss grads the
+same way, `nstyle.py` train loop).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+import mxnet_tpu.symbol as sym  # noqa: E402
+
+
+def build_feature_net():
+    """Small VGG-ish feature stack; style taps after each block, content at
+    the deepest tap (the reference taps relu1_1..relu5_1 of VGG-19)."""
+    data = sym.Variable("data")
+    taps = []
+    x = data
+    for stage, nf in enumerate((16, 32, 64), 1):
+        x = sym.Convolution(data=x, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                            name="conv%d" % stage)
+        x = sym.Activation(data=x, act_type="relu", name="relu%d" % stage)
+        taps.append(x)
+        x = sym.Pooling(data=x, pool_type="avg", kernel=(2, 2), stride=(2, 2),
+                        name="pool%d" % stage)
+    return sym.Group(taps)
+
+
+def gram(feat):
+    """(C, H*W) gram matrix of an NCHW activation (numpy, batch of 1)."""
+    c = feat.shape[1]
+    f = feat.reshape(c, -1)
+    return f @ f.T / f.shape[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--num-steps", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--style-weight", type=float, default=1.0)
+    ap.add_argument("--content-weight", type=float, default=10.0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    s = args.size
+
+    # synthetic "images": content = blobs, style = stripes
+    content_img = np.zeros((1, 3, s, s), np.float32)
+    content_img[:, :, s // 4: s // 2, s // 4: 3 * s // 4] = 1.0
+    style_img = np.tile(
+        (np.arange(s) % 8 < 4).astype(np.float32), (1, 3, s, 1))
+
+    net = build_feature_net()
+    exe = net.simple_bind(mx.Context.default_ctx(), grad_req="write",
+                          data=(1, 3, s, s))
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            init(name, arr)
+
+    def extract(img):
+        exe.arg_dict["data"][:] = img
+        exe.forward(is_train=False)
+        return [o.asnumpy() for o in exe.outputs]
+
+    content_feats = extract(content_img)
+    style_grams = [gram(f) for f in extract(style_img)]
+
+    img = rng.randn(1, 3, s, s).astype(np.float32) * 0.1
+    for step in range(args.num_steps):
+        exe.arg_dict["data"][:] = img
+        exe.forward(is_train=True)
+        feats = [o.asnumpy() for o in exe.outputs]
+        head_grads = []
+        loss = 0.0
+        for i, f in enumerate(feats):
+            g = np.zeros_like(f)
+            if i == len(feats) - 1:  # content tap
+                diff = f - content_feats[i]
+                loss += args.content_weight * float((diff ** 2).mean())
+                g += args.content_weight * 2 * diff / diff.size
+            gm = gram(f)
+            c, hw = f.shape[1], f.shape[2] * f.shape[3]
+            gdiff = gm - style_grams[i]
+            loss += args.style_weight * float((gdiff ** 2).sum())
+            # d/df of gram loss: 2/(HW) * (G - G_style) @ F
+            gg = (2.0 / hw) * (gdiff @ f.reshape(c, -1))
+            g += args.style_weight * gg.reshape(f.shape)
+            head_grads.append(mx.nd.array(g))
+        exe.backward(head_grads)
+        img -= args.lr * exe.grad_dict["data"].asnumpy()
+        if step % 10 == 0 or step == args.num_steps - 1:
+            logging.info("step %d loss %.5f", step, loss)
+
+
+if __name__ == "__main__":
+    main()

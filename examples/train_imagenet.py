@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""ImageNet-scale training harness (reference
+`example/image-classification/train_imagenet.py` + `train_model.py`).
+
+Two training paths, same models:
+  --trainer spmd (default): `parallel.SPMDTrainer` — one jitted
+    fwd+bwd+update program over the device mesh, bf16 compute, the
+    TPU-native equivalent of multi-GPU DP + kvstore='device'.
+  --trainer feedforward: the reference-style `FeedForward.fit` loop with an
+    explicit kvstore ('local'/'device'/'dist_sync').
+
+Data: ImageRecordIter when --data-dir holds RecordIO packs (build with
+tools/im2rec.py), else synthetic labeled noise at ImageNet shapes.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def get_net(name, num_classes):
+    if name == "resnet":
+        return models.get_resnet(num_classes=num_classes, num_layers=50)
+    if name == "resnet18":
+        return models.get_resnet(num_classes=num_classes, num_layers=18)
+    if name == "alexnet":
+        return models.get_alexnet(num_classes=num_classes)
+    if name == "vgg":
+        return models.get_vgg(num_classes=num_classes)
+    if name == "googlenet":
+        return models.get_googlenet(num_classes=num_classes)
+    if name == "inception-bn":
+        return models.get_inception_bn(num_classes=num_classes,
+                                       image_shape=(3, 224, 224))
+    if name == "inception-v3":
+        return models.get_inception_v3(num_classes=num_classes)
+    raise ValueError("unknown network %r" % name)
+
+
+def synthetic_batches(batch_size, image, num_classes, seed=0):
+    rng = np.random.RandomState(seed)
+    while True:
+        yield {
+            "data": rng.randn(batch_size, 3, image, image).astype(np.float32),
+            "softmax_label": rng.randint(
+                0, num_classes, (batch_size,)).astype(np.float32),
+        }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--num-batches", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--trainer", default="spmd",
+                    choices=["spmd", "feedforward"])
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = get_net(args.network, args.num_classes)
+
+    if args.trainer == "feedforward":
+        if args.data_dir:
+            train = mx.io.ImageRecordIter(
+                path_imgrec=os.path.join(args.data_dir, "train.rec"),
+                data_shape=(3, args.image_size, args.image_size),
+                batch_size=args.batch_size, shuffle=True)
+        else:
+            gen = synthetic_batches(args.batch_size, args.image_size,
+                                    args.num_classes)
+            batches = [next(gen) for _ in range(8)]
+            train = mx.io.NDArrayIter(
+                np.concatenate([b["data"] for b in batches]),
+                np.concatenate([b["softmax_label"] for b in batches]),
+                batch_size=args.batch_size)
+        model = mx.model.FeedForward(
+            symbol=net, ctx=mx.Context.default_ctx(), num_epoch=1,
+            optimizer="sgd", learning_rate=args.lr,
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+        model.fit(X=train, kvstore=args.kv_store,
+                  batch_end_callback=mx.callback.Speedometer(
+                      args.batch_size, 10))
+        return
+
+    # SPMD path
+    import jax
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+    from mxnet_tpu.base import bfloat16
+
+    dtype = bfloat16 if args.dtype == "bfloat16" else np.float32
+    n_avail = len(jax.devices())
+    n_dev = next(k for k in range(n_avail, 0, -1) if args.batch_size % k == 0)
+    mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes={"data": (args.batch_size, 3, args.image_size,
+                              args.image_size),
+                     "softmax_label": (args.batch_size,)},
+        initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+        lr=args.lr, momentum=0.9, wd=1e-4, dtype=dtype)
+    gen = synthetic_batches(args.batch_size, args.image_size,
+                            args.num_classes)
+    t0 = time.time()
+    seen = 0
+    for i in range(args.num_batches):
+        trainer.step(next(gen))
+        seen += args.batch_size
+        if (i + 1) % 10 == 0:
+            jax.block_until_ready(trainer.params)
+            dt = time.time() - t0
+            logging.info("batch %d  %.1f images/sec", i + 1, seen / dt)
+    jax.block_until_ready(trainer.params)
+    logging.info("done: %.1f images/sec overall", seen / (time.time() - t0))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,9 +65,13 @@ class PythonOp:
                 return pyop.list_outputs()
 
             def infer_shape(self, params, in_shapes):
-                if any(s is None for s in in_shapes):
+                # the user op derives missing input shapes (e.g. the label
+                # from the data, reference NumpyOp.infer_shape contract), so
+                # only the first input must be known
+                if in_shapes[0] is None:
                     return in_shapes, [None] * len(pyop.list_outputs()), []
-                ins, outs = pyop.infer_shape([list(s) for s in in_shapes])
+                ins, outs = pyop.infer_shape(
+                    [list(s) if s is not None else None for s in in_shapes])
                 return ([tuple(s) for s in ins], [tuple(s) for s in outs], [])
 
             def apply(self, octx, params, inputs, aux):

@@ -1,0 +1,72 @@
+"""Example scripts smoke tests (reference runs its examples in nightlies;
+here each example runs a tiny configuration end-to-end in-process)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def run_example(name, *argv, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(EXAMPLES, "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name), *argv],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, (proc.stdout[-2000:] + proc.stderr[-2000:])
+    return proc.stdout + proc.stderr
+
+
+def test_adversary_fgsm():
+    out = run_example("adversary_fgsm.py", "--num-epoch", "4",
+                      "--batch-size", "64")
+    assert "FGSM" in out
+
+
+def test_autoencoder():
+    out = run_example("autoencoder.py", "--dims", "32,16",
+                      "--pretrain-epochs", "4", "--finetune-epochs", "6")
+    assert "finetune rmse" in out
+
+
+def test_bayesian_sgld():
+    out = run_example("bayesian_sgld.py", "--num-steps", "120",
+                      "--burn-in", "60", "--thin", "20")
+    assert "posterior-mean rmse" in out
+
+
+def test_cnn_text_classification():
+    out = run_example("cnn_text_classification.py", "--num-epoch", "2",
+                      "--seq-len", "16", "--vocab-size", "50")
+    assert "final val accuracy" in out
+
+
+def test_multi_task():
+    out = run_example("multi_task.py", "--num-epoch", "3")
+    assert "parity-acc" in out
+
+
+def test_numpy_ops():
+    out = run_example("numpy_ops.py", "--num-epoch", "3")
+    assert "acc" in out
+
+
+def test_neural_style():
+    out = run_example("neural_style.py", "--size", "32", "--num-steps", "8")
+    assert "loss" in out
+
+
+def test_fcn_xs_example():
+    out = run_example("fcn_xs.py", "--variant", "fcn32s", "--size", "32",
+                      "--num-batches", "4", "--batch-size", "2")
+    assert "pixel_acc" in out
+
+
+def test_train_imagenet_spmd_tiny():
+    out = run_example("train_imagenet.py", "--network", "resnet18",
+                      "--num-classes", "16", "--image-size", "32",
+                      "--batch-size", "8", "--num-batches", "10",
+                      "--dtype", "float32")
+    assert "images/sec overall" in out
