@@ -18,6 +18,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -171,7 +172,20 @@ class DistKVStore(KVStore):
         uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
         self._addr = (uri, port)
-        self._sock = socket.create_connection(self._addr, timeout=120)
+        # the server process imports jax before it binds; retry refused
+        # connections until it is up (`ps::Postoffice` handshakes similarly)
+        deadline = time.time() + float(
+            os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
+        while True:
+            try:
+                self._sock = socket.create_connection(self._addr, timeout=120)
+                break
+            except (ConnectionRefusedError, OSError):
+                if time.time() > deadline:
+                    raise MXNetError(
+                        "cannot reach parameter server at %s:%d"
+                        % self._addr)
+                time.sleep(0.2)
         self._sock_lock = threading.Lock()
         if "async" in kv_type:
             self._rpc({"op": "set_sync", "sync": False})

@@ -1,0 +1,72 @@
+"""Multi-process distributed kvstore tests (reference
+`tests/nightly/dist_sync_kvstore.py` run via `tools/launch.py -n 4` on
+localhost, `tests/nightly/test_all.sh:34-37`).
+
+The BSP oracle: weight initialized to 1; each of n workers pushes
+ones*(rank+1) per round, the server's 'test' optimizer applies
+`w += rate * sum(grads)`, so after nrepeat rounds every pulled value must
+equal `1 + rate * n(n+1)/2 * nrepeat` exactly
+(`dist_sync_kvstore.py:30-46`).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+
+    shape = (3, 4)
+    nrepeat = 4
+    rate = 2.0
+    kv = mx.kv.create(os.environ["TEST_KV_TYPE"])
+    rank, nworker = kv.rank, kv.num_workers
+    kv.init(3, mx.nd.ones(shape))
+    kv.set_optimizer(mx.optimizer.Test(rescale_grad=rate))
+    out = mx.nd.zeros(shape)
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape) * (rank + 1))
+        kv.pull(3, out=out)
+    kv.barrier()
+    kv.pull(3, out=out)
+    if os.environ["TEST_KV_TYPE"] == "dist_sync":
+        expect = 1 + rate * nworker * (nworker + 1) / 2 * nrepeat
+        got = out.asnumpy()
+        assert np.allclose(got, expect), (got[0, 0], expect)
+        print("rank %d oracle ok: %.1f" % (rank, expect))
+    else:
+        print("rank %d async done" % rank)
+    kv.barrier()
+    if rank == 0:
+        kv.stop_server()
+""")
+
+
+def run_launch(n, kv_type, timeout=180):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env["TEST_KV_TYPE"] = kv_type
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), sys.executable, "-c", WORKER],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    return proc.stdout + proc.stderr
+
+
+def test_dist_sync_closed_form_oracle_4_workers():
+    out = run_launch(4, "dist_sync")
+    # every worker must have verified the closed form
+    assert out.count("oracle ok") == 4, out[-2000:]
+
+
+def test_dist_async_smoke():
+    out = run_launch(2, "dist_async")
+    assert out.count("async done") == 2, out[-2000:]

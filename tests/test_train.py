@@ -108,3 +108,69 @@ def test_speedometer_runs(caplog):
     model = mx.model.FeedForward(symbol=_mlp(), ctx=mx.cpu(), num_epoch=1,
                                  numpy_batch_size=20)
     model.fit(X, y, batch_end_callback=mx.callback.Speedometer(20, 5))
+
+
+def test_multi_device_determinism():
+    """`tests/nightly/multi_lenet.py` analogue: with randomness removed
+    (fixed init, no shuffle, no dropout), k-device data-parallel training
+    must match single-device results."""
+    X, y = make_blobs(n=256)
+
+    def train(ctx):
+        mx.random.seed(7)
+        it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=False)
+        m = mx.model.FeedForward(
+            symbol=_mlp(), ctx=ctx, num_epoch=3, optimizer="sgd",
+            learning_rate=0.1, initializer=mx.init.Uniform(0.07))
+        m.fit(X=it)
+        return {k: v.asnumpy() for k, v in m.arg_params.items()}
+
+    single = train(mx.cpu(0))
+    multi = train([mx.cpu(0), mx.cpu(1)])
+    for k in single:
+        np.testing.assert_allclose(single[k], multi[k], rtol=2e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_spmd_trainer_matches_executor_loop():
+    """The fused SPMDTrainer step and the reference-style executor+updater
+    loop must produce the same parameters (same init, same data)."""
+    import jax
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    X, y = make_blobs(n=128)
+    net = _mlp()
+    batch = 64
+    mx.random.seed(11)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    tr = SPMDTrainer(net, mesh,
+                     data_shapes={"data": (batch, 20),
+                                  "softmax_label": (batch,)},
+                     initializer=mx.init.Uniform(0.07),
+                     lr=0.1, momentum=0.0, wd=0.0)
+    init_params = {k: np.asarray(v) for k, v in tr.params.items()}
+    for i in range(2):
+        s = slice(i * batch, (i + 1) * batch)
+        tr.step({"data": X[s], "softmax_label": y[s]})
+    spmd_params = {k: np.asarray(v) for k, v in tr.params.items()}
+
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=(batch, 20))
+    for k, v in init_params.items():
+        exe.arg_dict[k][:] = v
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.0, wd=0.0,
+                           rescale_grad=1.0 / batch)
+    updater = mx.optimizer.get_updater(opt)
+    arg_names = net.list_arguments()
+    for i in range(2):
+        s = slice(i * batch, (i + 1) * batch)
+        exe.arg_dict["data"][:] = X[s]
+        exe.arg_dict["softmax_label"][:] = y[s]
+        exe.forward(is_train=True)
+        exe.backward()
+        for j, nm in enumerate(arg_names):
+            if nm not in ("data", "softmax_label"):
+                updater(j, exe.grad_dict[nm], exe.arg_dict[nm])
+    for k in spmd_params:
+        np.testing.assert_allclose(
+            spmd_params[k], exe.arg_dict[k].asnumpy(),
+            rtol=2e-4, atol=1e-5, err_msg=k)
