@@ -57,6 +57,7 @@ from . import kvstore
 from . import kvstore as kv
 from . import predictor
 from .predictor import Predictor
+from . import storage
 from . import model
 from .model import FeedForward
 from . import module as mod

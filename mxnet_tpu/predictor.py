@@ -192,6 +192,99 @@ class Predictor:
         """Convenience: forward + first output."""
         return self.forward(**inputs).get_output(0)
 
+    def export(self, path):
+        """Serialize the compiled model to ONE self-contained artifact —
+        the TPU analogue of amalgamation's `mxnet_predict-all.cc` single
+        deployable (`amalgamation/README.md:1-30`): StableHLO via
+        `jax.export` + parameters, loadable by `load_exported` with no
+        Symbol graph, no op registry, no re-trace."""
+        from jax import export as jax_export
+
+        def infer(inputs, params_aux):
+            args = list(params_aux[0])
+            for n, v in zip(self._input_names, inputs):
+                args[self._arg_index[n]] = v
+            outs, _ = self._graph_fn(args, list(params_aux[1]), None, False)
+            return outs
+
+        input_avals = tuple(
+            jax.ShapeDtypeStruct(
+                self._arg_arrays[self._arg_index[n]].shape, self._dtype)
+            for n in self._input_names)
+        params_avals = (
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in self._arg_arrays),
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in self._aux_arrays),
+        )
+        exported = jax_export.export(jax.jit(infer))(
+            input_avals, params_avals)
+        payload = {
+            "stablehlo": exported.serialize(),
+            "input_names": self._input_names,
+            "input_shapes": {
+                n: tuple(self._arg_arrays[self._arg_index[n]].shape)
+                for n in self._input_names},
+            "dtype": np.dtype(self._dtype).name,
+            "out_shapes": [tuple(s) for s in self._out_shapes],
+            "args": [np.asarray(a) for a in self._arg_arrays],
+            "aux": [np.asarray(a) for a in self._aux_arrays],
+        }
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+
+
+class ExportedPredictor:
+    """Inference from an `export()` artifact: no graph, no registry —
+    deserialized StableHLO executed directly (the amalgamated predictor)."""
+
+    def __init__(self, path, ctx=None):
+        import pickle
+        from jax import export as jax_export
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        self._fn = jax_export.deserialize(payload["stablehlo"])
+        self._input_names = payload["input_names"]
+        self._input_shapes = payload["input_shapes"]
+        self._dtype = np.dtype(payload["dtype"])
+        self._out_shapes = payload["out_shapes"]
+        self._params = (tuple(jnp.asarray(a) for a in payload["args"]),
+                        tuple(jnp.asarray(a) for a in payload["aux"]))
+        self._outputs = None
+
+    def forward(self, **inputs):
+        unknown = [n for n in inputs if n not in self._input_names]
+        if unknown:
+            raise MXNetError(
+                "ExportedPredictor: unknown inputs %s (inputs: %s)"
+                % (unknown, self._input_names))
+        # absent inputs zero-fill, like the predict ABI which only takes
+        # data inputs (label heads are inert at inference)
+        vals = tuple(
+            jnp.asarray(
+                getattr(inputs[n], "asnumpy", lambda n=n: inputs[n])())
+            if n in inputs
+            else jnp.zeros(self._input_shapes[n], self._dtype)
+            for n in self._input_names)
+        self._outputs = self._fn.call(vals, self._params)
+        return self
+
+    def get_output(self, index=0):
+        if self._outputs is None:
+            raise MXNetError("ExportedPredictor: call forward() first")
+        return np.asarray(self._outputs[index])
+
+    def predict(self, **inputs):
+        return self.forward(**inputs).get_output(0)
+
+
+def load_exported(path, ctx=None):
+    """Load a single-artifact predictor written by `Predictor.export`."""
+    return ExportedPredictor(path, ctx=ctx)
+
 
 def load(prefix, epoch, input_shapes, ctx=None, **kwargs):
     """Create a Predictor from a FeedForward checkpoint

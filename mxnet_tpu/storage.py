@@ -1,0 +1,148 @@
+"""Device memory manager (reference `src/storage/`, `include/mxnet/storage.h`).
+
+What survives the TPU translation and what doesn't:
+
+- XLA owns HBM for compiled programs (its allocator replaces both the
+  reference's `GraphStorageAllocator` and most raw `cudaMalloc` traffic), so
+  ordinary tensors never touch this module.
+- What remains ours is the *imperative-side* buffer pool the reference's
+  `PooledStorageManager` provides (`pooled_storage_manager.h:21-83`):
+  explicit `Alloc/Free` of scratch device buffers with an exact-size free
+  list per device and a dump-everything cap, plus visibility into device
+  memory (`Storage` was also the reference's one place to ask "how much is
+  allocated where").
+
+API parity: `Storage.get().alloc(size, ctx) -> Handle{size, ctx, data}`,
+`free(handle)` (returns to pool), `release_all()`, `pool_stats()`, and
+`device_memory_stats(ctx)` surfacing the TPU runtime's live HBM counters
+(`jax.Device.memory_stats`).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context
+
+
+class Handle:
+    """`Storage::Handle` (`storage.h:22-40`): a sized device buffer."""
+
+    __slots__ = ("data", "size", "ctx", "_freed")
+
+    def __init__(self, data, size, ctx):
+        self.data = data
+        self.size = size
+        self.ctx = ctx
+        self._freed = False
+
+    def asnumpy(self):
+        return np.asarray(self.data)
+
+
+class Storage:
+    """Singleton pooled allocator (`storage.cc:99-105` Storage::Get).
+
+    Pool policy matches `PooledStorageManager`: free() caches the buffer on
+    an exact-size free list keyed by (ctx, size); alloc() of the same size
+    reuses it without touching the device allocator; when cached bytes
+    exceed the cap (`MXNET_STORAGE_POOL_CAP_BYTES`, reference hardcoded
+    4 GB at `storage.cc:28`) everything cached is dropped.
+    """
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._pools = {}  # (ctx_str, size) -> [buffers]
+        self._cached_bytes = {}  # ctx_str -> int
+        self._live = {}  # ctx_str -> int, currently alloc'd via this manager
+        self._mu = threading.Lock()
+        self.cap_bytes = int(os.environ.get(
+            "MXNET_STORAGE_POOL_CAP_BYTES", str(4 << 30)))
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Storage()
+            return cls._instance
+
+    def alloc(self, size, ctx=None):
+        if size < 0:
+            raise MXNetError("Storage.alloc: negative size %d" % size)
+        ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+        key = (str(ctx), int(size))
+        with self._mu:
+            pool = self._pools.get(key)
+            if pool:
+                buf = pool.pop()
+                self._cached_bytes[key[0]] -= size
+                self._live[key[0]] = self._live.get(key[0], 0) + size
+                return Handle(buf, size, ctx)
+        buf = jax.device_put(jnp.zeros(max(size, 1), jnp.uint8),
+                             ctx.jax_device())
+        with self._mu:
+            self._live[key[0]] = self._live.get(key[0], 0) + size
+        return Handle(buf, size, ctx)
+
+    def free(self, handle):
+        if handle._freed:
+            raise MXNetError("Storage.free: double free")
+        handle._freed = True
+        key = (str(handle.ctx), int(handle.size))
+        with self._mu:
+            self._live[key[0]] = self._live.get(key[0], 0) - handle.size
+            cached = self._cached_bytes.get(key[0], 0) + handle.size
+            if cached > self.cap_bytes:
+                # dump-all policy (`pooled_storage_manager.h:44-50`)
+                for k in [k for k in self._pools if k[0] == key[0]]:
+                    del self._pools[k]
+                self._cached_bytes[key[0]] = 0
+                return
+            self._pools.setdefault(key, []).append(handle.data)
+            self._cached_bytes[key[0]] = cached
+
+    def release_all(self, ctx=None):
+        """`DirectFreeAll`: drop every cached buffer (for ctx, or all)."""
+        with self._mu:
+            if ctx is None:
+                self._pools.clear()
+                self._cached_bytes.clear()
+            else:
+                cs = str(Context(ctx) if not isinstance(ctx, Context) else ctx)
+                for k in [k for k in self._pools if k[0] == cs]:
+                    del self._pools[k]
+                self._cached_bytes[cs] = 0
+
+    def pool_stats(self):
+        """{ctx: {"cached_bytes": n, "live_bytes": n, "cached_buffers": n}}"""
+        with self._mu:
+            out = {}
+            for (cs, size), bufs in self._pools.items():
+                d = out.setdefault(cs, {"cached_bytes": 0, "live_bytes": 0,
+                                        "cached_buffers": 0})
+                d["cached_bytes"] += size * len(bufs)
+                d["cached_buffers"] += len(bufs)
+            for cs, live in self._live.items():
+                d = out.setdefault(cs, {"cached_bytes": 0, "live_bytes": 0,
+                                        "cached_buffers": 0})
+                d["live_bytes"] = live
+            return out
+
+
+def device_memory_stats(ctx=None):
+    """Live HBM counters from the TPU runtime (`jax.Device.memory_stats`):
+    bytes_in_use, peak_bytes_in_use, bytes_limit when the platform reports
+    them; {} on platforms that don't (CPU)."""
+    ctx = Context(ctx) if ctx is not None and not isinstance(ctx, Context) \
+        else (ctx or Context.default_ctx())
+    dev = ctx.jax_device()
+    stats = dev.memory_stats()
+    return dict(stats) if stats else {}

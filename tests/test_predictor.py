@@ -82,3 +82,26 @@ def test_partial_forward(tmp_path):
     full = pred.forward(data=X[:4]).get_output(0)
     all_steps = pred.partial_forward(10**6)
     np.testing.assert_allclose(all_steps[-1][1], full, rtol=1e-5)
+
+
+def test_export_single_artifact_roundtrip(tmp_path):
+    """Predictor.export -> load_exported: one deployable file, no Symbol or
+    op registry at load time (amalgamation-analogue contract)."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.predictor import load_exported
+
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=8, name="fc")
+    net = sym.SoftmaxOutput(data=fc, name="softmax")
+    rng = np.random.RandomState(3)
+    params = {"fc_weight": rng.randn(8, 12).astype(np.float32) * 0.2,
+              "fc_bias": np.zeros(8, np.float32)}
+    pred = mx.Predictor(net, params, {"data": (4, 12)})
+    x = rng.randn(4, 12).astype(np.float32)
+    want = pred.predict(data=x)
+
+    path = str(tmp_path / "model.mxtpu")
+    pred.export(path)
+    loaded = load_exported(path)
+    got = loaded.predict(data=x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)

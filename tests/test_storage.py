@@ -1,0 +1,73 @@
+"""Storage pool tests (port of `tests/cpp/storage_test.cc`: alloc/free/
+pool-reuse invariants)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.storage import Storage, device_memory_stats
+
+
+@pytest.fixture
+def storage():
+    # fresh instance per test so live-byte accounting is isolated
+    # (Storage.get() is the production singleton)
+    yield Storage()
+
+
+def test_alloc_free_roundtrip(storage):
+    h = storage.alloc(1024, mx.cpu())
+    assert h.size == 1024
+    assert h.data.shape == (1024,)
+    storage.free(h)
+    stats = storage.pool_stats()
+    key = str(mx.cpu())
+    assert stats[key]["cached_bytes"] == 1024
+    assert stats[key]["cached_buffers"] == 1
+
+
+def test_pool_reuse_exact_size(storage):
+    h1 = storage.alloc(4096, mx.cpu())
+    buf = h1.data
+    storage.free(h1)
+    h2 = storage.alloc(4096, mx.cpu())
+    assert h2.data is buf  # exact-size free list returned the same buffer
+    h3 = storage.alloc(2048, mx.cpu())
+    assert h3.data is not buf
+
+
+def test_double_free_rejected(storage):
+    h = storage.alloc(64, mx.cpu())
+    storage.free(h)
+    with pytest.raises(MXNetError):
+        storage.free(h)
+
+
+def test_cap_dumps_pool(storage):
+    storage.cap_bytes = 10_000
+    hs = [storage.alloc(4096, mx.cpu()) for _ in range(3)]
+    for h in hs:
+        storage.free(h)
+    # third free exceeded the cap -> everything dumped
+    stats = storage.pool_stats()
+    assert stats[str(mx.cpu())]["cached_bytes"] == 0
+    storage.cap_bytes = 4 << 30
+
+
+def test_live_bytes_accounting(storage):
+    h1 = storage.alloc(1000, mx.cpu())
+    h2 = storage.alloc(500, mx.cpu())
+    assert storage.pool_stats()[str(mx.cpu())]["live_bytes"] == 1500
+    storage.free(h1)
+    assert storage.pool_stats()[str(mx.cpu())]["live_bytes"] == 500
+    storage.free(h2)
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats(mx.cpu())
+    assert isinstance(stats, dict)  # CPU may report {} — shape contract only
+
+
+def test_negative_size_rejected(storage):
+    with pytest.raises(MXNetError):
+        storage.alloc(-1, mx.cpu())
