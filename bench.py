@@ -8,6 +8,11 @@ Workload: the BASELINE.json north star — ResNet-50 ImageNet-shape training
 f32 accumulation).  `vs_baseline` compares images/sec/chip against the
 reference's only published absolute throughput: ~170 images/sec on 4 GPUs
 (`docs/tutorials/imagenet_full.md:45`) = 42.5 images/sec/device.
+
+Calibration: a hand-written pure-jnp NHWC ResNet-50 train step (scan-fused,
+bf16, f32 BN stats) measures ~14.8% MFU on the same single v5e chip; the
+framework path measures ~12.8% — the Symbol->XLA executor costs <15% vs
+hand-tuned JAX, the rest is the model/chip reality at this batch size.
 """
 from __future__ import annotations
 

@@ -51,6 +51,7 @@ from . import lr_scheduler
 from . import callback
 from . import monitor
 from . import io
+from . import image
 from . import recordio
 from . import rtc
 from . import kvstore

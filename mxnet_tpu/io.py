@@ -398,14 +398,39 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size,
                  part_index=0, num_parts=1, preprocess_threads=4,
                  prefetch_buffer=4, data_name="data",
-                 label_name="softmax_label", use_native=None):
+                 label_name="softmax_label", use_native=None,
+                 rand_crop=False, rand_mirror=False, mean_img=None,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, scale=1.0,
+                 max_random_contrast=0.0, max_random_illumination=0.0,
+                 record_shape=None):
         super().__init__()
         from . import _native
         from . import recordio as _recordio
 
         self.batch_size = batch_size
         self._data_shape = tuple(int(x) for x in check_shape(data_shape))
-        self._sample_len = int(np.prod(self._data_shape))
+        # on-device augmentation (image.py): records may be stored larger
+        # than data_shape (record_shape) so random crops have margin,
+        # mirroring the reference's decode-then-crop flow
+        self._record_shape = tuple(int(x) for x in check_shape(record_shape)) \
+            if record_shape else self._data_shape
+        self._augmenter = None
+        if (rand_crop or rand_mirror or mean_img is not None
+                or any((mean_r, mean_g, mean_b))
+                or scale != 1.0 or max_random_contrast
+                or max_random_illumination
+                or self._record_shape != self._data_shape):
+            from .image import ImageAugmenter
+
+            mean_rgb = [mean_r, mean_g, mean_b] \
+                if any((mean_r, mean_g, mean_b)) else None
+            self._augmenter = ImageAugmenter(
+                data_shape=self._data_shape, rand_crop=rand_crop,
+                rand_mirror=rand_mirror,
+                max_random_contrast=max_random_contrast,
+                max_random_illumination=max_random_illumination,
+                mean_img=mean_img, mean_rgb=mean_rgb, scale=scale)
+        self._sample_len = int(np.prod(self._record_shape))
         self._path = path_imgrec
         self._part_index = part_index
         self._num_parts = num_parts
@@ -421,7 +446,7 @@ class ImageRecordIter(DataIter):
                 self._sample_len, preprocess_threads, prefetch_buffer)
             _native.check(self._handle != 0, "loader_open")
             import ctypes
-            self._data_buf = np.zeros((batch_size,) + self._data_shape,
+            self._data_buf = np.zeros((batch_size,) + self._record_shape,
                                       np.float32)
             self._label_buf = np.zeros((batch_size,), np.float32)
             self._data_ptr = self._data_buf.ctypes.data_as(
@@ -494,14 +519,14 @@ class ImageRecordIter(DataIter):
             if n <= 0:
                 raise StopIteration
             return DataBatch(
-                data=[array(self._data_buf.copy())],
+                data=[self._finish(self._data_buf)],
                 label=[array(self._label_buf.copy())],
                 pad=self.batch_size - n,
                 provide_data=self.provide_data,
                 provide_label=self.provide_label,
             )
         # ---- pure-python fallback ----
-        data = np.zeros((self.batch_size,) + self._data_shape, np.float32)
+        data = np.zeros((self.batch_size,) + self._record_shape, np.float32)
         label = np.zeros((self.batch_size,), np.float32)
         n = 0
         while n < self.batch_size:
@@ -509,17 +534,23 @@ class ImageRecordIter(DataIter):
             if buf is None:
                 break
             header, img = self._recordio_mod.unpack_img(buf)
-            data[n] = np.asarray(img, np.float32).reshape(self._data_shape)
+            data[n] = np.asarray(img, np.float32).reshape(self._record_shape)
             label[n] = header.label
             n += 1
         if n == 0:
             raise StopIteration
         return DataBatch(
-            data=[array(data)], label=[array(label)],
+            data=[self._finish(data)], label=[array(label)],
             pad=self.batch_size - n,
             provide_data=self.provide_data,
             provide_label=self.provide_label,
         )
+
+    def _finish(self, data):
+        """Apply the on-device augmentation pipeline (or plain wrap)."""
+        if self._augmenter is None:
+            return array(data.copy() if data is not None else data)
+        return array(np.asarray(self._augmenter(data)))
 
     def close(self):
         if self._native and self._handle:

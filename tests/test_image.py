@@ -1,0 +1,98 @@
+"""On-device image augmentation tests (reference `src/io/image_augmenter.h`
+crop/mirror/jitter + `src/io/iter_normalize.h` mean-subtract semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.image import ImageAugmenter, compute_mean_image
+
+
+def make_batch(n=4, c=3, h=12, w=12, seed=0):
+    return np.random.RandomState(seed).rand(n, c, h, w).astype(np.float32)
+
+
+def test_center_crop_no_rand():
+    batch = make_batch(h=12, w=12)
+    aug = ImageAugmenter(data_shape=(3, 8, 8), rand_crop=False)
+    out = np.asarray(aug(batch))
+    assert out.shape == (4, 3, 8, 8)
+    np.testing.assert_allclose(out, batch[:, :, 2:10, 2:10], rtol=1e-6)
+
+
+def test_rand_crop_stays_in_bounds_and_varies():
+    batch = make_batch(h=16, w=16)
+    aug = ImageAugmenter(data_shape=(3, 8, 8), rand_crop=True, seed=1)
+    outs = [np.asarray(aug(batch)) for _ in range(4)]
+    assert all(o.shape == (4, 3, 8, 8) for o in outs)
+    assert any(not np.allclose(outs[0], o) for o in outs[1:])
+
+
+def test_rand_mirror_produces_flips():
+    batch = make_batch(n=16, h=8, w=8)
+    aug = ImageAugmenter(rand_mirror=True, seed=2)
+    out = np.asarray(aug(batch))
+    flipped = sum(
+        bool(np.allclose(out[i], batch[i, :, :, ::-1])) for i in range(16))
+    kept = sum(bool(np.allclose(out[i], batch[i])) for i in range(16))
+    assert flipped + kept == 16 and flipped > 0 and kept > 0
+
+
+def test_mean_rgb_and_scale():
+    batch = make_batch()
+    aug = ImageAugmenter(mean_rgb=[0.1, 0.2, 0.3], scale=2.0)
+    out = np.asarray(aug(batch))
+    want = (batch - np.array([0.1, 0.2, 0.3], np.float32)
+            .reshape(1, 3, 1, 1)) * 2.0
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_contrast_jitter_preserves_mean_roughly():
+    batch = make_batch(n=8)
+    aug = ImageAugmenter(max_random_contrast=0.5, seed=3)
+    out = np.asarray(aug(batch))
+    np.testing.assert_allclose(out.mean(axis=(1, 2, 3)),
+                               batch.mean(axis=(1, 2, 3)), atol=1e-3)
+
+
+def test_crop_larger_than_input_rejected():
+    aug = ImageAugmenter(data_shape=(3, 16, 16))
+    with pytest.raises(MXNetError):
+        aug(make_batch(h=8, w=8))
+
+
+def test_compute_mean_image_and_subtract(tmp_path):
+    X = make_batch(n=8, h=6, w=6)
+    it = mx.io.NDArrayIter(X, np.zeros(8, np.float32), batch_size=4)
+    path = str(tmp_path / "mean.npy")
+    mean = compute_mean_image(it, path=path)
+    np.testing.assert_allclose(mean, X.mean(axis=0), rtol=1e-5)
+    aug = ImageAugmenter(mean_img=path)
+    out = np.asarray(aug(X))
+    np.testing.assert_allclose(out, X - X.mean(axis=0), atol=1e-6)
+
+
+def test_image_record_iter_augmented(tmp_path):
+    """End-to-end: records stored at 3x10x10, iterated at 3x8x8 with
+    rand_crop+mirror through ImageRecordIter."""
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "pack.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        img = (rng.rand(3, 10, 10) * 255).astype(np.float32)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img))
+    rec.close()
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 8, 8), record_shape=(3, 10, 10),
+        batch_size=4, rand_crop=True, rand_mirror=True, scale=1.0 / 255,
+        use_native=False)
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape == (4, 3, 8, 8)
+        arr = b.data[0].asnumpy()
+        assert arr.max() <= 1.0 + 1e-6
