@@ -59,6 +59,7 @@ from . import kvstore as kv
 from . import predictor
 from .predictor import Predictor
 from . import storage
+from . import checkpoint
 from . import model
 from .model import FeedForward
 from . import module as mod

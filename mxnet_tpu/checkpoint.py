@@ -1,0 +1,120 @@
+"""Checkpoint / resume with optimizer state and atomic writes.
+
+Reference behavior (`python/mxnet/model.py:315-377`, SURVEY §5.4):
+`prefix-symbol.json` + `prefix-%04d.params`, resume via
+`FeedForward.load(..., begin_epoch=k)`.  Two reference gaps fixed here:
+
+1. **Optimizer state was not checkpointed** (momentum restarted from zero
+   after resume) — `save` also writes `prefix-%04d.states` holding the
+   updater's per-key optimizer state, and `load` restores it.
+2. **Non-atomic writes** — a worker killed mid-save left a corrupt
+   checkpoint; all files here are written to a temp name then
+   `os.replace`d, and `prefix-latest` is only updated after the data files
+   are durable, so `resume()` never sees a torn checkpoint.
+
+The `.params` format stays byte-compatible with `nd.save` (`arg:`/`aux:`
+keys) so plain `load_checkpoint` / the reference tooling can still read it.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+def _atomic_write(path, write_fn):
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _states_to_host(states):
+    """updater.states {key: state} -> picklable numpy pytree."""
+
+    def conv(v):
+        if isinstance(v, NDArray):
+            return v.asnumpy()
+        if isinstance(v, (tuple, list)):
+            return type(v)(conv(x) for x in v)
+        return v
+
+    return {k: conv(v) for k, v in states.items()}
+
+
+def _states_from_host(states):
+    from .ndarray import array
+
+    def conv(v):
+        if isinstance(v, np.ndarray):
+            return array(v)
+        if isinstance(v, (tuple, list)):
+            return type(v)(conv(x) for x in v)
+        return v
+
+    return {k: conv(v) for k, v in states.items()}
+
+
+def save(prefix, epoch, symbol, arg_params, aux_params, updater=None):
+    """Atomic checkpoint; pass the training `updater` (from
+    `optimizer.get_updater`) to persist optimizer state too."""
+    _atomic_write("%s-symbol.json" % prefix,
+                  lambda p: symbol.save(p))
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    _atomic_write("%s-%04d.params" % (prefix, epoch),
+                  lambda p: nd.save(p, save_dict))
+    if updater is not None:
+        states = getattr(updater, "states", updater)
+        blob = pickle.dumps(_states_to_host(states), protocol=4)
+        _atomic_write("%s-%04d.states" % (prefix, epoch),
+                      lambda p: open(p, "wb").write(blob))
+    # marker last: readers only trust epochs the marker names
+    _atomic_write("%s-latest" % prefix,
+                  lambda p: open(p, "w").write(str(epoch)))
+
+
+def latest_epoch(prefix):
+    """Last fully-written epoch, or None."""
+    path = "%s-latest" % prefix
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return int(f.read().strip())
+
+
+def load(prefix, epoch=None):
+    """(symbol, arg_params, aux_params, states_or_None, epoch).
+    epoch=None loads the latest durable checkpoint."""
+    from . import symbol as sym_mod
+
+    if epoch is None:
+        epoch = latest_epoch(prefix)
+        if epoch is None:
+            raise MXNetError("no checkpoint at prefix %r" % prefix)
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        (arg_params if tp == "arg" else aux_params)[name] = v
+    states = None
+    spath = "%s-%04d.states" % (prefix, epoch)
+    if os.path.exists(spath):
+        with open(spath, "rb") as f:
+            states = _states_from_host(pickle.loads(f.read()))
+    return symbol, arg_params, aux_params, states, epoch
+
+
+def restore_updater(updater, states):
+    """Install loaded optimizer state into a `get_updater` closure."""
+    updater.states.clear()
+    updater.states.update(states)

@@ -59,6 +59,15 @@ class ParameterServer:
         self.store = {}
         self.updater = None
         self.sync_mode = True
+        # Failure detection (absent in the reference, where a lost worker ==
+        # a silent hang at the next barrier, SURVEY §5.3): workers heartbeat;
+        # when one goes silent past the timeout, every blocked sync
+        # participant is released with an error so the job fails fast and
+        # can restart from the last checkpoint.
+        self.heartbeat_timeout = float(os.environ.get(
+            "MXNET_PS_HEARTBEAT_TIMEOUT", "60"))
+        self._last_seen = {}
+        self._dead = None  # rank that timed out, once detected
         self._accum = {}
         self._accum_count = {}
         self._waiting = {}
@@ -70,6 +79,8 @@ class ParameterServer:
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(num_workers * 2)
+        self._monitor = threading.Thread(target=self._watchdog, daemon=True)
+        self._monitor.start()
 
     def run(self):
         threads = []
@@ -83,6 +94,33 @@ class ParameterServer:
             threads.append(t)
         for t in threads:
             t.join(timeout=1)
+
+    def _watchdog(self):
+        while not self._stop:
+            time.sleep(min(self.heartbeat_timeout / 4, 2.0))
+            if self.heartbeat_timeout <= 0 or not self._last_seen:
+                continue
+            now = time.time()
+            with self._lock:
+                for rank, seen in self._last_seen.items():
+                    if now - seen > self.heartbeat_timeout:
+                        self._dead = rank
+                        # release everyone blocked on BSP accumulation or
+                        # barriers; they observe _dead and raise
+                        for evs in self._waiting.values():
+                            for ev in evs:
+                                ev.set()
+                        for ev in self._barrier_waiters:
+                            ev.set()
+                        self._barrier_waiters = []
+                        return
+
+    def _check_dead(self):
+        if self._dead is not None:
+            return {"error": "worker %d lost (no heartbeat for %.0fs); "
+                             "restart from the last checkpoint"
+                             % (self._dead, self.heartbeat_timeout)}
+        return None
 
     def _apply_update(self, key, merged):
         stored = self.store[key]
@@ -98,7 +136,13 @@ class ParameterServer:
                 conn.close()
                 return
             op = msg["op"]
-            if op == "init":
+            if "rank" in msg:
+                with self._lock:
+                    self._last_seen[msg["rank"]] = time.time()
+            if op == "heartbeat":
+                err = self._check_dead()
+                _send_msg(conn, err or {"ok": True})
+            elif op == "init":
                 with self._lock:
                     if msg["key"] not in self.store:
                         self.store[msg["key"]] = np.array(msg["value"])
@@ -122,12 +166,15 @@ class ParameterServer:
                             self._accum_count[key] = 0
                             self._waiting[key] = []
                 done.wait()
-                _send_msg(conn, {"ok": True})
+                _send_msg(conn, self._check_dead() or {"ok": True})
             elif op == "pull":
                 with self._lock:
                     val = np.array(self.store[msg["key"]])
                 _send_msg(conn, {"value": val})
             elif op == "barrier":
+                if self._check_dead():
+                    _send_msg(conn, self._check_dead())
+                    continue
                 ev = threading.Event()
                 with self._lock:
                     self._barrier_waiters.append(ev)
@@ -136,7 +183,7 @@ class ParameterServer:
                             w.set()
                         self._barrier_waiters = []
                 ev.wait()
-                _send_msg(conn, {"ok": True})
+                _send_msg(conn, self._check_dead() or {"ok": True})
             elif op == "set_optimizer":
                 from ..optimizer import get_updater
 
@@ -189,11 +236,35 @@ class DistKVStore(KVStore):
         self._sock_lock = threading.Lock()
         if "async" in kv_type:
             self._rpc({"op": "set_sync", "sync": False})
+        # heartbeat on its own connection so a long-blocked push/barrier on
+        # the main socket doesn't starve liveness reporting
+        interval = float(os.environ.get("MXNET_PS_HEARTBEAT_INTERVAL", "5"))
+        if interval > 0:
+            self._hb_stop = threading.Event()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(interval,), daemon=True)
+            self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval):
+        try:
+            sock = socket.create_connection(self._addr, timeout=30)
+        except OSError:
+            return
+        while not self._hb_stop.wait(interval):
+            try:
+                _send_msg(sock, {"op": "heartbeat", "rank": self.rank})
+                _recv_msg(sock)
+            except OSError:
+                return
 
     def _rpc(self, msg):
+        msg.setdefault("rank", self.rank)
         with self._sock_lock:
             _send_msg(self._sock, msg)
-            return _recv_msg(self._sock)
+            reply = _recv_msg(self._sock)
+        if isinstance(reply, dict) and "error" in reply:
+            raise MXNetError(reply["error"])
+        return reply
 
     def init(self, key, value):
         keys, _ = self._keylist(key)

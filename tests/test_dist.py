@@ -70,3 +70,41 @@ def test_dist_sync_closed_form_oracle_4_workers():
 def test_dist_async_smoke():
     out = run_launch(2, "dist_async")
     assert out.count("async done") == 2, out[-2000:]
+
+
+FAILING_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    kv.init(1, mx.nd.ones((2, 2)))
+    if rank == 1:
+        # die without pushing: the BSP accumulate can never complete
+        os._exit(42)
+    try:
+        kv.push(1, mx.nd.ones((2, 2)))
+        out = mx.nd.zeros((2, 2))
+        kv.pull(1, out=out)
+        print("rank %d UNEXPECTED completion" % rank)
+    except mx.base.MXNetError as e:
+        print("rank %d detected failure: %s" % (rank, e))
+    kv.stop_server()
+""")
+
+
+def test_worker_failure_detected_not_hang():
+    """A lost worker must surface as an error on the survivors (the
+    reference hangs forever at the barrier, SURVEY §5.3)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env["MXNET_PS_HEARTBEAT_TIMEOUT"] = "6"
+    env["MXNET_PS_HEARTBEAT_INTERVAL"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable, "-c", FAILING_WORKER],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT)
+    out = proc.stdout + proc.stderr
+    assert "detected failure" in out, out[-2000:]
+    assert "UNEXPECTED" not in out
