@@ -60,6 +60,8 @@ from . import predictor
 from .predictor import Predictor
 from . import storage
 from . import checkpoint
+from . import profiler
+from . import plugin
 from . import model
 from .model import FeedForward
 from . import module as mod

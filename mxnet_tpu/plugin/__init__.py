@@ -1,0 +1,4 @@
+"""Optional plugins (reference `plugin/`): torch interop lives in
+`mxnet_tpu.torch_bridge` (always registered since torch is a standard
+dependency here); sframe is gated on the sframe package."""
+from . import sframe  # noqa: F401

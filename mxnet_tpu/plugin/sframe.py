@@ -1,0 +1,74 @@
+"""SFrame data iterator (reference `plugin/sframe/iter_sframe.cc`).
+
+The reference wrapped GraphLab/Turi SFrame as a C++ data iter.  SFrame is
+effectively dead upstream; this port keeps the capability — iterate a
+columnar on-disk table as DataBatches — against anything exposing the
+minimal column protocol (`__len__`, column access returning array-likes),
+which covers turicreate.SFrame when installed, pandas DataFrames, and plain
+dict-of-arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataIter
+from ..ndarray import array
+
+
+class SFrameIter(DataIter):
+    """Batches from a columnar table.
+
+    Parameters
+    ----------
+    sframe : turicreate.SFrame | pandas.DataFrame | dict of name->array
+    data_field : column name (or list of names, concatenated as features)
+    label_field : optional column name
+    """
+
+    def __init__(self, sframe, data_field, label_field=None, batch_size=1):
+        super().__init__()
+        self.batch_size = batch_size
+        fields = [data_field] if isinstance(data_field, str) else list(data_field)
+        cols = []
+        for f in fields:
+            try:
+                col = np.asarray(sframe[f], dtype=np.float32)
+            except Exception as e:
+                raise MXNetError("SFrameIter: cannot read column %r: %s"
+                                 % (f, e))
+            cols.append(col.reshape(len(col), -1))
+        self._data = np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+        if label_field is not None:
+            self._label = np.asarray(sframe[label_field], dtype=np.float32)
+        else:
+            self._label = np.zeros((len(self._data),), np.float32)
+        if len(self._data) < batch_size:
+            raise MXNetError("SFrameIter: batch_size larger than table")
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self._data.shape[1:])]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._data):
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        pad = max(0, end - len(self._data))
+        idx = np.arange(self._cursor, end) % len(self._data)
+        self._cursor = end
+        return DataBatch(
+            data=[array(self._data[idx])],
+            label=[array(self._label[idx])],
+            pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label,
+        )

@@ -1,0 +1,99 @@
+"""Profiling / tracing hooks.
+
+The reference had no profiler — observability was `Monitor` tensor stats,
+`Speedometer` samples/sec and `GraphExecutor::Print` (SURVEY §5.1).  On TPU
+the runtime exposes real tracing: these helpers wrap `jax.profiler` so
+training loops get xprof traces (op timeline, HBM, MXU utilization —
+viewable in TensorBoard/xprof) and device memory profiles with the same
+one-liner ergonomics as the reference's Monitor.
+
+    with mx.profiler.trace("/tmp/xprof"):
+        trainer.step(batch)
+
+    with mx.profiler.annotate("data-augment"):
+        batch = augmenter(batch)
+
+    mx.profiler.save_device_memory_profile("mem.prof")
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+import jax
+
+from .base import MXNetError
+
+_active_logdir = None
+
+
+@contextlib.contextmanager
+def trace(logdir, create_perfetto_link=False):
+    """Trace everything in the block to an xprof logdir."""
+    global _active_logdir
+    if _active_logdir is not None:
+        raise MXNetError("profiler.trace already active (%s)" % _active_logdir)
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    _active_logdir = logdir
+    try:
+        yield logdir
+    finally:
+        _active_logdir = None
+        jax.profiler.stop_trace()
+
+
+def start(logdir):
+    """Imperative form of `trace` (reference `MXSetProfilerState(1)` shape)."""
+    global _active_logdir
+    if _active_logdir is not None:
+        raise MXNetError("profiler already active (%s)" % _active_logdir)
+    jax.profiler.start_trace(logdir)
+    _active_logdir = logdir
+
+
+def stop():
+    global _active_logdir
+    if _active_logdir is None:
+        raise MXNetError("profiler not active")
+    _active_logdir = None
+    jax.profiler.stop_trace()
+
+
+def annotate(name):
+    """Named span visible on the xprof timeline (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def save_device_memory_profile(path, backend=None):
+    """Snapshot of live device allocations (pprof format)."""
+    jax.profiler.save_device_memory_profile(path, backend=backend)
+
+
+class StepTimer:
+    """Host-side per-step wall-clock stats: the `Speedometer` companion for
+    loops that want numbers without a trace viewer.  `tic()` each step;
+    `summary()` -> dict with mean/p50/p99 step ms and steps/sec."""
+
+    def __init__(self, warmup=1):
+        self.warmup = warmup
+        self._times = []
+        self._last = None
+
+    def tic(self):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    def summary(self):
+        times = sorted(self._times[self.warmup:]) or [0.0]
+        n = len(times)
+        return {
+            "steps": n,
+            "mean_ms": 1e3 * sum(times) / n,
+            "p50_ms": 1e3 * times[n // 2],
+            "p99_ms": 1e3 * times[min(n - 1, int(n * 0.99))],
+            "steps_per_sec": (n / sum(times)) if sum(times) else 0.0,
+        }
