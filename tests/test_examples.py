@@ -70,3 +70,9 @@ def test_train_imagenet_spmd_tiny():
                       "--batch-size", "8", "--num-batches", "10",
                       "--dtype", "float32")
     assert "images/sec overall" in out
+
+
+def test_memcost():
+    out = run_example("memcost.py", "--depth", "6", "--width", "16",
+                      "--batch-size", "4", "--steps", "2")
+    assert "mirror" in out

@@ -71,3 +71,22 @@ def test_device_memory_stats_shape():
 def test_negative_size_rejected(storage):
     with pytest.raises(MXNetError):
         storage.alloc(-1, mx.cpu())
+
+
+def test_resource_manager_contract():
+    from mxnet_tpu.resource import ResourceManager, ResourceRequest
+
+    rm = ResourceManager.get()
+    rnd = rm.request(mx.cpu(), ResourceRequest.kRandom)
+    k1, k2 = rnd.get_key(), rnd.get_key()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+    tmp = rm.request(mx.cpu(), ResourceRequest.kTempSpace)
+    a = tmp.get_space((4, 4))
+    assert a.shape == (4, 4) and (a == 0).all()
+    b = tmp.get_space((2, 2))  # smaller: reuses grown buffer
+    assert b.shape == (2, 2)
+    tmp.release()
+
+    with pytest.raises(MXNetError):
+        rm.request(mx.cpu(), "bogus")
