@@ -96,12 +96,10 @@ class LayerNorm(OpDef):
         return [d, c, c], [d], []
 
     def apply(self, octx, params, inputs, aux):
+        from .pallas_kernels.layer_norm import layer_norm
+
         x, gamma, beta = inputs
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        xn = (x - mean) * jax.lax.rsqrt(var + params["eps"])
-        shape = (1,) * (x.ndim - 1) + (-1,)
-        return [xn * gamma.reshape(shape) + beta.reshape(shape)], []
+        return [layer_norm(x, gamma, beta, params["eps"])], []
 
 
 register(LayerNorm)
