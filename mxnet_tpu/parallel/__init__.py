@@ -9,3 +9,5 @@ lacked (sequence/context parallelism via ring attention).
 from .mesh import MeshContext, get_mesh, make_mesh, data_parallel_sharding
 from .trainer import SPMDTrainer
 from .sequence import ring_attention, ulysses_attention
+from .pipeline import PipelineParallel
+from .moe import MoEFFN

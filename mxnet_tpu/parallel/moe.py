@@ -1,0 +1,125 @@
+"""Expert parallelism: mixture-of-experts FFN over an "expert" mesh axis.
+
+No analogue exists in the reference (2016); this is part of the TPU-era
+parallelism mandate.  Design (the standard TPU MoE recipe):
+
+- Experts live one-per-slot on the `expert` mesh axis (E experts over
+  `mesh.shape[axis]` devices, E == axis size here).
+- Router: dense softmax over experts per token, top-1 dispatch with a
+  capacity factor; overflowing tokens are dropped (their combine weight is
+  zero) — keeps every shape static for XLA.
+- Dispatch/combine are einsums against a one-hot dispatch mask +
+  `all_to_all` over ICI inside `shard_map`: each device sends its tokens
+  bound for expert e to the device holding e, runs its expert on the
+  received capacity block, and the combine all_to_all routes results back.
+- Router auxiliary load-balance loss (mean_prob * mean_assignment per
+  expert, scaled by E) is returned for the trainer to add.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _sm
+    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..base import MXNetError
+
+
+def _router(x, wr, num_experts):
+    """(tokens, d) -> (gates, expert_index, probs): top-1 routing."""
+    logits = x @ wr  # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    return gate, idx, probs
+
+
+class MoEFFN:
+    """Expert-parallel feed-forward layer.
+
+    params pytree (leading axis = num_experts for expert weights):
+      wr: (d, E) router;  w1: (E, d, hidden);  w2: (E, hidden, d)
+
+    __call__(params, x) with x (batch, d) returns (y, aux_loss).
+    """
+
+    def __init__(self, mesh, axis="expert", capacity_factor=1.25):
+        if axis not in mesh.axis_names:
+            raise MXNetError("mesh has no %r axis" % axis)
+        self.mesh = mesh
+        self.axis = axis
+        self.num_experts = mesh.shape[axis]
+        self.capacity_factor = capacity_factor
+
+    def init_params(self, rng, d, hidden, dtype=jnp.float32):
+        E = self.num_experts
+        r = np.random.RandomState(rng) if isinstance(rng, int) else rng
+        s1 = 1.0 / np.sqrt(d)
+        return {
+            "wr": jnp.asarray(r.randn(d, E) * s1, dtype),
+            "w1": jnp.asarray(r.randn(E, d, hidden) * s1, dtype),
+            "w2": jnp.asarray(r.randn(E, hidden, d) / np.sqrt(hidden), dtype),
+        }
+
+    def _local(self, params, x):
+        """Inside shard_map: x is this device's token shard (t, d); expert
+        weights are this device's expert (1, d, hidden)."""
+        ax = self.axis
+        E = self.num_experts
+        w1 = params["w1"][0]
+        w2 = params["w2"][0]
+        wr = params["wr"]
+        t, d = x.shape
+        cap = int(np.ceil(t * self.capacity_factor / E))
+
+        gate, idx, probs = _router(x, wr, E)
+        # position of each token within its expert's capacity block
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # (t, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # (t, E), -1 elsewhere
+        pos_in_expert = pos.max(axis=1)  # (t,)
+        keep = pos_in_expert < cap
+        gate = jnp.where(keep, gate, 0.0)
+
+        # dispatch tensor: (t, E, cap) one-hot of (expert, slot)
+        disp = (jax.nn.one_hot(idx, E, dtype=x.dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.clip(pos_in_expert, 0, cap - 1), cap,
+                                 dtype=x.dtype)[:, None, :])
+        disp = disp * keep[:, None, None].astype(x.dtype)
+        # (E, cap, d): tokens this device wants each expert to process
+        send = jnp.einsum("tec,td->ecd", disp, x)
+        # all_to_all: axis-many groups of (cap, d) -> device e receives its
+        # block from every peer: (peers, cap, d)
+        recv = jax.lax.all_to_all(send, ax, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        # run the local expert on every received block
+        h = jax.nn.relu(recv @ w1)
+        out = h @ w2  # (peers*cap, d)
+        back = jax.lax.all_to_all(out, ax, split_axis=0, concat_axis=0,
+                                  tiled=True)  # (E*cap, d) back per sender
+        back = back.reshape(E, cap, d)
+        y = jnp.einsum("tec,ecd->td", disp, back) * gate[:, None].astype(x.dtype)
+
+        # load-balance aux loss (Switch-style): E * sum_e f_e * P_e
+        f = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        p = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(f * p)
+        aux = jax.lax.pmean(aux, ax)
+        return y, aux
+
+    def __call__(self, params, x):
+        fn = shard_map(
+            self._local, mesh=self.mesh,
+            in_specs=({"wr": P(), "w1": P(self.axis), "w2": P(self.axis)},
+                      P(self.axis)),
+            out_specs=(P(self.axis), P()),
+        )
+        return fn(params, x)
