@@ -8,6 +8,7 @@ from .vgg import get_vgg
 from .inception_bn import get_inception_bn
 from .resnet import get_resnet
 from .lstm import lstm_unroll, lstm_cell
+from .rnn import rnn_unroll, rnn_cell
 from .transformer import get_transformer_lm, transformer_block
 from .googlenet import get_googlenet
 from .inception_v3 import get_inception_v3

@@ -129,3 +129,23 @@ def test_fcn8s_train_step():
     exe.backward()
     g = exe.grad_dict["score_weight"].asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_rnn_unroll_shapes():
+    net = models.rnn_unroll(num_rnn_layer=1, seq_len=3, input_size=50,
+                            num_hidden=16, num_embed=8, num_label=50)
+    shapes = {"t%d_data" % t: (4,) for t in range(3)}
+    shapes["l0_init_h"] = (4, 16)
+    arg_shapes, out_shapes, _ = net.infer_shape(**shapes)
+    assert len(out_shapes) == 3
+    assert all(s == (4, 50) for s in out_shapes)
+
+
+def test_rnn_unroll_shapes():
+    net = models.rnn_unroll(num_rnn_layer=1, seq_len=3, input_size=50,
+                            num_hidden=16, num_embed=8, num_label=50)
+    shapes = {"t%d_data" % t: (4,) for t in range(3)}
+    shapes["l0_init_h"] = (4, 16)
+    arg_shapes, out_shapes, _ = net.infer_shape(**shapes)
+    assert len(out_shapes) == 3
+    assert all(s == (4, 50) for s in out_shapes)
