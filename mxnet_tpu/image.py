@@ -45,12 +45,15 @@ class ImageAugmenter:
         self.max_illum = float(max_random_illumination)
         self.scale = float(scale)
         self._mean = None
+        self._mean_path = None
         if mean_img is not None:
             if isinstance(mean_img, str):
                 if os.path.exists(mean_img):
                     self._mean = np.load(mean_img)
                 else:
-                    self._mean_path = mean_img  # computed lazily by the iter
+                    # the owning iterator computes it on first use
+                    # (ImageRecordIter._ensure_mean) and calls set_mean
+                    self._mean_path = mean_img
             else:
                 self._mean = np.asarray(mean_img, np.float32)
         self._mean_rgb = (np.asarray(mean_rgb, np.float32).reshape(1, -1, 1, 1)
@@ -58,10 +61,20 @@ class ImageAugmenter:
         self._key = jax.random.PRNGKey(seed)
         self._step = 0
         self._jitted = {}
+        self._mean_version = 0  # part of the jit cache key: _augment bakes
+        # self._mean in at trace time, so changing it must retrace
+
+    @property
+    def needs_mean(self):
+        """True when a mean_img path was given but not computed yet."""
+        return self._mean_path is not None and self._mean is None
 
     # -- mean image (iter_normalize.h: computed once, cached) -------------
     def set_mean(self, mean, path=None):
         self._mean = np.asarray(mean, np.float32)
+        self._mean_version += 1
+        if path is None:
+            path = self._mean_path
         if path:
             np.save(path, self._mean)
 
@@ -117,7 +130,7 @@ class ImageAugmenter:
                 % (batch.shape[2:], out_hw))
         self._step += 1
         key = jax.random.fold_in(self._key, self._step)
-        sig = (batch.shape, batch.dtype, out_hw)
+        sig = (batch.shape, batch.dtype, out_hw, self._mean_version)
         fn = self._jitted.get(sig)
         if fn is None:
             fn = jax.jit(partial(self._augment, out_hw=out_hw))

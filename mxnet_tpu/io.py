@@ -513,6 +513,7 @@ class ImageRecordIter(DataIter):
             self._f.seek(self._begin)
 
     def next(self):
+        self._ensure_mean()  # before any record is consumed for this batch
         if self._native:
             n = self._lib.mxtpu_loader_next(self._handle, self._data_ptr,
                                             self._label_ptr)
@@ -546,11 +547,28 @@ class ImageRecordIter(DataIter):
             provide_label=self.provide_label,
         )
 
+    def _ensure_mean(self):
+        """`iter_normalize.h` flow: mean_img named a file that doesn't
+        exist — compute it with one raw pass over this iterator (augmenter
+        suspended), cache to the file, then normalize with it."""
+        if self._augmenter is None or not self._augmenter.needs_mean:
+            return
+        from .image import compute_mean_image
+
+        aug, self._augmenter = self._augmenter, None
+        try:
+            mean = compute_mean_image(self)
+        finally:
+            self._augmenter = aug
+        aug.set_mean(mean)
+
     def _finish(self, data):
-        """Apply the on-device augmentation pipeline (or plain wrap)."""
+        """Apply the on-device augmentation pipeline (or plain wrap).
+        The augmented batch stays a device array inside the NDArray — no
+        host round-trip; it overlaps the train step under async dispatch."""
         if self._augmenter is None:
             return array(data.copy() if data is not None else data)
-        return array(np.asarray(self._augmenter(data)))
+        return NDArray(self._augmenter(data))
 
     def close(self):
         if self._native and self._handle:

@@ -102,18 +102,23 @@ class ParameterServer:
                 continue
             now = time.time()
             with self._lock:
-                for rank, seen in self._last_seen.items():
-                    if now - seen > self.heartbeat_timeout:
-                        self._dead = rank
-                        # release everyone blocked on BSP accumulation or
-                        # barriers; they observe _dead and raise
-                        for evs in self._waiting.values():
-                            for ev in evs:
-                                ev.set()
-                        for ev in self._barrier_waiters:
+                if self._dead is None:
+                    for rank, seen in self._last_seen.items():
+                        if now - seen > self.heartbeat_timeout:
+                            self._dead = rank
+                            break
+                if self._dead is not None:
+                    # release everyone blocked on BSP accumulation or
+                    # barriers — including waiters that arrived after the
+                    # detection (the thread keeps running for them); they
+                    # observe _dead and raise
+                    for evs in self._waiting.values():
+                        for ev in evs:
                             ev.set()
-                        self._barrier_waiters = []
-                        return
+                    self._waiting = {}
+                    for ev in self._barrier_waiters:
+                        ev.set()
+                    self._barrier_waiters = []
 
     def _check_dead(self):
         if self._dead is not None:
@@ -148,6 +153,9 @@ class ParameterServer:
                         self.store[msg["key"]] = np.array(msg["value"])
                 _send_msg(conn, {"ok": True})
             elif op == "push":
+                if self._check_dead():
+                    _send_msg(conn, self._check_dead())
+                    continue
                 key, val = msg["key"], np.asarray(msg["value"])
                 done = threading.Event()
                 with self._lock:

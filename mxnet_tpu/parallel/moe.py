@@ -25,13 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _sm
-    shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from ..base import MXNetError
+from .mesh import shard_map
 
 
 def _router(x, wr, num_experts):

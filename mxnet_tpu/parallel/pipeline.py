@@ -28,14 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.8 moved shard_map to jax.shard_map
-    from jax import shard_map as _shard_map_mod
-    shard_map = _shard_map_mod.shard_map if hasattr(_shard_map_mod, "shard_map") \
-        else _shard_map_mod
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from ..base import MXNetError
+from .mesh import shard_map
 
 
 class PipelineParallel:

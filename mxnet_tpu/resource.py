@@ -9,20 +9,16 @@ The reference's ResourceManager handed operators two things:
   without any per-device mutable generator.
 - `kTempSpace`: round-robin grow-only scratch buffers (`resource.cc:121-224`).
   XLA allocates operator workspace itself, so inside compiled programs this
-  is vestigial; for *host-side* scratch (custom ops staging data, IO) the
-  request is served from the pooled `storage.Storage` allocator, preserving
-  the get_space contract.
+  is vestigial; for *host-side* scratch (custom ops staging data, IO) a
+  grow-only host buffer preserves the get_space reuse contract.
 """
 from __future__ import annotations
 
 import numpy as np
 
-import jax
-
 from . import random as _random
 from .base import MXNetError
 from .context import Context
-from .storage import Storage
 
 
 class ResourceRequest:
@@ -50,25 +46,26 @@ class RandomResource:
 
 class TempSpaceResource:
     """`Resource` with req.type == kTempSpace: `get_space(shape, dtype)`
-    returns a scratch numpy view backed by the pooled allocator; grow-only
-    per resource like the reference (`resource.cc:204-224`)."""
+    returns a zeroed scratch view of a grow-only host buffer — the same
+    reuse contract as the reference (`resource.cc:204-224`): requesting a
+    smaller space reuses the grown allocation, a larger one reallocates."""
 
     def __init__(self, ctx):
         self.ctx = ctx
-        self._handle = None
+        self._buf = None  # grow-only byte buffer
 
     def get_space(self, shape, dtype=np.float32):
-        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        if self._handle is None or self._handle.size < nbytes:
-            if self._handle is not None:
-                Storage.get().free(self._handle)
-            self._handle = Storage.get().alloc(nbytes, self.ctx)
-        return np.zeros(shape, dtype)  # scratch semantics: zeroed view
+        dtype = np.dtype(dtype)
+        n = int(np.prod(shape))
+        nbytes = n * dtype.itemsize
+        if self._buf is None or self._buf.nbytes < nbytes:
+            self._buf = np.empty(nbytes, np.uint8)
+        view = self._buf[:nbytes].view(dtype)[:n].reshape(shape)
+        view[...] = 0  # scratch semantics: zeroed
+        return view
 
     def release(self):
-        if self._handle is not None:
-            Storage.get().free(self._handle)
-            self._handle = None
+        self._buf = None
 
 
 class ResourceManager:

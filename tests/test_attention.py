@@ -13,8 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map as _sm
-shard_map = _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+from mxnet_tpu.parallel.mesh import shard_map
 
 import mxnet_tpu as mx
 from mxnet_tpu.ops.pallas_kernels import flash_attention

@@ -1,5 +1,6 @@
 """On-device image augmentation tests (reference `src/io/image_augmenter.h`
 crop/mirror/jitter + `src/io/iter_normalize.h` mean-subtract semantics)."""
+import os
 import numpy as np
 import pytest
 
@@ -96,3 +97,28 @@ def test_image_record_iter_augmented(tmp_path):
         assert b.data[0].shape == (4, 3, 8, 8)
         arr = b.data[0].asnumpy()
         assert arr.max() <= 1.0 + 1e-6
+
+
+def test_image_record_iter_lazy_mean(tmp_path):
+    """mean_img naming a missing file: computed on first use with one raw
+    pass, cached, then applied (iter_normalize.h flow)."""
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "pack.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    imgs = [(rng.rand(3, 6, 6)).astype(np.float32) for _ in range(8)]
+    for i, img in enumerate(imgs):
+        rec.write(recordio.pack_img(recordio.IRHeader(0, 0.0, i, 0), img))
+    rec.close()
+    mean_path = str(tmp_path / "mean.npy")
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 6, 6), batch_size=4,
+        mean_img=mean_path, use_native=False)
+    b0 = next(it)
+    assert os.path.exists(mean_path)
+    mean = np.load(mean_path)
+    np.testing.assert_allclose(mean, np.stack(imgs).mean(0), rtol=1e-5)
+    np.testing.assert_allclose(b0.data[0].asnumpy(),
+                               np.stack(imgs[:4]) - mean, atol=1e-5)
