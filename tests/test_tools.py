@@ -91,3 +91,18 @@ def test_rtc_kernel():
         kern.push([a, b], [mx.nd.zeros((3, 3))])
     with pytest.raises(MXNetError, match="callable"):
         mx.rtc.Rtc("cuda", "__global__ void k() {}")
+
+
+def test_gen_op_docs(tmp_path):
+    import subprocess, sys, os
+    out = str(tmp_path / "ops.md")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "gen_op_docs.py"), out],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    text = open(out).read()
+    assert "## Convolution" in text and "num_filter" in text

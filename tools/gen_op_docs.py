@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Generate docs/operators.md from the operator registry.
+
+The reference auto-generated its Python API docs from the dmlc::Parameter
+declarations (`fully_connected-inl.h:29-40` docs flow into `mx.sym.*`
+signatures); this does the same from `ops.registry`.
+
+    python tools/gen_op_docs.py [output.md]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(out_path=None):
+    from mxnet_tpu.ops import registry
+
+    out_path = out_path or os.path.join(
+        os.path.dirname(__file__), "..", "docs", "operators.md")
+    names = sorted(registry.list_ops())
+    seen = {}
+    for n in names:
+        op = registry.get(n)
+        seen.setdefault(id(op), (op, []))[1].append(n)
+
+    lines = [
+        "# Operator reference",
+        "",
+        "Auto-generated from `mxnet_tpu.ops.registry` by "
+        "`tools/gen_op_docs.py` — do not edit.  Every operator is exposed "
+        "both as `mx.sym.<Name>` (symbol) and, for simple ops, as the "
+        "matching `mx.nd` function (the reference's dual registration).",
+        "",
+        "%d registered names, %d distinct operators." % (
+            len(names), len(seen)),
+        "",
+    ]
+    for _, (op, opnames) in sorted(seen.items(),
+                                   key=lambda kv: kv[1][1][0].lower()):
+        primary = op.name
+        aliases = [n for n in opnames if n != primary]
+        lines.append("## %s" % primary)
+        if aliases:
+            lines.append("*Aliases: %s*" % ", ".join("`%s`" % a
+                                                     for a in aliases))
+        doc = (op.__doc__ or type(op).__doc__ or "").strip().splitlines()
+        if doc:
+            lines.append("")
+            lines.append(doc[0].strip())
+        try:
+            args = op.list_arguments(
+                {k: p.default for k, p in op.params.items()})
+        except Exception:
+            args = ["data"]
+        lines.append("")
+        lines.append("**Inputs**: %s" % ", ".join("`%s`" % a for a in args))
+        if op.params:
+            lines.append("")
+            lines.append("| param | type | default | required |")
+            lines.append("|---|---|---|---|")
+            for pname, p in op.params.items():
+                t = p.type if isinstance(p.type, str) \
+                    else getattr(p.type, "__name__", str(p.type))
+                lines.append("| `%s` | %s | `%r` | %s |" % (
+                    pname, t, p.default, "yes" if p.required else ""))
+        lines.append("")
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    print("wrote %s (%d ops)" % (out_path, len(seen)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)
