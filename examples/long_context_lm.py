@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Long-context LM training with sequence parallelism (the TPU-era upgrade
+of the reference's long-sequence story, SURVEY §5.7; no reference analogue
+— the reference scaled sequence length with bucketing + model-parallel
+LSTM, `example/model-parallel-lstm/`).
+
+A small causal transformer is trained with the sequence axis SHARDED over
+the device mesh: activations live as (batch, heads, S/n_dev, dim) shards
+and attention runs as ring attention (`parallel.ring_attention`, K/V shards
+rotating over ICI) — the context length scales with the number of devices
+while per-device memory stays flat.  The whole train step (fwd + bwd +
+adam-ish update) is one jitted SPMD program.
+
+Run on the 8-device CPU mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/long_context_lm.py
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from mxnet_tpu.parallel import make_mesh, ring_attention  # noqa: E402
+from mxnet_tpu.parallel.mesh import shard_map  # noqa: E402
+from mxnet_tpu.ops.pallas_kernels.layer_norm import layer_norm  # noqa: E402
+
+
+def init_params(rng, vocab, embed, heads, layers):
+    def W(*s, scale=None):
+        scale = scale or 1.0 / np.sqrt(s[0])
+        return jnp.asarray(rng.randn(*s) * scale, jnp.float32)
+
+    params = {"embed": W(vocab, embed, scale=0.02), "layers": []}
+    for _ in range(layers):
+        params["layers"].append({
+            "qkv": W(embed, 3 * embed),
+            "proj": W(embed, embed),
+            "ln1_g": jnp.ones(embed), "ln1_b": jnp.zeros(embed),
+            "w1": W(embed, 4 * embed), "w2": W(4 * embed, embed),
+            "ln2_g": jnp.ones(embed), "ln2_b": jnp.zeros(embed),
+        })
+    params["out"] = W(embed, vocab, scale=0.02)
+    return params
+
+
+def model_local(params, tokens, heads, axis):
+    """Inside shard_map: tokens is the local (batch, S_local) shard."""
+    if hasattr(jax.lax, "pvary"):
+        # params arrive replicated; ops with custom VJPs (layer_norm) need
+        # them device-varying so their cotangents type-check — shard_map's
+        # transpose then psums the param grads back to replicated
+        params = jax.tree.map(lambda a: jax.lax.pvary(a, (axis,)), params)
+    b, s_loc = tokens.shape
+    x = params["embed"][tokens]  # (b, s_loc, e)
+    e = x.shape[-1]
+    # positions are global: offset by this shard's start
+    start = jax.lax.axis_index(axis) * s_loc
+    pos = start + jnp.arange(s_loc)
+    angles = pos[:, None] / (10000 ** (jnp.arange(e // 2) / (e // 2)))
+    x = x + jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], -1)[None]
+    for lp in params["layers"]:
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, s_loc, heads, e // heads)
+        q, k, v = (t.reshape(shp).transpose(0, 2, 1, 3) for t in (q, k, v))
+        att = ring_attention(q, k, v, axis, causal=True)
+        att = att.transpose(0, 2, 1, 3).reshape(b, s_loc, e)
+        x = x + att @ lp["proj"]
+        h = layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+        x = x + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
+    return x @ params["out"]  # (b, s_loc, vocab)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=512,
+                    help="GLOBAL context length (sharded over devices)")
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    n_dev = len(jax.devices())
+    if args.seq_len % n_dev:
+        raise SystemExit("--seq-len must divide the %d devices" % n_dev)
+    mesh = make_mesh(shape=(n_dev,), axis_names=("seq",))
+    logging.info("global context %d over %d devices (%d tokens/device)",
+                 args.seq_len, n_dev, args.seq_len // n_dev)
+
+    rng = np.random.RandomState(0)
+    params = init_params(rng, args.vocab, args.embed, args.heads,
+                         args.layers)
+    # learnable task: next token = (token + 1) % vocab on random sequences
+    tokens = jnp.asarray(
+        rng.randint(0, args.vocab, (args.batch_size, args.seq_len)))
+    targets = (tokens + 1) % args.vocab
+
+    def loss_fn(params, tokens, targets):
+        fn = shard_map(
+            lambda p, t: model_local(p, t, args.heads, "seq"),
+            mesh=mesh, in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"))
+        logits = fn(params, tokens)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(logp, targets[..., None], -1).mean()
+
+    @jax.jit
+    def step(params, m, v, t, tokens, targets):
+        loss, g = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        m = jax.tree.map(lambda m, g: 0.9 * m + 0.1 * g, m, g)
+        v = jax.tree.map(lambda v, g: 0.999 * v + 0.001 * g * g, v, g)
+        mh = jax.tree.map(lambda m: m / (1 - 0.9 ** t), m)
+        vh = jax.tree.map(lambda v: v / (1 - 0.999 ** t), v)
+        params = jax.tree.map(
+            lambda p, m, v: p - args.lr * m / (jnp.sqrt(v) + 1e-8),
+            params, mh, vh)
+        return params, m, v, loss
+
+    m = jax.tree.map(jnp.zeros_like, params)
+    v = jax.tree.map(jnp.zeros_like, params)
+    for i in range(args.steps):
+        params, m, v, loss = step(params, m, v, float(i + 1), tokens,
+                                  targets)
+        if i % 10 == 0 or i == args.steps - 1:
+            logging.info("step %d loss %.4f", i, float(loss))
+    final = float(loss)
+    logging.info("done: final loss %.4f (start ~%.2f)", final,
+                 np.log(args.vocab))
+
+
+if __name__ == "__main__":
+    main()

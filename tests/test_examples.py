@@ -76,3 +76,12 @@ def test_memcost():
     out = run_example("memcost.py", "--depth", "6", "--width", "16",
                       "--batch-size", "4", "--steps", "2")
     assert "mirror" in out
+
+
+def test_long_context_lm():
+    out = run_example("long_context_lm.py", "--seq-len", "64",
+                      "--steps", "25", "--embed", "32", "--vocab", "16")
+    assert "final loss" in out
+    import re
+    m = re.search(r"final loss ([\d.]+)", out)
+    assert m and float(m.group(1)) < 2.0, out[-800:]
