@@ -108,3 +108,25 @@ def test_worker_failure_detected_not_hang():
     out = proc.stdout + proc.stderr
     assert "detected failure" in out, out[-2000:]
     assert "UNEXPECTED" not in out
+
+
+def test_dist_training_converges():
+    """`tests/nightly/dist_lenet.py` analogue: 2 workers train MNIST-like
+    synthetic data with kvstore=dist_sync through the launcher and must
+    reach the accuracy gate (`test_all.sh` check_val pattern)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "examples", "train_mnist.py"),
+         "--network", "mlp", "--data-dir", "/nonexistent",
+         "--num-epochs", "4", "--kv-store", "dist_sync"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    import re
+    accs = [float(m) for m in
+            re.findall(r"final validation accuracy: ([\d.]+)", out)]
+    assert len(accs) == 2, out[-2000:]
+    assert all(a > 0.9 for a in accs), accs
