@@ -81,7 +81,24 @@ def _load():
                                       ctypes.POINTER(ctypes.c_float)]
     lib.mxtpu_loader_reset.argtypes = [H]
     lib.mxtpu_loader_close.argtypes = [H]
+
+    try:  # sgd entry points (absent in older builds of the .so)
+        lib.mxtpu_sgd_create.restype = H
+        lib.mxtpu_sgd_create.argtypes = [ctypes.c_float] * 5 + [ctypes.c_int]
+        lib.mxtpu_sgd_set_lr.argtypes = [H, ctypes.c_float]
+        lib.mxtpu_sgd_update.restype = ctypes.c_int
+        lib.mxtpu_sgd_update.argtypes = [H, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.POINTER(ctypes.c_float),
+                                         ctypes.c_int64]
+        lib.mxtpu_sgd_destroy.argtypes = [H]
+    except AttributeError:
+        pass
     return lib
+
+
+def has_sgd() -> bool:
+    return LIB is not None and hasattr(LIB, "mxtpu_sgd_create")
 
 
 LIB = _load()

@@ -127,6 +127,43 @@ class ParameterServer:
                              % (self._dead, self.heartbeat_timeout)}
         return None
 
+    def _native_sgd_updater(self, opt):
+        """C++ SGD fast path (`native/optimizer.cc`, the reference's
+        server-side `src/optimizer/sgd-inl.h` role): engaged when the
+        installed optimizer is plain SGD on f32 and the native lib is
+        built; returns None to use the Python updater otherwise."""
+        import ctypes
+
+        from .. import _native
+        from ..optimizer import SGD, ccSGD
+
+        if type(opt) not in (SGD, ccSGD) or not _native.has_sgd():
+            return None
+        if (getattr(opt, "lr_scheduler", None) is not None
+                or opt.lr_mult or opt.wd_mult or opt.idx2name):
+            return None  # scheduled lr / per-param multipliers: Python path
+        h = _native.LIB.mxtpu_sgd_create(
+            float(opt.lr), float(opt.momentum), float(opt.wd),
+            float(opt.rescale_grad), float(opt.clip_gradient or 0.0),
+            int(os.environ.get("MXNET_KVSTORE_REDUCTION_NTHREADS", "4")))
+        fp = ctypes.POINTER(ctypes.c_float)
+
+        def native_updater(key, grad, weight, _h=h):
+            g = np.ascontiguousarray(grad, np.float32)
+            if weight.dtype != np.float32 or not weight.flags["C_CONTIGUOUS"]:
+                w = np.ascontiguousarray(weight, np.float32)
+                _native.LIB.mxtpu_sgd_update(
+                    _h, int(key), w.ctypes.data_as(fp),
+                    g.ctypes.data_as(fp), w.size)
+                weight[...] = w
+            else:
+                _native.LIB.mxtpu_sgd_update(
+                    _h, int(key), weight.ctypes.data_as(fp),
+                    g.ctypes.data_as(fp), weight.size)
+            return None
+
+        return native_updater
+
     def _apply_update(self, key, merged):
         stored = self.store[key]
         if self.updater is not None:
@@ -196,15 +233,15 @@ class ParameterServer:
                 from ..optimizer import get_updater
 
                 opt = pickle.loads(msg["optimizer"])
-
-                def np_updater(key, grad, weight,
-                               _u=get_updater(opt)):
-                    g, w = array(grad), array(weight)
-                    _u(key, g, w)
-                    weight[...] = w.asnumpy()
+                updater = self._native_sgd_updater(opt)
+                if updater is None:
+                    def updater(key, grad, weight, _u=get_updater(opt)):
+                        g, w = array(grad), array(weight)
+                        _u(key, g, w)
+                        weight[...] = w.asnumpy()
 
                 with self._lock:
-                    self.updater = np_updater
+                    self.updater = updater
                 _send_msg(conn, {"ok": True})
             elif op == "set_sync":
                 with self._lock:

@@ -76,4 +76,14 @@ MXTPU_API int mxtpu_loader_next(mxtpu_handle l, float* data, float* label);
 MXTPU_API void mxtpu_loader_reset(mxtpu_handle l);
 MXTPU_API void mxtpu_loader_close(mxtpu_handle l);
 
+/* -- native SGD (server-side updates, `src/optimizer/sgd-inl.h`) -------- */
+MXTPU_API mxtpu_handle mxtpu_sgd_create(float lr, float momentum, float wd,
+                                        float rescale, float clip_gradient,
+                                        int nthreads);
+MXTPU_API void mxtpu_sgd_set_lr(mxtpu_handle opt, float lr);
+/* In-place: weight += update(grad); momentum state kept per (opt, key). */
+MXTPU_API int mxtpu_sgd_update(mxtpu_handle opt, int key, float* weight,
+                               const float* grad, int64_t n);
+MXTPU_API void mxtpu_sgd_destroy(mxtpu_handle opt);
+
 #endif  /* MXTPU_H_ */

@@ -229,3 +229,53 @@ def test_image_record_iter_sharded(tmp_path):
             all_labels.extend(batch.label[0].asnumpy()[:n].tolist())
         it.close()
     assert sorted(all_labels) == sorted(labels.astype(np.float32).tolist())
+
+
+@pytest.mark.skipif(not _native.has_sgd(), reason="native lib lacks sgd")
+def test_native_sgd_matches_python():
+    """native/optimizer.cc must reproduce the Python SGD rule exactly."""
+    import ctypes
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(1000).astype(np.float32)
+    grads = [rng.randn(1000).astype(np.float32) for _ in range(5)]
+
+    # python reference
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-3,
+                           rescale_grad=0.5, clip_gradient=1.0)
+    upd = mx.optimizer.get_updater(opt)
+    w_py = mx.nd.array(w0.copy())
+    for g in grads:
+        upd(7, mx.nd.array(g), w_py)
+
+    # native
+    h = _native.LIB.mxtpu_sgd_create(0.1, 0.9, 1e-3, 0.5, 1.0, 2)
+    fp = ctypes.POINTER(ctypes.c_float)
+    w_nat = w0.copy()
+    for g in grads:
+        gc = np.ascontiguousarray(g)
+        assert _native.LIB.mxtpu_sgd_update(
+            h, 7, w_nat.ctypes.data_as(fp), gc.ctypes.data_as(fp),
+            w_nat.size) == 0
+    _native.LIB.mxtpu_sgd_destroy(h)
+    np.testing.assert_allclose(w_nat, w_py.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.skipif(not _native.has_sgd(), reason="native lib lacks sgd")
+def test_dist_server_uses_native_sgd():
+    """ParameterServer installs the C++ updater for plain SGD."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.dist import ParameterServer
+
+    srv = ParameterServer.__new__(ParameterServer)
+    upd = ParameterServer._native_sgd_updater(
+        srv, mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    assert upd is not None
+    w = np.ones(64, np.float32)
+    g = np.full(64, 2.0, np.float32)
+    upd(1, g, w)
+    np.testing.assert_allclose(w, 1.0 - 0.1 * 2.0, rtol=1e-6)
+    # Adam has no native path
+    assert ParameterServer._native_sgd_updater(
+        srv, mx.optimizer.Adam()) is None
