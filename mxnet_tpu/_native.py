@@ -29,6 +29,19 @@ def _find_lib():
     for c in cands:
         if c and os.path.exists(c):
             return c
+    # build on first use when the sources ship without a binary
+    native_dir = os.path.join(here, "..", "native")
+    if os.path.exists(os.path.join(native_dir, "Makefile")):
+        import subprocess
+
+        try:
+            subprocess.run(["make", "-C", native_dir], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+        built = os.path.join(native_dir, "libmxtpu.so")
+        if os.path.exists(built):
+            return built
     return None
 
 
