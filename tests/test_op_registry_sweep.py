@@ -1,0 +1,106 @@
+"""Registry-wide invariant sweep: for EVERY registered operator that can be
+instantiated with defaults, the shapes promised by `infer_shape` must match
+what `apply` actually produces, and outputs must be finite for benign
+inputs.  (The reference relied on per-op tests; this catches any op whose
+metadata and kernel drift apart.)"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+from mxnet_tpu.ops.registry import OpCtx
+
+# ops needing bespoke inputs or params; covered by dedicated tests elsewhere
+SKIP = {
+    "TorchModule", "TorchCriterion",  # host torch bridge
+    "_CrossDeviceCopy",               # executor-internal marker
+    "Crop",                           # needs h_w/crop_like (test_operator)
+    "Attention", "DotProductAttention",  # 4-D qkv (test_attention)
+    "batch_dot", "dot",               # lhs/rhs rank rules (test_operator)
+    "Unpooling",                      # paired with Pooling (test_operator)
+    "softmax_cross_entropy",          # (data, label) ranks (test_operator)
+}
+
+# per-op input overrides: name -> dict(param overrides)
+PARAMS = {
+    "Convolution": {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1)},
+    "Deconvolution": {"kernel": (2, 2), "num_filter": 4, "stride": (2, 2)},
+    "Pooling": {"kernel": (2, 2), "stride": (2, 2)},
+    "Activation": {"act_type": "relu"},
+    "FullyConnected": {"num_hidden": 6},
+    "Embedding": {"input_dim": 11, "output_dim": 5},
+    "Reshape": {"target_shape": (0, 192)},
+    "SliceChannel": {"num_outputs": 2},
+    "Concat": {"num_args": 1},
+    "ElementWiseSum": {"num_args": 1},
+    "UpSampling": {"scale": 2, "sample_type": "nearest", "num_args": 1},
+    "Cast": {"dtype": "float32"},
+    "LRN": {"nsize": 3},
+    "_MinusScalar": {"scalar": 1.5},
+    "_PlusScalar": {"scalar": 1.5},
+    "_RMinusScalar": {"scalar": 1.5},
+    "_MulScalar": {"scalar": 1.5},
+    "_DivScalar": {"scalar": 1.5},
+    "_RDivScalar": {"scalar": 1.5},
+    "_PowerScalar": {"scalar": 2.0},
+    "_RPowerScalar": {"scalar": 2.0},
+    "_MaximumScalar": {"scalar": 0.5},
+    "_MinimumScalar": {"scalar": 0.5},
+    "clip": {"a_min": -1.0, "a_max": 1.0},
+    "smooth_l1": {"scalar": 1.0},
+}
+
+
+def _make_input(name, shape):
+    rng = np.random.RandomState(hash(name) % (2 ** 31))
+    x = rng.rand(*shape).astype(np.float32) + 0.1  # positive: log/sqrt safe
+    return x
+
+
+def _input_shape(op, argname):
+    # label-ish args get filled from infer_shape; data default NCHW-ish
+    return (2, 3, 8, 8)
+
+
+@pytest.mark.parametrize("name", sorted(
+    n for n in registry.list_ops()
+    if n == registry.get(n).name and n not in SKIP))
+def test_op_shape_contract(name):
+    op = registry.get(name)
+    params = op.parse_params(PARAMS.get(name, {}))
+    args = op.list_arguments(params)
+    # seed shapes: first input 4-D data; infer the rest
+    in_shapes = [None] * len(args)
+    in_shapes[0] = _input_shape(op, args[0])
+    try:
+        full_in, out_shapes, aux_shapes = op.infer_shape(params, in_shapes)
+    except mx.base.MXNetError:
+        # op wants a different rank; retry 2-D
+        in_shapes[0] = (4, 12)
+        full_in, out_shapes, aux_shapes = op.infer_shape(params, in_shapes)
+    if any(s is None for s in full_in) or any(s is None for s in out_shapes):
+        pytest.skip("%s cannot complete inference from data alone" % name)
+
+    inputs = [jax.numpy.asarray(_make_input(a, s))
+              for a, s in zip(args, full_in)]
+    if name == "Embedding":  # ids must be < input_dim
+        inputs[0] = jax.numpy.asarray(
+            np.random.RandomState(0).randint(0, 11, full_in[0])
+            .astype(np.float32))
+    aux = [jax.numpy.asarray(np.zeros(s, np.float32)) for s in aux_shapes]
+    if op.list_aux(params) and op.list_aux(params)[-1].endswith("var"):
+        aux[-1] = jax.numpy.ones(aux_shapes[-1])
+    octx = OpCtx(is_train=True, rng=jax.random.PRNGKey(0))
+    outs, _ = op.apply(octx, params, inputs, aux)
+
+    assert len(outs) == len(out_shapes), \
+        "%s: apply produced %d outputs, infer_shape promised %d" % (
+            name, len(outs), len(out_shapes))
+    for i, (o, s) in enumerate(zip(outs, out_shapes)):
+        assert tuple(o.shape) == tuple(s), \
+            "%s output %d: apply %s vs infer_shape %s" % (
+                name, i, o.shape, s)
+        assert np.isfinite(np.asarray(o, dtype=np.float32)).all(), \
+            "%s output %d not finite" % (name, i)

@@ -105,3 +105,30 @@ def test_export_single_artifact_roundtrip(tmp_path):
     loaded = load_exported(path)
     got = loaded.predict(data=x)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_export_with_aux_states(tmp_path):
+    """Export a BN model: aux (moving stats) must bake into the artifact."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.predictor import load_exported
+
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn", fix_gamma=False)
+    fc = sym.FullyConnected(data=bn, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(data=fc, name="softmax")
+    rng = np.random.RandomState(5)
+    params = {
+        "bn_gamma": np.ones(4, np.float32) * 2.0,
+        "bn_beta": np.zeros(4, np.float32),
+        "fc_weight": rng.randn(3, 4).astype(np.float32),
+        "fc_bias": np.zeros(3, np.float32),
+        "aux:bn_moving_mean": rng.rand(4).astype(np.float32),
+        "aux:bn_moving_var": (rng.rand(4) + 0.5).astype(np.float32),
+    }
+    pred = mx.Predictor(net, params, {"data": (2, 4)})
+    x = rng.randn(2, 4).astype(np.float32)
+    want = pred.predict(data=x)
+    path = str(tmp_path / "bn.mxtpu")
+    pred.export(path)
+    got = load_exported(path).predict(data=x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
