@@ -9,3 +9,4 @@ from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule
 from .python_module import PythonModule
+from .spmd_module import SPMDModule

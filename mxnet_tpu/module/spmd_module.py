@@ -1,0 +1,151 @@
+"""SPMDModule: the Module interface backed by the fused SPMD trainer.
+
+Drop-in for `mx.mod.Module` on a device mesh: same
+`fit/score/predict/bind/init_params/init_optimizer` surface (BaseModule's
+generic loops drive it unchanged), but forward+backward+update execute as
+ONE jitted XLA program over the mesh (`parallel.SPMDTrainer`) instead of
+per-device executors + kvstore push/pull.  `update()` runs the fused step;
+`forward(is_train=False)` uses the AOT inference program.
+
+    mod = mx.mod.SPMDModule(net, mesh=make_mesh((8,), ("data",)),
+                            dtype="bfloat16")
+    mod.fit(train_iter, num_epoch=10,
+            optimizer_params={"learning_rate": 0.1})
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import initializer as init_mod
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+
+class SPMDModule(BaseModule):
+    def __init__(self, symbol, mesh=None, dtype=np.float32,
+                 param_sharding=None, logger=None):
+        import logging
+
+        super().__init__(logger or logging)
+        self._symbol = symbol
+        self._dtype = dtype
+        self._param_sharding = param_sharding
+        if mesh is None:
+            from ..parallel import make_mesh
+
+            mesh = make_mesh()
+        self._mesh = mesh
+        self._trainer = None
+        self._data_shapes = None
+        self._initializer = None
+        self._arg_params = None
+        self._aux_params = None
+        self._pending_batch = None
+        self._outputs = None
+
+    # -- setup -------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False, **_):
+        if self.binded and not force_rebind:
+            return
+        shapes = dict(data_shapes)
+        for name, s in (label_shapes or []):
+            shapes[name] = s
+        self._data_shapes = {n: tuple(s) for n, s in shapes.items()}
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, **_):
+        if not self.binded:
+            raise MXNetError("bind before init_params")
+        self._initializer = initializer or init_mod.Uniform(0.01)
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore=None, optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        """kvstore is accepted for signature parity and ignored — gradient
+        reduction is the XLA all-reduce inside the fused step."""
+        from ..parallel import SPMDTrainer
+
+        if self._trainer is not None and not force_init:
+            return
+        p = dict(optimizer_params or {})
+        if optimizer not in ("sgd", "ccsgd"):
+            raise MXNetError(
+                "SPMDModule fuses the optimizer into the step program; only "
+                "sgd is supported (got %r) — use Module for others" % optimizer)
+        self._trainer = SPMDTrainer(
+            self._symbol, self._mesh, self._data_shapes,
+            initializer=self._initializer,
+            lr=p.get("learning_rate", 0.01),
+            momentum=p.get("momentum", 0.9),
+            wd=p.get("wd", 0.0),
+            dtype=self._dtype,
+            param_sharding=self._param_sharding)
+        if self._arg_params:
+            self.set_params(self._arg_params, self._aux_params or {})
+        self.optimizer_initialized = True
+
+    # -- step --------------------------------------------------------------
+    def _batch_dict(self, data_batch):
+        names = [n for n in self._trainer.data_names]
+        arrays = list(data_batch.data) + list(data_batch.label or [])
+        provided = [n for n, _ in
+                    (data_batch.provide_data or []) +
+                    (data_batch.provide_label or [])]
+        if provided:
+            m = dict(zip(provided, arrays))
+        else:
+            m = dict(zip(names, arrays))
+        return {n: m[n] for n in names if n in m}
+
+    def forward(self, data_batch, is_train=None):
+        if self._trainer is None:
+            raise MXNetError("init_optimizer before forward")
+        batch = self._batch_dict(data_batch)
+        if is_train:
+            self._pending_batch = batch  # fused step runs in update()
+            self._outputs = None
+        else:
+            self._outputs = self._trainer.forward(batch)
+            self._pending_batch = None
+
+    def backward(self, out_grads=None):
+        pass  # inside the fused step
+
+    def update(self):
+        if self._pending_batch is None:
+            raise MXNetError("update: no pending training batch")
+        self._outputs = self._trainer.step(self._pending_batch)
+        self._pending_batch = None
+
+    def get_outputs(self, merge_multi_context=True):
+        if self._outputs is None:
+            raise MXNetError("no outputs; run forward/update first")
+        return [NDArray(np.asarray(o)) for o in self._outputs]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- params ------------------------------------------------------------
+    def get_params(self):
+        return self._trainer.get_params()
+
+    def set_params(self, arg_params, aux_params, **_):
+        import jax
+
+        for n, v in (arg_params or {}).items():
+            if n in self._trainer.params:
+                self._trainer.params[n] = jax.device_put(
+                    np.asarray(getattr(v, "asnumpy", lambda: v)(),
+                               np.float32),
+                    self._trainer._param_sharding[n])
+        for n, v in (aux_params or {}).items():
+            if n in self._trainer.aux:
+                self._trainer.aux[n] = jax.device_put(
+                    np.asarray(getattr(v, "asnumpy", lambda: v)(),
+                               np.float32))

@@ -228,7 +228,13 @@ class SPMDTrainer:
 
     def forward(self, batch):
         rng = jax.random.fold_in(self._base_key, 0)
-        return self._fwd(self.params, self.aux, self.shard_batch(batch), rng)
+        dev = self.shard_batch(batch)
+        for n in self.data_names:  # labels are inert at inference
+            if n not in dev:
+                dev[n] = jax.device_put(
+                    jnp.zeros(self._shape_of[n], jnp.float32),
+                    self._batch_sharding)
+        return self._fwd(self.params, self.aux, dev, rng)
 
     def get_params(self):
         """Host NDArray dicts (checkpoint path)."""

@@ -174,3 +174,23 @@ def test_spmd_trainer_matches_executor_loop():
         np.testing.assert_allclose(
             spmd_params[k], exe.arg_dict[k].asnumpy(),
             rtol=2e-4, atol=1e-5, err_msg=k)
+
+
+def test_spmd_module_fit():
+    """SPMDModule: BaseModule.fit driving the fused SPMD trainer."""
+    from mxnet_tpu.parallel import make_mesh
+
+    X, y = make_blobs(n=512)
+    it = mx.io.NDArrayIter(X, y, batch_size=128, shuffle=True)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    mod = mx.mod.SPMDModule(_mlp(), mesh=mesh)
+    mod.fit(it, num_epoch=6, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=128),
+                      mx.metric.Accuracy())
+    assert score[0][1] > 0.95, score
+
+    pred = mod.predict(mx.io.NDArrayIter(X, batch_size=128))
+    assert pred.shape == (512, 4)
+    arg_p, aux_p = mod.get_params()
+    assert "fc1_weight" in arg_p
