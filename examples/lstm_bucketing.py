@@ -28,9 +28,13 @@ class BucketSentenceIter(DataIter):
     """`example/rnn/bucket_io.py` equivalent over tokenized sentences."""
 
     def __init__(self, sentences, batch_size, buckets=BUCKETS,
-                 vocab_size=None):
+                 vocab_size=None, init_states=None):
         super().__init__()
         self.batch_size = batch_size
+        # LSTM init states ride provide_data with zero arrays per batch,
+        # the reference's bucket_io contract (`bucket_io.py:71-137`)
+        self.init_states = init_states or []
+        self._init_arrays = [mx.nd.zeros(s) for _, s in self.init_states]
         self.buckets = sorted(buckets)
         self.vocab_size = vocab_size or (max(max(s) for s in sentences) + 1)
         self.default_bucket_key = self.buckets[-1]
@@ -46,7 +50,8 @@ class BucketSentenceIter(DataIter):
 
     @property
     def provide_data(self):
-        return [("data", (self.batch_size, self.default_bucket_key))]
+        return [("data", (self.batch_size, self.default_bucket_key))] \
+            + list(self.init_states)
 
     @property
     def provide_label(self):
@@ -71,9 +76,11 @@ class BucketSentenceIter(DataIter):
         labels = np.roll(rows, -1, axis=1)
         labels[:, -1] = 0
         return DataBatch(
-            data=[mx.nd.array(rows)], label=[mx.nd.array(labels)],
+            data=[mx.nd.array(rows)] + self._init_arrays,
+            label=[mx.nd.array(labels)],
             bucket_key=b,
-            provide_data=[("data", (self.batch_size, b))],
+            provide_data=[("data", (self.batch_size, b))]
+            + list(self.init_states),
             provide_label=[("softmax_label", (self.batch_size, b))])
 
 
@@ -122,14 +129,21 @@ def main():
     else:
         logging.info("%s not found, using synthetic sequences", args.data)
         sentences = synthetic_sentences()
-    it = BucketSentenceIter(sentences, args.batch_size)
+    init_states = [("l%d_init_%s" % (i, t),
+                    (args.batch_size, args.num_hidden))
+                   for i in range(args.num_layers) for t in ("c", "h")]
+    it = BucketSentenceIter(sentences, args.batch_size,
+                            init_states=init_states)
     vocab = it.vocab_size
 
+    data_names = ("data",) + tuple(n for n, _ in init_states)
+
     def sym_gen(bucket_key):
-        return models.lstm_unroll(
+        sym = models.lstm_unroll(
             num_lstm_layer=args.num_layers, seq_len=bucket_key,
             input_size=vocab, num_hidden=args.num_hidden,
             num_embed=args.num_embed, num_label=vocab)
+        return sym, data_names, ("softmax_label",)
 
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=it.default_bucket_key)

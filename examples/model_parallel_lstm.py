@@ -52,6 +52,10 @@ def main():
 
     shapes = {"data": (args.batch_size, args.seq_len),
               "softmax_label": (args.batch_size, args.seq_len)}
+    # init states are bound inputs, like the reference's bucket_io contract
+    for i in range(args.num_layers):
+        shapes["l%d_init_c" % i] = (args.batch_size, args.num_hidden)
+        shapes["l%d_init_h" % i] = (args.batch_size, args.num_hidden)
     exe = net.simple_bind(ctx=mx.cpu(), grad_req="write",
                           group2ctx=group2ctx, **shapes)
 

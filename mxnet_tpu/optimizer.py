@@ -64,6 +64,15 @@ class Optimizer:
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    def __getstate__(self):
+        """Pickle support for kvstore set_optimizer (the reference ships
+        pickled optimizers to servers, `kvstore.py:231`): drop the Symbol
+        reference — its op objects hold jax callables that don't pickle,
+        and the lr/wd multiplier dicts it seeded are already materialized."""
+        state = self.__dict__.copy()
+        state["sym"] = None
+        return state
+
     # -- multipliers (optimizer.py:124-170) -------------------------------
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult = {}

@@ -329,7 +329,8 @@ class Symbol:
             f.write(self.tojson())
 
     # -- binding -----------------------------------------------------------
-    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, **kwargs):
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, **kwargs):
         """Allocate arguments from inferred shapes and bind
         (`python/mxnet/symbol.py:616`)."""
         from .context import current_context
@@ -349,7 +350,8 @@ class Symbol:
         if grad_req != "null":
             args_grad = [zeros(s, ctx=ctx) for s in arg_shapes]
         aux = [zeros(s, ctx=ctx) for s in aux_shapes]
-        return Executor(self, ctx, args, args_grad, grad_req, aux)
+        return Executor(self, ctx, args, args_grad, grad_req, aux,
+                        group2ctx=group2ctx)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):

@@ -85,3 +85,28 @@ def test_long_context_lm():
     import re
     m = re.search(r"final loss ([\d.]+)", out)
     assert m and float(m.group(1)) < 2.0, out[-800:]
+
+
+def test_train_mnist_example():
+    out = run_example("train_mnist.py", "--num-epochs", "2",
+                      "--data-dir", "/nonexistent")
+    assert "final validation accuracy" in out
+
+
+def test_train_cifar10_example():
+    out = run_example("train_cifar10.py", "--num-epochs", "1",
+                      "--batch-size", "16")
+    assert "accuracy" in out.lower()
+
+
+def test_lstm_bucketing_example():
+    out = run_example("lstm_bucketing.py", "--num-epochs", "1",
+                      "--num-hidden", "16", "--num-embed", "16",
+                      "--num-layers", "1", "--batch-size", "8",
+                      "--data", "/nonexistent")
+    assert "perplexity" in out.lower() or "Train" in out
+
+
+def test_model_parallel_lstm_example():
+    out = run_example("model_parallel_lstm.py", "--steps", "3")
+    assert "ms/step" in out
