@@ -123,7 +123,7 @@ class SPMDTrainer:
                 return x
             return x.astype(cd)
 
-        def step(params, momenta, aux, batch, rng):
+        def step(params, momenta, aux, batch, rng, lr):
             def f(p):
                 args = [
                     cast_arg(n, batch[n] if n in batch else p[n])
@@ -137,15 +137,17 @@ class SPMDTrainer:
             cot = tuple(jnp.ones_like(o) for o in outs)
             (grads,) = vjp(cot)
             new_params, new_momenta = _sgd_update(
-                params, grads, momenta, self.lr, self.momentum, self.wd,
+                params, grads, momenta, lr, self.momentum, self.wd,
                 rescale,
             )
             aux_out = dict(zip(self.aux_names, new_aux))
             return new_params, new_momenta, aux_out, outs
 
+        # lr is a traced scalar argument, so schedules (set_lr) take effect
+        # without recompiling the step program
         self._step = jax.jit(step, donate_argnums=(0, 1, 2))
 
-        def multi_step(params, momenta, aux, batch, rng, nsteps):
+        def multi_step(params, momenta, aux, batch, rng, lr, nsteps):
             """nsteps fused train steps in ONE XLA program (lax.scan), so
             dispatch/host latency is paid once per call instead of per step.
             `batch` leaves either have a leading (nsteps, ...) axis (fresh
@@ -172,7 +174,7 @@ class SPMDTrainer:
                 cot = tuple(jnp.ones_like(o) for o in outs)
                 (grads,) = vjp(cot)
                 new_params, new_momenta = _sgd_update(
-                    params, grads, momenta, self.lr, self.momentum, self.wd,
+                    params, grads, momenta, lr, self.momentum, self.wd,
                     rescale,
                 )
                 aux_out = dict(zip(self.aux_names, new_aux))
@@ -183,7 +185,7 @@ class SPMDTrainer:
             return params, momenta, aux
 
         self._multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2),
-                                   static_argnums=(5,))
+                                   static_argnums=(6,))
 
         def fwd(params, aux, batch, rng):
             args = [cast_arg(n, batch[n] if n in batch else params[n])
@@ -208,12 +210,18 @@ class SPMDTrainer:
                 else self._batch_sharding)
         return out
 
+    def set_lr(self, lr):
+        """Change the learning rate (no recompile: lr is a traced scalar).
+        Drive from an `lr_scheduler.FactorScheduler` etc. per epoch."""
+        self.lr = float(lr)
+
     def step(self, batch):
         """One fused train step.  Returns the graph outputs."""
         self._nstep += 1
         rng = jax.random.fold_in(self._base_key, self._nstep)
         self.params, self.momenta, self.aux, outs = self._step(
-            self.params, self.momenta, self.aux, self.shard_batch(batch), rng
+            self.params, self.momenta, self.aux, self.shard_batch(batch),
+            rng, jnp.float32(self.lr)
         )
         return outs
 
@@ -224,7 +232,7 @@ class SPMDTrainer:
         rng = jax.random.fold_in(self._base_key, self._nstep)
         self.params, self.momenta, self.aux = self._multi_step(
             self.params, self.momenta, self.aux, self.shard_batch(batch),
-            rng, nsteps)
+            rng, jnp.float32(self.lr), nsteps)
 
     def forward(self, batch):
         rng = jax.random.fold_in(self._base_key, 0)

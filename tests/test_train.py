@@ -194,3 +194,22 @@ def test_spmd_module_fit():
     assert pred.shape == (512, 4)
     arg_p, aux_p = mod.get_params()
     assert "fc1_weight" in arg_p
+
+
+def test_spmd_trainer_set_lr_no_recompile():
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    X, y = make_blobs(n=128)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    tr = SPMDTrainer(_mlp(), mesh,
+                     data_shapes={"data": (64, 20), "softmax_label": (64,)},
+                     initializer=mx.init.Xavier(), lr=0.1, momentum=0.0,
+                     wd=0.0)
+    b = {"data": X[:64], "softmax_label": y[:64]}
+    tr.step(b)
+    p0 = {k: np.asarray(v) for k, v in tr.params.items()}
+    tr.set_lr(0.0)  # zero lr: next step must not move params
+    tr.step(b)
+    for k in p0:
+        np.testing.assert_allclose(np.asarray(tr.params[k]), p0[k],
+                                   err_msg=k)
