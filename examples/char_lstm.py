@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Character-level LSTM language model + sampling (reference
+`example/rnn/char-rnn.ipynb`: train char LSTM on a corpus, then sample text
+one character at a time feeding states back).
+
+Self-contained: trains on a built-in pangram corpus (or --text FILE), then
+greedy/temperature-samples a continuation.  Demonstrates the inference-time
+state-feeding pattern: a seq_len=1 executor whose l*_init_c/h inputs are
+fed from the previous step's state outputs.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+import mxnet_tpu.symbol as sym  # noqa: E402
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 40
+
+
+def lstm_states_symbol(num_layers, vocab, num_hidden, num_embed):
+    """seq_len=1 unroll that ALSO outputs the next (c, h) states, for the
+    sampling loop (the notebook's inference model)."""
+    from mxnet_tpu.models.lstm import LSTMParam, LSTMState, lstm_cell
+
+    embed_weight = sym.Variable("embed_weight")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    data = sym.Variable("data")
+    hidden = sym.Embedding(data=data, input_dim=vocab, weight=embed_weight,
+                           output_dim=num_embed, name="embed_t")
+    outs = []
+    for i in range(num_layers):
+        param = LSTMParam(
+            i2h_weight=sym.Variable("l%d_i2h_weight" % i),
+            i2h_bias=sym.Variable("l%d_i2h_bias" % i),
+            h2h_weight=sym.Variable("l%d_h2h_weight" % i),
+            h2h_bias=sym.Variable("l%d_h2h_bias" % i))
+        state = LSTMState(c=sym.Variable("l%d_init_c" % i),
+                          h=sym.Variable("l%d_init_h" % i))
+        state = lstm_cell(num_hidden, indata=hidden, prev_state=state,
+                          param=param, seqidx=0, layeridx=i)
+        hidden = state.h
+        outs += [state.c, state.h]
+    pred = sym.FullyConnected(data=hidden, num_hidden=vocab,
+                              weight=cls_weight, bias=cls_bias, name="pred")
+    prob = sym.SoftmaxActivation(data=pred, name="prob")
+    return sym.Group([prob] + outs)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--sample-len", type=int, default=120)
+    ap.add_argument("--temperature", type=float, default=0.7)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    text = open(args.text).read() if args.text else CORPUS
+    chars = sorted(set(text))
+    c2i = {c: i for i, c in enumerate(chars)}
+    vocab = len(chars)
+    ids = np.array([c2i[c] for c in text], np.float32)
+    n_seq = (len(ids) - 1) // args.seq_len
+    X = ids[:n_seq * args.seq_len].reshape(n_seq, args.seq_len)
+    Y = ids[1:n_seq * args.seq_len + 1].reshape(n_seq, args.seq_len)
+
+    init_states = [("l%d_init_%s" % (i, t),
+                    (args.batch_size, args.num_hidden))
+                   for i in range(args.num_layers) for t in ("c", "h")]
+    data_names = ("data",) + tuple(n for n, _ in init_states)
+    zeros = [mx.nd.zeros(s) for _, s in init_states]
+    it = mx.io.NDArrayIter(
+        data={"data": X, **{n: np.zeros((n_seq,) + s[1:], np.float32)
+                            for n, s in init_states}},
+        label=Y, batch_size=args.batch_size, shuffle=True)
+
+    net = models.lstm_unroll(
+        num_lstm_layer=args.num_layers, seq_len=args.seq_len,
+        input_size=vocab, num_hidden=args.num_hidden,
+        num_embed=args.num_embed, num_label=vocab)
+    mod = mx.mod.Module(net, data_names=data_names,
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    arg_params, aux_params = mod.get_params()
+
+    # -- sampling with the seq_len=1 state-feeding model -------------------
+    snet = lstm_states_symbol(args.num_layers, vocab, args.num_hidden,
+                              args.num_embed)
+    shapes = {"data": (1,)}
+    for i in range(args.num_layers):
+        shapes["l%d_init_c" % i] = (1, args.num_hidden)
+        shapes["l%d_init_h" % i] = (1, args.num_hidden)
+    exe = snet.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    for k, v in arg_params.items():
+        if k in exe.arg_dict:
+            exe.arg_dict[k][:] = v
+    rng = np.random.RandomState(0)
+    seed = "the "
+    out = list(seed)
+    states = [np.zeros((1, args.num_hidden), np.float32)
+              for _ in range(2 * args.num_layers)]
+    cur = None
+    for ch in seed + "\0" * args.sample_len:
+        if len(out) >= len(seed) + args.sample_len:
+            break
+        feed = c2i[ch] if ch in c2i else cur
+        exe.arg_dict["data"][:] = np.array([feed], np.float32)
+        for i in range(args.num_layers):
+            exe.arg_dict["l%d_init_c" % i][:] = states[2 * i]
+            exe.arg_dict["l%d_init_h" % i][:] = states[2 * i + 1]
+        exe.forward(is_train=False)
+        outs = [o.asnumpy() for o in exe.outputs]
+        states = outs[1:]
+        p = outs[0][0] ** (1.0 / args.temperature)
+        p /= p.sum()
+        cur = int(rng.choice(vocab, p=p))
+        if ch == "\0" or ch not in c2i:
+            out.append(chars[cur])
+    logging.info("sample: %r", "".join(out))
+
+
+if __name__ == "__main__":
+    main()

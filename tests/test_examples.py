@@ -110,3 +110,9 @@ def test_lstm_bucketing_example():
 def test_model_parallel_lstm_example():
     out = run_example("model_parallel_lstm.py", "--steps", "3")
     assert "ms/step" in out
+
+
+def test_char_lstm_example():
+    out = run_example("char_lstm.py", "--num-epochs", "2", "--seq-len", "16",
+                      "--num-hidden", "32", "--sample-len", "30")
+    assert "sample:" in out
