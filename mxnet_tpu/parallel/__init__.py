@@ -11,3 +11,4 @@ from .trainer import SPMDTrainer
 from .sequence import ring_attention, ulysses_attention
 from .pipeline import PipelineParallel
 from .moe import MoEFFN
+from .multihost import init_from_env, global_mesh

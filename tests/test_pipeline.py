@@ -92,3 +92,21 @@ def test_bad_axis_rejected():
     mesh = make_mesh(shape=(4,), axis_names=("data",))
     with pytest.raises(MXNetError):
         PipelineParallel(stage_fn, loss_fn, mesh, axis="pipe")
+
+
+def test_multihost_env_parsing(monkeypatch):
+    """init_from_env resolves coordinator/rank from either env contract
+    without initializing when unconfigured."""
+    from mxnet_tpu.parallel import multihost
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "DMLC_PS_ROOT_URI"):
+        monkeypatch.delenv(k, raising=False)
+    assert multihost.init_from_env() == 1  # no config: single-process no-op
+
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "10.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9091")
+    assert multihost._dmlc_coordinator() == "10.0.0.1:9092"
+
+    with pytest.raises(MXNetError):
+        multihost.init_from_env(coordinator="x:1", num_processes=2,
+                                process_id=5)
