@@ -133,7 +133,9 @@ def main():
         exe.forward(is_train=False)
         outs = [o.asnumpy() for o in exe.outputs]
         states = outs[1:]
-        p = outs[0][0] ** (1.0 / args.temperature)
+        # f64 before renormalizing: np.random.choice verifies sum(p)==1 in
+        # f64 and f32 rounding routinely misses its tolerance
+        p = outs[0][0].astype(np.float64) ** (1.0 / args.temperature)
         p /= p.sum()
         cur = int(rng.choice(vocab, p=p))
         if ch == "\0" or ch not in c2i:

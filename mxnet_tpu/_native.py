@@ -29,17 +29,24 @@ def _find_lib():
     for c in cands:
         if c and os.path.exists(c):
             return c
-    # build on first use when the sources ship without a binary
+    # build on first use when the sources ship without a binary; the flock
+    # serializes concurrent importers (tools/launch.py spawns N processes
+    # that may all hit a fresh checkout at once)
     native_dir = os.path.join(here, "..", "native")
     if os.path.exists(os.path.join(native_dir, "Makefile")):
+        import fcntl
         import subprocess
 
+        built = os.path.join(native_dir, "libmxtpu.so")
+        lock_path = os.path.join(native_dir, ".build.lock")
         try:
-            subprocess.run(["make", "-C", native_dir], check=True,
-                           capture_output=True, timeout=120)
+            with open(lock_path, "w") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)  # winner builds, rest wait
+                if not os.path.exists(built):
+                    subprocess.run(["make", "-C", native_dir], check=True,
+                                   capture_output=True, timeout=120)
         except Exception:
             return None
-        built = os.path.join(native_dir, "libmxtpu.so")
         if os.path.exists(built):
             return built
     return None

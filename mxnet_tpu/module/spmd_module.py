@@ -82,7 +82,9 @@ class SPMDModule(BaseModule):
             self._symbol, self._mesh, self._data_shapes,
             initializer=self._initializer,
             lr=p.get("learning_rate", 0.01),
-            momentum=p.get("momentum", 0.9),
+            # default 0.0 like optimizer.SGD — a drop-in must not change
+            # the effective update rule
+            momentum=p.get("momentum", 0.0),
             wd=p.get("wd", 0.0),
             dtype=self._dtype,
             param_sharding=self._param_sharding)
@@ -105,7 +107,13 @@ class SPMDModule(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         if self._trainer is None:
-            raise MXNetError("init_optimizer before forward")
+            if is_train:
+                raise MXNetError("init_optimizer before training forward")
+            # inference after bind+init_params works without an optimizer,
+            # like Module: build the trainer with inert update params
+            self.init_optimizer(optimizer_params={"learning_rate": 0.0,
+                                                  "momentum": 0.0})
+            self.optimizer_initialized = False  # fit will still init properly
         batch = self._batch_dict(data_batch)
         if is_train:
             self._pending_batch = batch  # fused step runs in update()

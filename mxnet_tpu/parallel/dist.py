@@ -146,19 +146,27 @@ class ParameterServer:
             float(opt.lr), float(opt.momentum), float(opt.wd),
             float(opt.rescale_grad), float(opt.clip_gradient or 0.0),
             int(os.environ.get("MXNET_KVSTORE_REDUCTION_NTHREADS", "4")))
+        # one handle per installed optimizer: destroy the previous one (its
+        # C++ momentum state would otherwise leak across set_optimizer calls)
+        prev = getattr(self, "_native_opt_handle", None)
+        if prev:
+            _native.LIB.mxtpu_sgd_destroy(prev)
+        self._native_opt_handle = h
         fp = ctypes.POINTER(ctypes.c_float)
+        key_ids = {}  # kvstore keys may be str; C side wants stable ints
 
         def native_updater(key, grad, weight, _h=h):
+            kid = key_ids.setdefault(key, len(key_ids))
             g = np.ascontiguousarray(grad, np.float32)
             if weight.dtype != np.float32 or not weight.flags["C_CONTIGUOUS"]:
                 w = np.ascontiguousarray(weight, np.float32)
                 _native.LIB.mxtpu_sgd_update(
-                    _h, int(key), w.ctypes.data_as(fp),
+                    _h, kid, w.ctypes.data_as(fp),
                     g.ctypes.data_as(fp), w.size)
                 weight[...] = w
             else:
                 _native.LIB.mxtpu_sgd_update(
-                    _h, int(key), weight.ctypes.data_as(fp),
+                    _h, kid, weight.ctypes.data_as(fp),
                     g.ctypes.data_as(fp), weight.size)
             return None
 
@@ -250,6 +258,12 @@ class ParameterServer:
             elif op == "stop":
                 _send_msg(conn, {"ok": True})
                 self._stop = True
+                h = getattr(self, "_native_opt_handle", None)
+                if h:
+                    from .. import _native
+
+                    _native.LIB.mxtpu_sgd_destroy(h)
+                    self._native_opt_handle = None
                 self._sock.close()
                 conn.close()
                 return

@@ -279,3 +279,21 @@ def test_dist_server_uses_native_sgd():
     # Adam has no native path
     assert ParameterServer._native_sgd_updater(
         srv, mx.optimizer.Adam()) is None
+
+
+@pytest.mark.skipif(not _native.has_sgd(), reason="native lib lacks sgd")
+def test_native_sgd_str_keys():
+    """kvstore keys may be strings; the native path maps them to ids."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.dist import ParameterServer
+
+    srv = ParameterServer.__new__(ParameterServer)
+    upd = ParameterServer._native_sgd_updater(
+        srv, mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    w1 = np.ones(16, np.float32)
+    w2 = np.ones(16, np.float32)
+    g = np.full(16, 2.0, np.float32)
+    upd("fc1_weight", g, w1)
+    upd("fc2_weight", g, w2)  # distinct momentum state per str key
+    upd("fc1_weight", g, w1)
+    assert np.isfinite(w1).all() and not np.allclose(w1, w2)

@@ -213,3 +213,17 @@ def test_spmd_trainer_set_lr_no_recompile():
     for k in p0:
         np.testing.assert_allclose(np.asarray(tr.params[k]), p0[k],
                                    err_msg=k)
+
+
+def test_spmd_module_inference_only():
+    """bind+init_params+predict without init_optimizer (Module parity)."""
+    from mxnet_tpu.parallel import make_mesh
+
+    X, y = make_blobs(n=128)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    mod = mx.mod.SPMDModule(_mlp(), mesh=mesh)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    pred = mod.predict(mx.io.NDArrayIter(X, batch_size=64))
+    assert pred.shape == (128, 4)
