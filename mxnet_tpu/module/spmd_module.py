@@ -74,18 +74,24 @@ class SPMDModule(BaseModule):
         if self._trainer is not None and not force_init:
             return
         p = dict(optimizer_params or {})
-        if optimizer not in ("sgd", "ccsgd"):
+        if optimizer not in ("sgd", "ccsgd", "adam"):
             raise MXNetError(
-                "SPMDModule fuses the optimizer into the step program; only "
-                "sgd is supported (got %r) — use Module for others" % optimizer)
+                "SPMDModule fuses the optimizer into the step program; "
+                "sgd and adam are supported (got %r) — use Module for "
+                "others" % optimizer)
         self._trainer = SPMDTrainer(
             self._symbol, self._mesh, self._data_shapes,
             initializer=self._initializer,
-            lr=p.get("learning_rate", 0.01),
+            optimizer=optimizer,
+            lr=p.get("learning_rate",
+                     0.002 if optimizer == "adam" else 0.01),
             # default 0.0 like optimizer.SGD — a drop-in must not change
             # the effective update rule
             momentum=p.get("momentum", 0.0),
             wd=p.get("wd", 0.0),
+            beta1=p.get("beta1", 0.9),
+            beta2=p.get("beta2", 0.999),
+            epsilon=p.get("epsilon", 1e-8),
             dtype=self._dtype,
             param_sharding=self._param_sharding)
         if self._arg_params:

@@ -43,6 +43,25 @@ def _sgd_update(params, grads, momenta, lr, momentum, wd, rescale):
     return new_p, new_m
 
 
+def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps):
+    """Fused Adam with the `optimizer.Adam` numerics (wd folded into the
+    gradient, bias-corrected lr).  state: {"_t": count, k: (m, v)}."""
+    t = state["_t"] + 1
+    coef1 = 1 - b1 ** t
+    coef2 = 1 - b2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    new_state = {"_t": t}
+    new_p = {}
+    for k, p in params.items():
+        g = grads[k] * rescale + wd * p
+        m, v = state[k]
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        new_state[k] = (m, v)
+        new_p[k] = p - lr_t * m / (jnp.sqrt(v) + eps)
+    return new_p, new_state
+
+
 class SPMDTrainer:
     """One-program data-parallel trainer for a Symbol graph.
 
@@ -51,16 +70,23 @@ class SPMDTrainer:
     symbol : Symbol whose outputs are loss heads (SoftmaxOutput etc.).
     mesh : jax.sharding.Mesh with a "data" axis (make_mesh()).
     data_shapes : dict name -> global batch shape (like simple_bind kwargs).
-    optimizer : 'sgd' params via lr/momentum/wd (fused); other optimizers
-        can be applied per-step on host via apply_host_optimizer.
+    optimizer : 'sgd' (momentum/wd) or 'adam' (beta1/beta2/epsilon,
+        `optimizer.Adam` numerics) — both fuse into the step program.
     """
 
     def __init__(self, symbol, mesh, data_shapes, initializer=None, lr=0.01,
                  momentum=0.9, wd=0.0001, dtype=np.float32,
-                 param_sharding=None):
+                 param_sharding=None, optimizer="sgd", beta1=0.9,
+                 beta2=0.999, epsilon=1e-8):
         self.symbol = symbol
         self.mesh = mesh
         self.lr, self.momentum, self.wd = lr, momentum, wd
+        if optimizer not in ("sgd", "ccsgd", "adam"):
+            raise MXNetError(
+                "SPMDTrainer fuses the optimizer; sgd and adam are "
+                "supported (got %r)" % (optimizer,))
+        self.optimizer = "sgd" if optimizer == "ccsgd" else optimizer
+        self._adam_hp = (beta1, beta2, epsilon)
         # Mixed precision, the TPU way: master params/momenta/aux stay f32,
         # compute casts to `dtype` (bf16 on the MXU) inside the jitted step,
         # and vjp's cast-transpose returns f32 gradients for the f32 update.
@@ -90,10 +116,20 @@ class SPMDTrainer:
             self._param_sharding[n] = sh
             params[n] = jax.device_put(host.data, sh)
         self.params = params
-        self.momenta = {
-            n: jax.device_put(jnp.zeros_like(v), self._param_sharding[n])
-            for n, v in params.items()
-        }
+        if self.optimizer == "adam":
+            self.momenta = {"_t": jnp.zeros((), jnp.float32)}
+            self.momenta.update({
+                n: (jax.device_put(jnp.zeros_like(v),
+                                   self._param_sharding[n]),
+                    jax.device_put(jnp.zeros_like(v),
+                                   self._param_sharding[n]))
+                for n, v in params.items()
+            })
+        else:
+            self.momenta = {
+                n: jax.device_put(jnp.zeros_like(v), self._param_sharding[n])
+                for n, v in params.items()
+            }
         self.aux = {
             n: jax.device_put(jnp.zeros(s, dtype=np.float32), repl)
             for n, s in zip(self.aux_names, aux_shapes)
@@ -116,6 +152,17 @@ class SPMDTrainer:
 
         cd = self._compute_dtype
 
+        if self.optimizer == "adam":
+            b1, b2, eps = self._adam_hp
+
+            def opt_update(params, grads, state, lr):
+                return _adam_update(params, grads, state, lr, self.wd,
+                                    rescale, b1, b2, eps)
+        else:
+            def opt_update(params, grads, state, lr):
+                return _sgd_update(params, grads, state, lr, self.momentum,
+                                   self.wd, rescale)
+
         def cast_arg(name, x):
             # labels stay in their own dtype (class ids > 256 are not exact
             # in bf16); everything else floating casts to the compute dtype
@@ -136,10 +183,7 @@ class SPMDTrainer:
             outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
             cot = tuple(jnp.ones_like(o) for o in outs)
             (grads,) = vjp(cot)
-            new_params, new_momenta = _sgd_update(
-                params, grads, momenta, lr, self.momentum, self.wd,
-                rescale,
-            )
+            new_params, new_momenta = opt_update(params, grads, momenta, lr)
             aux_out = dict(zip(self.aux_names, new_aux))
             return new_params, new_momenta, aux_out, outs
 
@@ -173,10 +217,8 @@ class SPMDTrainer:
                 outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
                 cot = tuple(jnp.ones_like(o) for o in outs)
                 (grads,) = vjp(cot)
-                new_params, new_momenta = _sgd_update(
-                    params, grads, momenta, lr, self.momentum, self.wd,
-                    rescale,
-                )
+                new_params, new_momenta = opt_update(params, grads, momenta,
+                                                     lr)
                 aux_out = dict(zip(self.aux_names, new_aux))
                 return (new_params, new_momenta, aux_out), ()
 
