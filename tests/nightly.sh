@@ -1,0 +1,13 @@
+#!/bin/bash
+# Nightly-style gate (reference `tests/nightly/test_all.sh`): the full test
+# suite — including the slow multi-process distributed oracles and the
+# accuracy-gated training runs in tests/test_train.py, tests/test_dist.py
+# and tests/test_examples.py — plus a CPU-mesh bench smoke.
+set -e
+cd "$(dirname "$0")/.."
+./run_tests.sh tests/ -q
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    BENCH_BATCH=8 BENCH_IMAGE=64 BENCH_STEPS=2 BENCH_REPS=1 \
+    python bench.py
+echo "nightly: all gates passed"
