@@ -63,6 +63,7 @@ from . import checkpoint
 from . import profiler
 from . import plugin
 from . import resource
+from . import test_utils
 from . import model
 from .model import FeedForward
 from . import module as mod

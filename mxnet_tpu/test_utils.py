@@ -1,0 +1,89 @@
+"""Public testing utilities (the reference's
+`tests/python/common/check_utils.py` helpers, exposed as a library module
+so users can gradient-check their own custom operators and symbols).
+
+    import mxnet_tpu as mx
+    sym = my_custom_op(data=mx.sym.Variable("data"))
+    mx.test_utils.check_numeric_gradient(sym, {"data": x})
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+def reldiff(a, b):
+    """Normalized L1 difference (`check_utils.py` reldiff)."""
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    if norm == 0:
+        return 0.0
+    return diff / norm
+
+
+def numeric_grad(f, x, eps=1e-4):
+    """Central-difference gradient of scalar ``f`` at numpy array ``x``."""
+    x = np.asarray(x, np.float64)
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + eps
+        fp = f(x.astype(np.float32))
+        x[idx] = orig - eps
+        fm = f(x.astype(np.float32))
+        x[idx] = orig
+        grad[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return grad
+
+
+def check_numeric_gradient(sym, location, grad_nodes=None, rtol=1e-2,
+                           atol=None, aux_states=None, eps=1e-4):
+    """Assert executor backward() matches finite differences.
+
+    sym : Symbol whose summed outputs form the loss.
+    location : dict arg_name -> numpy array.
+    grad_nodes : names to check (default: every floating arg in location).
+    """
+    from . import cpu
+    from .ndarray import array
+
+    names = sym.list_arguments()
+    for n in location:
+        if n not in names:
+            raise MXNetError("check_numeric_gradient: %r not an argument"
+                             % (n,))
+    shapes = {n: np.asarray(v).shape for n, v in location.items()}
+    exe = sym.simple_bind(cpu(), grad_req="write", **shapes)
+    for n, v in location.items():
+        exe.arg_dict[n][:] = np.asarray(v, np.float32)
+    if aux_states:
+        for n, v in aux_states.items():
+            exe.aux_dict[n][:] = v
+
+    exe.forward(is_train=True)
+    exe.backward([array(np.ones(o.shape, np.float32))
+                  for o in exe.outputs])
+    grad_nodes = grad_nodes or [
+        n for n in location
+        if np.issubdtype(np.asarray(location[n]).dtype, np.floating)]
+    for name in grad_nodes:
+        def f(x, _name=name):
+            exe.arg_dict[_name][:] = x
+            exe.forward(is_train=False)
+            out = sum(float(np.sum(o.asnumpy())) for o in exe.outputs)
+            exe.arg_dict[_name][:] = np.asarray(location[_name], np.float32)
+            return out
+
+        expected = numeric_grad(f, np.asarray(location[name]), eps=eps)
+        got = exe.grad_dict[name].asnumpy()
+        rd = reldiff(got, expected)
+        if rd > rtol and (atol is None or np.abs(got - expected).max() > atol):
+            raise AssertionError(
+                "numeric gradient check failed for %r: reldiff %.3g > %.3g"
+                % (name, rd, rtol))
+    return exe

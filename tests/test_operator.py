@@ -410,3 +410,19 @@ def test_unpooling(kernel, stride, pad, hw):
     # backward: gradient flows to `data` only (guided gather); the guide
     # inputs get zero gradient like `unpooling-inl.h:117-120`
     check_numeric_gradient(up, loc, grad_nodes=["data"])
+
+
+def test_public_test_utils_api():
+    """mx.test_utils is the public form of these helpers (users gradient-
+    check custom ops with it)."""
+    rng = np.random.RandomState(1)
+    s = mx.sym.Activation(data=mx.sym.Variable("data"), act_type="sigmoid")
+    mx.test_utils.check_numeric_gradient(
+        s, {"data": rng.randn(2, 4).astype(np.float32)})
+    assert mx.test_utils.reldiff(np.ones(3), np.ones(3)) == 0.0
+    with pytest.raises(AssertionError):
+        # deliberately wrong rtol on a random non-gradient comparison
+        bad = mx.sym.BlockGrad(data=mx.sym.Variable("data"))
+        mx.test_utils.check_numeric_gradient(
+            bad, {"data": rng.randn(2, 3).astype(np.float32) + 5.0},
+            rtol=1e-9)
