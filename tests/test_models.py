@@ -149,3 +149,43 @@ def test_rnn_unroll_shapes():
     arg_shapes, out_shapes, _ = net.infer_shape(**shapes)
     assert len(out_shapes) == 3
     assert all(s == (4, 50) for s in out_shapes)
+
+
+ZOO = [
+    ("mlp", lambda: models.get_mlp(), {"data": (2, 784)}),
+    ("lenet", lambda: models.get_lenet(), {"data": (2, 1, 28, 28)}),
+    ("alexnet", lambda: models.get_alexnet(num_classes=10),
+     {"data": (1, 3, 224, 224)}),
+    ("vgg", lambda: models.get_vgg(num_classes=10),
+     {"data": (1, 3, 224, 224)}),
+    ("googlenet", lambda: models.get_googlenet(num_classes=10),
+     {"data": (1, 3, 224, 224)}),
+    ("inception-bn", lambda: models.get_inception_bn(num_classes=10),
+     {"data": (1, 3, 28, 28)}),
+    ("inception-v3", lambda: models.get_inception_v3(num_classes=10),
+     {"data": (1, 3, 299, 299)}),
+    ("resnet18", lambda: models.get_resnet(num_classes=10, num_layers=18,
+                                           image_shape=(3, 32, 32)),
+     {"data": (1, 3, 32, 32)}),
+    ("fcn8s", lambda: models.get_fcn_xs(num_classes=5, variant="fcn8s"),
+     {"data": (1, 3, 32, 32)}),
+    ("transformer", lambda: models.get_transformer_lm(
+        vocab_size=50, seq_len=8, num_layers=1, num_heads=2, num_embed=16),
+     {"data": (2, 8), "softmax_label": (2, 8)}),
+]
+
+
+@pytest.mark.parametrize("name,build,shapes", ZOO,
+                         ids=[z[0] for z in ZOO])
+def test_zoo_json_roundtrip(name, build, shapes, tmp_path):
+    """Every zoo model must survive Symbol JSON save/load with identical
+    structure and shape inference (checkpoint-format parity, SURVEY §5.4)."""
+    net = build()
+    path = str(tmp_path / "m.json")
+    net.save(path)
+    net2 = mx.sym.load(path)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_auxiliary_states() == net.list_auxiliary_states()
+    s1 = net.infer_shape(**shapes)
+    s2 = net2.infer_shape(**shapes)
+    assert s1[1] == s2[1], "output shapes changed through JSON"
