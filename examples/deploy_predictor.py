@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Train -> checkpoint -> serve: the full deployment path.
+
+1. Train a small classifier with FeedForward and checkpoint it
+   (`prefix-symbol.json` + `prefix-%04d.params`, reference format).
+2. Load the checkpoint into a `Predictor` (the `MXPredCreate` surface).
+3. `export()` a single self-contained artifact (StableHLO + params) and
+   serve from `load_exported` with no Symbol graph or op registry — the
+   amalgamation-analogue deployable (`amalgamation/README.md` role).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.predictor import load_exported  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--out-dir", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="deploy_")
+
+    rng = np.random.RandomState(0)
+    n, d, k = 1024, 32, 5
+    y = rng.randint(0, k, n)
+    X = rng.randn(n, d).astype(np.float32)
+    X[np.arange(n), y * 6] += 3.0
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=k, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+    # 1. train + checkpoint
+    model = mx.model.FeedForward(
+        symbol=net, ctx=mx.cpu(), num_epoch=args.num_epoch,
+        optimizer="sgd", learning_rate=0.2, initializer=mx.init.Xavier())
+    model.fit(X=mx.io.NDArrayIter(X, y.astype(np.float32),
+                                  batch_size=args.batch_size, shuffle=True))
+    prefix = os.path.join(out_dir, "clf")
+    model.save(prefix, args.num_epoch)
+    logging.info("checkpoint: %s-{symbol.json,%04d.params}", prefix,
+                 args.num_epoch)
+
+    # 2. predictor from the checkpoint files
+    pred = mx.predictor.load(prefix, args.num_epoch,
+                             input_shapes={"data": (args.batch_size, d)})
+    acc = (pred.predict(data=X[:args.batch_size]).argmax(1)
+           == y[:args.batch_size]).mean()
+    logging.info("Predictor accuracy on a batch: %.3f", acc)
+
+    # 3. single-artifact export -> registry-free serving
+    artifact = os.path.join(out_dir, "clf.mxtpu")
+    pred.export(artifact)
+    served = load_exported(artifact)
+    acc2 = (served.predict(data=X[:args.batch_size]).argmax(1)
+            == y[:args.batch_size]).mean()
+    logging.info("exported artifact %s (%d bytes): accuracy %.3f",
+                 artifact, os.path.getsize(artifact), acc2)
+    assert abs(acc - acc2) < 1e-9
+
+
+if __name__ == "__main__":
+    main()

@@ -116,3 +116,16 @@ def test_char_lstm_example():
     out = run_example("char_lstm.py", "--num-epochs", "2", "--seq-len", "16",
                       "--num-hidden", "32", "--sample-len", "30")
     assert "sample:" in out
+
+
+def test_moe_lm_example():
+    out = run_example("moe_lm.py", "--steps", "60", "--seq-len", "8",
+                      "--batch-size", "8")
+    import re
+    m = re.search(r"final nll ([\d.]+)", out)
+    assert m and float(m.group(1)) < 3.5, out[-800:]
+
+
+def test_deploy_predictor_example():
+    out = run_example("deploy_predictor.py", "--num-epoch", "4")
+    assert "exported artifact" in out
