@@ -71,7 +71,10 @@ class SPMDModule(BaseModule):
         reduction is the XLA all-reduce inside the fused step."""
         from ..parallel import SPMDTrainer
 
-        if self._trainer is not None and not force_init:
+        # guard on optimizer_initialized, not trainer existence: an
+        # inference-only forward builds an inert trainer that fit() must
+        # replace with the real optimizer settings
+        if self.optimizer_initialized and not force_init:
             return
         p = dict(optimizer_params or {})
         if optimizer not in ("sgd", "ccsgd", "adam"):

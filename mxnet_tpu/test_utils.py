@@ -45,45 +45,60 @@ def check_numeric_gradient(sym, location, grad_nodes=None, rtol=1e-2,
                            atol=None, aux_states=None, eps=1e-4):
     """Assert executor backward() matches finite differences.
 
-    sym : Symbol whose summed outputs form the loss.
-    location : dict arg_name -> numpy array.
+    sym : Symbol whose summed outputs form the loss (head-grad of ones,
+        matching Executor.backward's default).
+    location : dict arg_name -> numpy array (every argument).
     grad_nodes : names to check (default: every floating arg in location).
+
+    Both the analytic backward and the finite-difference probes run with
+    ``is_train=True`` so train/eval-divergent operators (BatchNorm batch
+    statistics) are differentiated and probed as the SAME function.
     """
-    from . import cpu
-    from .ndarray import array
+    from . import cpu, nd
 
-    names = sym.list_arguments()
+    arg_names = sym.list_arguments()
     for n in location:
-        if n not in names:
-            raise MXNetError("check_numeric_gradient: %r not an argument"
-                             % (n,))
-    shapes = {n: np.asarray(v).shape for n, v in location.items()}
-    exe = sym.simple_bind(cpu(), grad_req="write", **shapes)
-    for n, v in location.items():
-        exe.arg_dict[n][:] = np.asarray(v, np.float32)
+        if n not in arg_names:
+            raise MXNetError("check_numeric_gradient: %r not an argument "
+                             "(args: %s)" % (n, arg_names))
+    ctx = cpu()
+    args = {n: nd.array(np.asarray(location[n], np.float32))
+            for n in arg_names}
+    grads = {n: nd.zeros(np.asarray(location[n]).shape) for n in arg_names}
+    aux_list = None
     if aux_states:
-        for n, v in aux_states.items():
-            exe.aux_dict[n][:] = v
-
+        aux_list = [nd.array(aux_states[n])
+                    for n in sym.list_auxiliary_states()]
+    exe = sym.bind(ctx, args, grads, "write", aux_list)
     exe.forward(is_train=True)
-    exe.backward([array(np.ones(o.shape, np.float32))
-                  for o in exe.outputs])
+    exe.backward()
     grad_nodes = grad_nodes or [
         n for n in location
         if np.issubdtype(np.asarray(location[n]).dtype, np.floating)]
+    analytic = {n: grads[n].asnumpy() for n in grad_nodes}
+
+    # ONE probe executor reused for every finite-difference eval: updating
+    # a bound arg and re-running forward hits the XLA compile cache
+    probe = sym.bind(ctx,
+                     {n: nd.array(np.asarray(location[n], np.float32))
+                      for n in arg_names},
+                     None, "null", aux_list)
     for name in grad_nodes:
         def f(x, _name=name):
-            exe.arg_dict[_name][:] = x
-            exe.forward(is_train=False)
-            out = sum(float(np.sum(o.asnumpy())) for o in exe.outputs)
-            exe.arg_dict[_name][:] = np.asarray(location[_name], np.float32)
-            return out
+            probe.arg_dict[_name][:] = x
+            outs = probe.forward(is_train=True)
+            return float(sum(o.asnumpy().astype(np.float64).sum()
+                             for o in outs))
 
-        expected = numeric_grad(f, np.asarray(location[name]), eps=eps)
-        got = exe.grad_dict[name].asnumpy()
+        expected = numeric_grad(f, np.asarray(location[name]).copy(),
+                                eps=eps)
+        probe.arg_dict[name][:] = np.asarray(location[name], np.float32)
+        got = analytic[name]
         rd = reldiff(got, expected)
-        if rd > rtol and (atol is None or np.abs(got - expected).max() > atol):
+        if rd > rtol and (atol is None
+                          or np.abs(got - expected).max() > atol):
             raise AssertionError(
                 "numeric gradient check failed for %r: reldiff %.3g > %.3g"
-                % (name, rd, rtol))
+                "\nanalytic=%s\nnumeric=%s"
+                % (name, rd, rtol, got, expected))
     return exe

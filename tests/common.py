@@ -1,74 +1,8 @@
-"""Shared test helpers — port of the reference's
-`tests/python/common/check_utils.py` (reldiff + finite-difference gradient
-checking)."""
-import numpy as np
-
-import mxnet_tpu as mx
-
-
-def reldiff(a, b):
-    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
-    diff = np.sum(np.abs(a - b))
-    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
-    if norm == 0:
-        return 0.0
-    return diff / norm
-
-
-def numeric_grad(f, x, eps=1e-4):
-    """Central-difference gradient of scalar f at numpy array x."""
-    x = np.asarray(x, np.float64)
-    grad = np.zeros_like(x)
-    it = np.nditer(x, flags=["multi_index"])
-    while not it.finished:
-        idx = it.multi_index
-        orig = x[idx]
-        x[idx] = orig + eps
-        fp = f(x.astype(np.float32))
-        x[idx] = orig - eps
-        fm = f(x.astype(np.float32))
-        x[idx] = orig
-        grad[idx] = (fp - fm) / (2 * eps)
-        it.iternext()
-    return grad
-
-
-def check_numeric_gradient(sym, location, grad_nodes=None, rtol=1e-2,
-                           atol=None, aux_states=None):
-    """Compare executor backward() against finite differences.
-
-    location: dict arg_name -> numpy array.  Loss = sum(outputs) via
-    head-grad of ones (matching Executor.backward default).
-    """
-    arg_names = sym.list_arguments()
-    grad_nodes = grad_nodes or [n for n in arg_names if n in location]
-    ctx = mx.cpu()
-    args = {n: mx.nd.array(location[n]) for n in arg_names}
-    grads = {n: mx.nd.zeros(location[n].shape) for n in arg_names}
-    aux_list = None
-    if aux_states:
-        aux_list = [mx.nd.array(aux_states[n])
-                    for n in sym.list_auxiliary_states()]
-    exe = sym.bind(ctx, args, grads, "write", aux_list)
-    exe.forward(is_train=True)
-    exe.backward()
-    analytic = {n: grads[n].asnumpy() for n in grad_nodes}
-
-    # reuse ONE executor for all finite-difference evals: updating a bound
-    # arg and re-running forward hits the XLA compile cache (per-element
-    # rebinding would recompile every probe)
-    probe = sym.bind(ctx, {n: mx.nd.array(location[n]) for n in arg_names},
-                     None, "null", aux_list)
-
-    for name in grad_nodes:
-        def f(x, name=name):
-            probe.arg_dict[name][:] = x
-            outs = probe.forward(is_train=True)
-            return float(sum(o.asnumpy().astype(np.float64).sum()
-                             for o in outs))
-
-        num = numeric_grad(f, location[name].copy())
-        probe.arg_dict[name][:] = location[name]
-        rd = reldiff(analytic[name], num)
-        assert rd < rtol, "gradient mismatch for %s: reldiff=%g\nanalytic=%s\nnumeric=%s" % (
-            name, rd, analytic[name], num)
+"""Shared test helpers: re-exported from the public `mx.test_utils`
+(single source of truth; this module exists so tests keep their historic
+`from common import ...` imports)."""
+from mxnet_tpu.test_utils import (  # noqa: F401
+    check_numeric_gradient,
+    numeric_grad,
+    reldiff,
+)

@@ -282,3 +282,23 @@ def test_spmd_module_adam_fit():
     score = mod.score(mx.io.NDArrayIter(X, y, batch_size=64),
                       mx.metric.Accuracy())
     assert score[0][1] > 0.9, score
+
+
+def test_spmd_module_fit_after_inference_forward():
+    """predict-then-fit: the inert inference trainer must be replaced by
+    the real optimizer when fit runs."""
+    from mxnet_tpu.parallel import make_mesh
+
+    X, y = make_blobs(n=256)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    mod = mx.mod.SPMDModule(_mlp(), mesh=mesh)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.predict(mx.io.NDArrayIter(X, batch_size=64))  # inert trainer built
+    it.reset()
+    mod.fit(it, num_epoch=5, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=64),
+                      mx.metric.Accuracy())
+    assert score[0][1] > 0.9, score
