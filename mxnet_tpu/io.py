@@ -535,7 +535,14 @@ class ImageRecordIter(DataIter):
             if buf is None:
                 break
             header, img = self._recordio_mod.unpack_img(buf)
-            data[n] = np.asarray(img, np.float32).reshape(self._record_shape)
+            img = np.asarray(img, np.float32)
+            rs = self._record_shape
+            if (img.ndim == 3 and img.shape != rs
+                    and img.shape == (rs[1], rs[2], rs[0])):
+                img = img.transpose(2, 0, 1)  # decoded HWC -> NCHW layout
+            elif img.ndim == 2 and rs[0] == 1 and img.shape == rs[1:]:
+                img = img[None]  # grayscale HW -> 1HW
+            data[n] = img.reshape(rs)
             label[n] = header.label
             n += 1
         if n == 0:

@@ -45,22 +45,51 @@ def unpack(s):
 
 
 def unpack_img(s, iscolor=-1):
-    """Unpack a record holding an encoded or raw image.  Without OpenCV in
-    the image, accepts raw `.npy`-encoded payloads written by `pack_img`."""
+    """Unpack a record holding an encoded or raw image (reference
+    `recordio.py` unpack_img, cv2.imdecode role).  Payload format is
+    sniffed: JPEG/PNG decode via PIL to an HWC uint8 array; `.npy`
+    payloads (written by `pack_img(..., img_fmt='.npy')`) load exactly."""
     header, s = unpack(s)
     import io as _io
 
-    arr = np.load(_io.BytesIO(s), allow_pickle=False)
-    return header, arr
+    if s[:6] == b"\x93NUMPY":
+        return header, np.load(_io.BytesIO(s), allow_pickle=False)
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(s))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1 or (iscolor == -1 and img.mode != "L"):
+        img = img.convert("RGB")
+    return header, np.asarray(img)
 
 
 def pack_img(header, img, quality=95, img_fmt=".npy"):
-    """Pack an image array (raw npy payload; JPEG needs OpenCV which the
-    image lacks — the C++ loader handles JPEG when built with libjpeg)."""
+    """Pack an image (reference `recordio.py` pack_img, cv2.imencode role).
+
+    img_fmt '.jpg'/'.jpeg' (lossy, `quality`) or '.png' encode via PIL from
+    an HWC (or HW) uint8-able array; '.npy' stores the array bit-exact
+    (any dtype/layout — the format used for float CHW training payloads).
+    """
     import io as _io
 
     buf = _io.BytesIO()
-    np.save(buf, np.asarray(img), allow_pickle=False)
+    fmt = img_fmt.lower()
+    if fmt in (".jpg", ".jpeg", ".png"):
+        from PIL import Image
+
+        arr = np.asarray(img)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3, 4):
+            arr = arr.transpose(1, 2, 0)  # CHW -> HWC
+        if arr.ndim == 3 and arr.shape[2] == 1:
+            arr = arr[:, :, 0]
+        pil = Image.fromarray(arr.astype(np.uint8))
+        if fmt == ".png":
+            pil.save(buf, format="PNG")
+        else:
+            pil.save(buf, format="JPEG", quality=quality)
+    else:
+        np.save(buf, np.asarray(img), allow_pickle=False)
     return pack(header, buf.getvalue())
 
 
