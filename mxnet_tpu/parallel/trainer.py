@@ -29,10 +29,22 @@ from ..ndarray import NDArray
 from .. import random as _random
 
 
-def _sgd_update(params, grads, momenta, lr, momentum, wd, rescale):
+def _wd_mult(name):
+    """Reference `Optimizer.set_wd_mult` default: weight decay applies to
+    *_weight/*_gamma only — biases/beta/BN stats are excluded
+    (`optimizer.py:76-87`)."""
+    return 1.0 if name.endswith(("weight", "gamma")) else 0.0
+
+
+def _clip(g, clip):
+    return jnp.clip(g, -clip, clip) if clip else g
+
+
+def _sgd_update(params, grads, momenta, lr, momentum, wd, rescale,
+                clip=None):
     new_p, new_m = {}, {}
     for k, p in params.items():
-        g = grads[k] * rescale + wd * p
+        g = _clip(grads[k] * rescale, clip) + wd * _wd_mult(k) * p
         if momentum:
             m = momentum * momenta[k] - lr * g
             new_m[k] = m
@@ -43,7 +55,8 @@ def _sgd_update(params, grads, momenta, lr, momentum, wd, rescale):
     return new_p, new_m
 
 
-def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps):
+def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps,
+                 clip=None):
     """Fused Adam with the `optimizer.Adam` numerics (wd folded into the
     gradient, bias-corrected lr).  state: {"_t": count, k: (m, v)}."""
     t = state["_t"] + 1
@@ -53,7 +66,7 @@ def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps):
     new_state = {"_t": t}
     new_p = {}
     for k, p in params.items():
-        g = grads[k] * rescale + wd * p
+        g = _clip(grads[k] * rescale, clip) + wd * _wd_mult(k) * p
         m, v = state[k]
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * jnp.square(g)
@@ -77,7 +90,7 @@ class SPMDTrainer:
     def __init__(self, symbol, mesh, data_shapes, initializer=None, lr=0.01,
                  momentum=0.9, wd=0.0001, dtype=np.float32,
                  param_sharding=None, optimizer="sgd", beta1=0.9,
-                 beta2=0.999, epsilon=1e-8):
+                 beta2=0.999, epsilon=1e-8, clip_gradient=None):
         self.symbol = symbol
         self.mesh = mesh
         self.lr, self.momentum, self.wd = lr, momentum, wd
@@ -87,6 +100,7 @@ class SPMDTrainer:
                 "supported (got %r)" % (optimizer,))
         self.optimizer = "sgd" if optimizer == "ccsgd" else optimizer
         self._adam_hp = (beta1, beta2, epsilon)
+        self.clip_gradient = clip_gradient
         # Mixed precision, the TPU way: master params/momenta/aux stay f32,
         # compute casts to `dtype` (bf16 on the MXU) inside the jitted step,
         # and vjp's cast-transpose returns f32 gradients for the f32 update.
@@ -157,11 +171,13 @@ class SPMDTrainer:
 
             def opt_update(params, grads, state, lr):
                 return _adam_update(params, grads, state, lr, self.wd,
-                                    rescale, b1, b2, eps)
+                                    rescale, b1, b2, eps,
+                                    clip=self.clip_gradient)
         else:
             def opt_update(params, grads, state, lr):
                 return _sgd_update(params, grads, state, lr, self.momentum,
-                                   self.wd, rescale)
+                                   self.wd, rescale,
+                                   clip=self.clip_gradient)
 
         def cast_arg(name, x):
             # labels stay in their own dtype (class ids > 256 are not exact
