@@ -1,0 +1,93 @@
+"""Full user-journey integration: JPEG RecordIO pack -> augmented sharded
+iterator -> prefetch -> Module training -> atomic checkpoint -> resume ->
+Predictor -> single-artifact export.  Every hop is a subsystem boundary;
+this test catches contract drift between them."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, recordio
+from mxnet_tpu.predictor import load_exported
+
+
+def make_pack(path, n=96, size=12, num_classes=3, seed=0):
+    """Class-colored squares as JPEGs in a RecordIO pack."""
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXRecordIO(path, "w")
+    labels = []
+    for i in range(n):
+        y = i % num_classes
+        img = np.zeros((size, size, 3), np.uint8)
+        img[..., y] = 200  # class = dominant channel
+        img += (rng.rand(size, size, 3) * 40).astype(np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(y), i, 0), img, img_fmt=".png"))
+        labels.append(y)
+    rec.close()
+    return labels
+
+
+def small_net(num_classes=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.Pooling(data=net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Flatten(data=net)
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_full_pipeline_journey(tmp_path):
+    pack = str(tmp_path / "train.rec")
+    make_pack(pack)
+    size, batch = 12, 8
+
+    def make_iter():
+        base = mx.io.ImageRecordIter(
+            path_imgrec=pack, data_shape=(3, 10, 10),
+            record_shape=(3, size, size), batch_size=batch,
+            rand_crop=True, rand_mirror=True, scale=1.0 / 255,
+            use_native=False)
+        return mx.io.PrefetchingIter([base])
+
+    net = small_net()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = make_iter()
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 5e-3},
+            initializer=mx.init.Xavier())
+    score = mod.score(make_iter(), mx.metric.Accuracy())
+    assert score[0][1] > 0.9, score
+
+    # atomic checkpoint with optimizer state
+    arg_p, aux_p = mod.get_params()
+    prefix = str(tmp_path / "ck")
+    checkpoint.save(prefix, 8, net, arg_p, aux_p)
+    assert checkpoint.latest_epoch(prefix) == 8
+
+    # resume into a fresh module: accuracy carries over without training
+    sym2, arg2, aux2, _, epoch = checkpoint.load(prefix)
+    mod2 = mx.mod.Module(sym2, context=mx.cpu())
+    it2 = make_iter()
+    mod2.bind(data_shapes=it2.provide_data, label_shapes=it2.provide_label)
+    mod2.set_params(arg2, aux2)
+    score2 = mod2.score(make_iter(), mx.metric.Accuracy())
+    assert abs(score2[0][1] - score[0][1]) < 0.15
+
+    # serve: Predictor from checkpoint files, then registry-free artifact
+    pred = mx.predictor.load(prefix, epoch,
+                             input_shapes={"data": (batch, 3, 10, 10)})
+    b = next(make_iter())
+    x = b.data[0].asnumpy()
+    want = pred.predict(data=x)
+    artifact = str(tmp_path / "model.mxtpu")
+    pred.export(artifact)
+    got = load_exported(artifact).predict(data=x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # predictions agree with training labels most of the time
+    acc = (got.argmax(1) == b.label[0].asnumpy()).mean()
+    assert acc > 0.7, acc
