@@ -534,9 +534,12 @@ class ImageRecordIter(DataIter):
             buf = self._read_record()
             if buf is None:
                 break
-            header, img = self._recordio_mod.unpack_img(buf)
-            img = np.asarray(img, np.float32)
             rs = self._record_shape
+            # force the channel count at decode (grayscale JPEGs in a color
+            # dataset and vice versa, like the reference's cv2 iscolor)
+            iscolor = 1 if rs[0] == 3 else (0 if rs[0] == 1 else -1)
+            header, img = self._recordio_mod.unpack_img(buf, iscolor=iscolor)
+            img = np.asarray(img, np.float32)
             if (img.ndim == 3 and img.shape != rs
                     and img.shape == (rs[1], rs[2], rs[0])):
                 img = img.transpose(2, 0, 1)  # decoded HWC -> NCHW layout

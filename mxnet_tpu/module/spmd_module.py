@@ -95,6 +95,7 @@ class SPMDModule(BaseModule):
             beta1=p.get("beta1", 0.9),
             beta2=p.get("beta2", 0.999),
             epsilon=p.get("epsilon", 1e-8),
+            clip_gradient=p.get("clip_gradient"),
             dtype=self._dtype,
             param_sharding=self._param_sharding)
         if self._arg_params:

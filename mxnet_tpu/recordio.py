@@ -83,6 +83,13 @@ def pack_img(header, img, quality=95, img_fmt=".npy"):
             arr = arr.transpose(1, 2, 0)  # CHW -> HWC
         if arr.ndim == 3 and arr.shape[2] == 1:
             arr = arr[:, :, 0]
+        if np.issubdtype(arr.dtype, np.floating):
+            if arr.max() <= 1.5:
+                raise MXNetError(
+                    "pack_img: float image looks 0..1-normalized; scale to "
+                    "0..255 before JPEG/PNG packing (or use img_fmt='.npy' "
+                    "for bit-exact float payloads)")
+            arr = np.clip(np.round(arr), 0, 255)
         pil = Image.fromarray(arr.astype(np.uint8))
         if fmt == ".png":
             pil.save(buf, format="PNG")

@@ -195,3 +195,47 @@ def test_image_record_iter_jpeg_payloads(tmp_path):
     b = next(it)
     assert b.data[0].shape == (3, 3, 8, 8)
     assert b.label[0].asnumpy().tolist() == [0.0, 1.0, 2.0]
+
+
+def test_pack_img_rejects_normalized_floats():
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import MXNetError
+
+    img = np.random.RandomState(0).rand(8, 8, 3)  # 0..1 float
+    with pytest.raises(MXNetError):
+        recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img,
+                          img_fmt=".png")
+    # 0..255 floats clip+round fine
+    s = recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img * 255,
+                          img_fmt=".png")
+    _, out = recordio.unpack_img(s)
+    np.testing.assert_array_equal(out, np.clip(np.round(img * 255), 0, 255))
+
+
+def test_image_record_iter_grayscale_in_color_dataset(tmp_path):
+    """A grayscale-mode image inside a 3-channel dataset decodes to 3
+    channels instead of crashing the reshape."""
+    from mxnet_tpu import recordio
+    import mxnet_tpu as mx
+    from PIL import Image
+    import io as _io
+
+    path = str(tmp_path / "mixed.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    color = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), color,
+                                img_fmt=".png"))
+    # hand-craft a grayscale-mode PNG record
+    buf = _io.BytesIO()
+    Image.fromarray((rng.rand(8, 8) * 255).astype(np.uint8), "L").save(
+        buf, format="PNG")
+    rec.write(recordio.pack(recordio.IRHeader(0, 1.0, 1, 0),
+                            buf.getvalue()))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=2, use_native=False)
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 8, 8)
+    arr = b.data[0].asnumpy()[1]
+    np.testing.assert_allclose(arr[0], arr[1])  # gray replicated to RGB
