@@ -36,23 +36,23 @@ def get_googlenet(num_classes=1000):
     conv1 = ConvFactory(data, 64, kernel=(7, 7), stride=(2, 2), pad=(3, 3),
                         name="conv1")
     pool1 = sym.Pooling(data=conv1, kernel=(3, 3), stride=(2, 2),
-                        pad=(1, 1), pool_type="max", name="pool1")
+                        pool_type="max", name="pool1")
     conv2 = ConvFactory(pool1, 64, kernel=(1, 1), name="conv2")
     conv3 = ConvFactory(conv2, 192, kernel=(3, 3), pad=(1, 1), name="conv3")
     pool3 = sym.Pooling(data=conv3, kernel=(3, 3), stride=(2, 2),
-                        pad=(1, 1), pool_type="max", name="pool3")
+                        pool_type="max", name="pool3")
 
     in3a = InceptionFactory(pool3, 64, 96, 128, 16, 32, "max", 32, "in3a")
     in3b = InceptionFactory(in3a, 128, 128, 192, 32, 96, "max", 64, "in3b")
     pool4 = sym.Pooling(data=in3b, kernel=(3, 3), stride=(2, 2),
-                        pad=(1, 1), pool_type="max", name="pool4")
+                        pool_type="max", name="pool4")
     in4a = InceptionFactory(pool4, 192, 96, 208, 16, 48, "max", 64, "in4a")
     in4b = InceptionFactory(in4a, 160, 112, 224, 24, 64, "max", 64, "in4b")
     in4c = InceptionFactory(in4b, 128, 128, 256, 24, 64, "max", 64, "in4c")
     in4d = InceptionFactory(in4c, 112, 144, 288, 32, 64, "max", 64, "in4d")
     in4e = InceptionFactory(in4d, 256, 160, 320, 32, 128, "max", 128, "in4e")
     pool5 = sym.Pooling(data=in4e, kernel=(3, 3), stride=(2, 2),
-                        pad=(1, 1), pool_type="max", name="pool5")
+                        pool_type="max", name="pool5")
     in5a = InceptionFactory(pool5, 256, 160, 320, 32, 128, "max", 128, "in5a")
     in5b = InceptionFactory(in5a, 384, 192, 384, 48, 128, "max", 128, "in5b")
     pool6 = sym.Pooling(data=in5b, kernel=(7, 7), stride=(1, 1),

@@ -189,3 +189,23 @@ def test_zoo_json_roundtrip(name, build, shapes, tmp_path):
     s1 = net.infer_shape(**shapes)
     s2 = net2.infer_shape(**shapes)
     assert s1[1] == s2[1], "output shapes changed through JSON"
+
+
+@pytest.mark.parametrize("name,build,shapes", ZOO, ids=[z[0] for z in ZOO])
+def test_zoo_forward_executes(name, build, shapes):
+    """Shape inference passing is not enough: every zoo model must actually
+    run one forward batch (caught a ceil-pool/conv branch mismatch that
+    inference alone missed)."""
+    net = build()
+    exe = net.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(0)
+    for n, arr in exe.arg_dict.items():
+        if n in shapes and "label" not in n:
+            arr[:] = rng.randn(*arr.shape).astype(np.float32)
+        elif n not in shapes:
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.05
+    for n, arr in exe.aux_dict.items():  # BN stats: mean 0, var 1
+        arr[:] = 1.0 if n.endswith("var") else 0.0
+    exe.forward(is_train=False)
+    out = exe.outputs[0].asnumpy()
+    assert np.isfinite(out).all(), name
