@@ -13,6 +13,44 @@ import numpy as np
 from .base import MXNetError
 
 
+def force_cpu_devices(n=8):
+    """Force an ``n``-device virtual CPU platform for multi-device tests.
+
+    The TPU build's version of the reference's hardware fakes (SURVEY §4:
+    ctx_group on cpu(0)/cpu(1), localhost PS processes): mesh/SPMD logic runs
+    on ``n`` virtual CPU devices.  Must be called BEFORE the first jax
+    backend initialization.  Handles the environment quirk where
+    ``sitecustomize`` imports jax at interpreter startup (so ``JAX_PLATFORMS``
+    in the environment is too late — ``jax.config.update`` still works until
+    the backend is actually initialized), and rewrites a preexisting
+    ``--xla_force_host_platform_device_count`` flag if it asks for fewer
+    than ``n`` devices.
+    """
+    import os
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags + " --xla_force_host_platform_device_count=%d"
+                 % n).strip()
+    elif int(m.group(1)) < n:
+        flags = (flags[:m.start()]
+                 + "--xla_force_host_platform_device_count=%d" % n
+                 + flags[m.end():])
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n:
+        raise MXNetError(
+            "force_cpu_devices(%d): jax backend already initialized with "
+            "%d devices; call before any jax computation (fresh process)"
+            % (n, len(jax.devices())))
+
+
 def reldiff(a, b):
     """Normalized L1 difference (`check_utils.py` reldiff)."""
     a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)

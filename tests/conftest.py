@@ -5,22 +5,10 @@ multi-device logic (DP executor groups, mesh sharding, model parallelism)
 runs on 8 virtual CPU devices, the same way the reference tested
 model-parallel code on cpu(0)/cpu(1).
 
-NOTE: the environment's ``sitecustomize`` imports jax and registers the real
-TPU platform at interpreter startup, so setting ``JAX_PLATFORMS`` in
-``os.environ`` here is already too late — and initializing the TPU from a
-test process blocks on the (single-tenant) device tunnel.
-``jax.config.update`` still works after import; XLA_FLAGS is read at first
-backend init, which has not happened yet at conftest time.
+All the platform-forcing subtlety (sitecustomize importing jax early, flag
+rewriting) lives in mxnet_tpu.test_utils.force_cpu_devices, shared with
+``__graft_entry__.dryrun_multichip``.
 """
-import os
+from mxnet_tpu.test_utils import force_cpu_devices
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
