@@ -116,6 +116,8 @@ class SPMDModule(BaseModule):
         return {n: m[n] for n in names if n in m}
 
     def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training  # Module semantics (module.py:157)
         if self._trainer is None:
             if is_train:
                 raise MXNetError("init_optimizer before training forward")

@@ -24,6 +24,24 @@ from ..base import MXNetError
 from .registry import OpCtx, OpDef, Param, register
 
 
+def _accum_kwargs(*operands):
+    """f32-accumulation request for low-precision matmuls/convs off-TPU.
+
+    The TPU MXU accumulates bf16 contractions in f32 natively, so on TPU no
+    annotation is needed (and keeping the output dtype == operand dtype lets
+    XLA fuse freely).  On other backends — notably the CPU mesh the test
+    suite runs on — bf16 contractions may accumulate in bf16, silently
+    degrading the mixed-precision path; request f32 accumulation there and
+    cast back (callers pair this with ``.astype(jnp.result_type(*operands))``
+    so output dtypes are backend-invariant)."""
+    if jax.default_backend() == "tpu":
+        return {}
+    dt = jnp.result_type(*operands)
+    if dt in (jnp.bfloat16, jnp.float16):
+        return {"preferred_element_type": jnp.float32}
+    return {}
+
+
 def _pair(v, name):
     if v is None:
         return None
@@ -178,10 +196,8 @@ class FullyConnected(OpDef):
     def apply(self, octx, params, inputs, aux):
         x = inputs[0].reshape(inputs[0].shape[0], -1)
         w = inputs[1]
-        # no explicit accumulation dtype: the TPU MXU accumulates bf16
-        # matmuls in f32 natively, and preferred_element_type!=operand dtype
-        # is not transposable through lax.conv/astype chains
-        y = jnp.dot(x, w.T)
+        y = jnp.dot(x, w.T, **_accum_kwargs(x, w)).astype(
+            jnp.result_type(x, w))
         if not params["no_bias"]:
             y = y + inputs[2]
         return [y], []
@@ -247,7 +263,8 @@ class Convolution(OpDef):
             rhs_dilation=dil,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=params["num_group"],
-        )
+            **_accum_kwargs(x, w),
+        ).astype(jnp.result_type(x, w))
         if not params["no_bias"]:
             y = y + inputs[2].reshape(1, -1, 1, 1)
         return [y], []
@@ -305,7 +322,8 @@ class Deconvolution(OpDef):
             lhs_dilation=s,
             dimension_numbers=("NCHW", "IOHW", "NCHW"),
             feature_group_count=params["num_group"],
-        )
+            **_accum_kwargs(x, w),
+        ).astype(jnp.result_type(x, w))
         if not params["no_bias"]:
             y = y + inputs[2].reshape(1, -1, 1, 1)
         return [y], []

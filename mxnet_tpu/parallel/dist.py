@@ -183,13 +183,25 @@ class ParameterServer:
         while True:
             msg = _recv_msg(conn)
             if msg is None:
+                # EOF alone must NOT deregister the rank: a crashed worker's
+                # sockets are closed by the OS exactly like an intentional
+                # close, and crash detection relies on its heartbeat
+                # timestamp going stale.  Deliberate departure is signalled
+                # by the explicit "goodbye" op (DistKVStore.close).
                 conn.close()
                 return
             op = msg["op"]
             if "rank" in msg:
                 with self._lock:
                     self._last_seen[msg["rank"]] = time.time()
-            if op == "heartbeat":
+            if op == "goodbye":
+                # worker is leaving on purpose: stop liveness-tracking it so
+                # a rank that finishes early doesn't trip the watchdog for
+                # the ranks still running
+                with self._lock:
+                    self._last_seen.pop(msg.get("rank"), None)
+                _send_msg(conn, {"ok": True})
+            elif op == "heartbeat":
                 err = self._check_dead()
                 _send_msg(conn, err or {"ok": True})
             elif op == "init":
@@ -305,16 +317,39 @@ class DistKVStore(KVStore):
             self._hb_thread.start()
 
     def _heartbeat_loop(self, interval):
-        try:
-            sock = socket.create_connection(self._addr, timeout=30)
-        except OSError:
-            return
-        while not self._hb_stop.wait(interval):
+        # A transient socket error must not silence liveness reporting for
+        # the rest of the job (the watchdog would then falsely declare this
+        # rank dead and poison every blocked BSP waiter): reconnect with
+        # capped exponential backoff instead of exiting.
+        sock = None
+        backoff = min(interval, 1.0)
+        while not self._hb_stop.is_set():
+            if sock is None:
+                try:
+                    sock = socket.create_connection(self._addr, timeout=30)
+                    backoff = min(interval, 1.0)
+                except OSError:
+                    if self._hb_stop.wait(backoff):
+                        break
+                    backoff = min(backoff * 2, 30.0)
+                    continue
             try:
                 _send_msg(sock, {"op": "heartbeat", "rank": self.rank})
                 _recv_msg(sock)
             except OSError:
-                return
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = None
+                continue
+            if self._hb_stop.wait(interval):
+                break
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _rpc(self, msg):
         msg.setdefault("rank", self.rank)
@@ -369,6 +404,24 @@ class DistKVStore(KVStore):
     def stop_server(self):
         if self.rank == 0:
             self._rpc({"op": "stop"})
+        self.close()
+
+    def close(self):
+        """Deliberately leave the job: stop heartbeating, tell the server to
+        deregister this rank (so our silence doesn't trip the watchdog for
+        the ranks still running), and drop the connections."""
+        hb = getattr(self, "_hb_stop", None)
+        if hb is not None:
+            hb.set()
+            self._hb_thread.join(timeout=5)
+        try:
+            self._rpc({"op": "goodbye"})
+        except (OSError, MXNetError):
+            pass  # server already gone
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 def run_server():

@@ -219,21 +219,33 @@ class Predictor:
         )
         exported = jax_export.export(jax.jit(infer))(
             input_avals, params_avals)
-        payload = {
-            "stablehlo": exported.serialize(),
+        # Non-executable container (npz = zip of .npy entries): raw
+        # StableHLO bytes + JSON metadata + plain ndarrays.  Unlike pickle,
+        # loading an artifact from an untrusted source cannot run code —
+        # matching the reference's inert JSON+binary deploy format.
+        meta = {
+            "format": "mxnet_tpu_predictor",
+            "version": 1,
             "input_names": self._input_names,
             "input_shapes": {
-                n: tuple(self._arg_arrays[self._arg_index[n]].shape)
+                n: list(self._arg_arrays[self._arg_index[n]].shape)
                 for n in self._input_names},
             "dtype": np.dtype(self._dtype).name,
-            "out_shapes": [tuple(s) for s in self._out_shapes],
-            "args": [np.asarray(a) for a in self._arg_arrays],
-            "aux": [np.asarray(a) for a in self._aux_arrays],
+            "out_shapes": [list(s) for s in self._out_shapes],
+            "n_args": len(self._arg_arrays),
+            "n_aux": len(self._aux_arrays),
         }
-        import pickle
-
+        payload = {
+            "meta_json": np.frombuffer(
+                json.dumps(meta).encode("utf-8"), np.uint8),
+            "stablehlo": np.frombuffer(exported.serialize(), np.uint8),
+        }
+        for i, a in enumerate(self._arg_arrays):
+            payload["arg_%d" % i] = np.asarray(a)
+        for i, a in enumerate(self._aux_arrays):
+            payload["aux_%d" % i] = np.asarray(a)
         with open(path, "wb") as f:
-            pickle.dump(payload, f, protocol=4)
+            np.savez(f, **payload)
 
 
 class ExportedPredictor:
@@ -241,18 +253,26 @@ class ExportedPredictor:
     deserialized StableHLO executed directly (the amalgamated predictor)."""
 
     def __init__(self, path, ctx=None):
-        import pickle
         from jax import export as jax_export
 
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
-        self._fn = jax_export.deserialize(payload["stablehlo"])
-        self._input_names = payload["input_names"]
-        self._input_shapes = payload["input_shapes"]
-        self._dtype = np.dtype(payload["dtype"])
-        self._out_shapes = payload["out_shapes"]
-        self._params = (tuple(jnp.asarray(a) for a in payload["args"]),
-                        tuple(jnp.asarray(a) for a in payload["aux"]))
+        with np.load(path, allow_pickle=False) as payload:
+            meta = json.loads(bytes(payload["meta_json"]).decode("utf-8"))
+            if meta.get("format") != "mxnet_tpu_predictor":
+                raise MXNetError(
+                    "ExportedPredictor: %r is not a predictor artifact"
+                    % path)
+            self._fn = jax_export.deserialize(
+                bytearray(payload["stablehlo"].tobytes()))
+            args = tuple(jnp.asarray(payload["arg_%d" % i])
+                         for i in range(meta["n_args"]))
+            aux = tuple(jnp.asarray(payload["aux_%d" % i])
+                        for i in range(meta["n_aux"]))
+        self._input_names = meta["input_names"]
+        self._input_shapes = {n: tuple(s)
+                              for n, s in meta["input_shapes"].items()}
+        self._dtype = np.dtype(meta["dtype"])
+        self._out_shapes = [tuple(s) for s in meta["out_shapes"]]
+        self._params = (args, aux)
         self._outputs = None
 
     def forward(self, **inputs):

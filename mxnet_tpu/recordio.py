@@ -84,9 +84,11 @@ def pack_img(header, img, quality=95, img_fmt=".npy"):
         if arr.ndim == 3 and arr.shape[2] == 1:
             arr = arr[:, :, 0]
         if np.issubdtype(arr.dtype, np.floating):
-            if arr.max() <= 1.5:
+            # only reject what is *provably* 0..1-normalized; a legitimately
+            # dark 0..255 float image (near-black crop) must pack fine
+            if arr.size and arr.min() >= 0.0 and arr.max() <= 1.0:
                 raise MXNetError(
-                    "pack_img: float image looks 0..1-normalized; scale to "
+                    "pack_img: float image values all in [0, 1] — scale to "
                     "0..255 before JPEG/PNG packing (or use img_fmt='.npy' "
                     "for bit-exact float payloads)")
             arr = np.clip(np.round(arr), 0, 255)

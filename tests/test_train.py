@@ -330,3 +330,28 @@ def test_spmd_trainer_wd_excludes_bias():
                                err_msg="wd leaked into biases")
     assert not np.allclose(p_wd["fc1_weight"], p_nowd["fc1_weight"]), \
         "wd had no effect on weights"
+
+
+def test_spmd_module_manual_loop_default_is_train():
+    """The documented drop-in manual loop — forward(batch) with no is_train,
+    then backward() + update() — must run a TRAINING forward when bound
+    for_training=True (Module semantics, module.py:157): params move and
+    update() finds a pending batch."""
+    from mxnet_tpu.parallel import make_mesh
+
+    X, y = make_blobs(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    mod = mx.mod.SPMDModule(_mlp(), mesh=mesh)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    p0, _ = mod.get_params()
+    p0 = {k: v.asnumpy().copy() for k, v in p0.items()}
+    batch = next(iter(it))
+    mod.forward(batch)  # is_train defaults to for_training=True
+    mod.backward()
+    mod.update()
+    p1, _ = mod.get_params()
+    moved = any(not np.allclose(p0[k], p1[k].asnumpy()) for k in p0)
+    assert moved, "default-is_train forward did not train"
