@@ -198,6 +198,7 @@ class Executor:
             (grads,) = vjp_fn(cots)
             return outs, new_aux, grads
 
+        self._train_step_fn = train_step  # un-jitted, for profiler.plan
         self._jit_train_step = jax.jit(train_step)
         self._base_key = _random.next_key()
         self._step = 0
@@ -362,6 +363,16 @@ class Executor:
                 nd._set_data(nd.data + g)
             else:
                 nd._set_data(g)
+
+    def debug_str(self, mode="auto"):
+        """Execution-plan dump (`GraphExecutor::Print`,
+        `graph_executor.cc:853-886`): per-node op/shape table with an
+        analytic FLOPs/HBM-bytes roofline plus XLA's cost and memory
+        analysis of the compiled program.  See `profiler.plan` for the
+        structured form."""
+        from . import profiler
+
+        return str(profiler.plan(self, mode=mode))
 
     def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
         """Copy parameters by name (`executor.py` copy_params_from)."""

@@ -22,6 +22,7 @@ import logging
 import time
 
 import jax
+import numpy as np
 
 from .base import MXNetError
 
@@ -97,3 +98,214 @@ class StepTimer:
             "p99_ms": 1e3 * times[min(n - 1, int(n * 0.99))],
             "steps_per_sec": (n / sum(times)) if sum(times) else 0.0,
         }
+
+
+# ---------------------------------------------------------------------------
+# Execution-plan observability (`GraphExecutor::Print`,
+# `src/symbol/graph_executor.cc:853-886`): per-node shapes + an itemized
+# FLOPs/HBM-bytes roofline, plus XLA's own cost/memory analysis of the
+# actual compiled program.
+# ---------------------------------------------------------------------------
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _node_cost(op_name, params, in_shapes, out_shapes, dsize):
+    """Analytic (flops, hbm_bytes) for one graph node.
+
+    flops follow the standard conventions (2*MACs for contractions); bytes
+    are the minimum HBM traffic if nothing fuses — inputs read once, outputs
+    written once.  XLA fusion makes the true per-op traffic lower; the
+    aggregate truth lives in `ExecutionPlan.xla`.  Good enough to rank the
+    movers, which is this tool's job."""
+    ins = [s for s in in_shapes if s]
+    outs = [s for s in out_shapes if s]
+    in_elems = sum(_prod(s) for s in ins)
+    out_elems = sum(_prod(s) for s in outs)
+    bytes_ = (in_elems + out_elems) * dsize
+    if op_name in ("Convolution", "Deconvolution"):
+        k = params.get("kernel") or ()
+        groups = int(params.get("num_group") or 1)
+        # MACs = out_elems * (C_in/g * prod(kernel)); for Deconvolution the
+        # same formula holds with its (bigger) output
+        cin = ins[1][0] if op_name == "Deconvolution" else ins[1][1] * groups
+        flops = 2 * out_elems * (cin // groups) * max(_prod(k), 1)
+    elif op_name == "FullyConnected":
+        flops = 2 * _prod(outs[0]) * _prod(ins[0][1:])
+    elif op_name == "BatchNorm":
+        flops = 10 * in_elems
+    elif op_name in ("SoftmaxOutput", "softmax_cross_entropy", "Softmax",
+                     "SoftmaxActivation", "log_softmax", "softmax"):
+        flops = 5 * in_elems
+    elif op_name == "Pooling":
+        k = params.get("kernel") or (1, 1)
+        flops = out_elems * max(_prod(k), 1)
+    elif op_name == "LRN":
+        flops = int(params.get("nsize") or 5) * 3 * in_elems
+    elif op_name == "dot":
+        a, b = ins[0], ins[1]
+        flops = 2 * _prod(a) * (_prod(b) // max(a[-1], 1))
+    else:
+        flops = out_elems  # elementwise-ish default: 1 flop per output
+    return int(flops), int(bytes_)
+
+
+class PlanNode:
+    __slots__ = ("name", "op", "in_shapes", "out_shapes", "flops", "bytes")
+
+    def __init__(self, name, op, in_shapes, out_shapes, flops, bytes_):
+        self.name, self.op = name, op
+        self.in_shapes, self.out_shapes = in_shapes, out_shapes
+        self.flops, self.bytes = flops, bytes_
+
+
+class ExecutionPlan:
+    """Itemized plan of one bound executor: per-node shapes + analytic
+    flops/bytes, XLA aggregate cost & memory analysis, and the lowered HLO.
+
+    `str(plan)` prints the reference-`Print`-style report; `plan.table()`
+    returns the rows; `plan.hlo` is the lowered StableHLO text."""
+
+    def __init__(self, nodes, xla, hlo, mode, n_params_bytes):
+        self.nodes = nodes
+        self.xla = xla  # dict: flops, bytes_accessed, peak_bytes, ...
+        self.hlo = hlo
+        self.mode = mode
+        self.param_bytes = n_params_bytes
+        self.total_flops = sum(n.flops for n in nodes)
+        self.total_bytes = sum(n.bytes for n in nodes)
+
+    def table(self, top=None, by="flops"):
+        """Rows sorted by decreasing cost: (name, op, out_shapes, flops,
+        bytes, flops_pct, bytes_pct)."""
+        rows = sorted(self.nodes, key=lambda n: -getattr(n, by))
+        if top:
+            rows = rows[:top]
+        out = []
+        for n in rows:
+            out.append({
+                "name": n.name, "op": n.op, "out_shapes": n.out_shapes,
+                "flops": n.flops, "bytes": n.bytes,
+                "flops_pct": 100.0 * n.flops / max(self.total_flops, 1),
+                "bytes_pct": 100.0 * n.bytes / max(self.total_bytes, 1),
+            })
+        return out
+
+    def __str__(self):
+        lines = ["Execution plan (%s)" % self.mode,
+                 "%-34s %-16s %-24s %12s %12s" % (
+                     "node", "op", "out_shapes", "GFLOPs", "MB")]
+        for n in self.nodes:
+            lines.append("%-34s %-16s %-24s %12.3f %12.2f" % (
+                n.name[:34], n.op[:16],
+                ",".join("x".join(map(str, s)) for s in n.out_shapes)[:24],
+                n.flops / 1e9, n.bytes / 1e6))
+        lines.append("-" * 100)
+        lines.append("analytic totals: %.2f GFLOPs, %.1f MB unfused traffic, "
+                     "params %.1f MB"
+                     % (self.total_flops / 1e9, self.total_bytes / 1e6,
+                        self.param_bytes / 1e6))
+        if self.xla:
+            lines.append("XLA compiled:    " + ", ".join(
+                "%s=%.4g" % (k, v) for k, v in sorted(self.xla.items())))
+        return "\n".join(lines)
+
+
+def _xla_analysis(compiled):
+    """Normalize compiled.cost_analysis()/memory_analysis() across jax
+    versions into one flat dict."""
+    out = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        for k in ("flops", "bytes accessed", "optimal_seconds"):
+            if k in cost:
+                out[k.replace(" ", "_")] = float(cost[k])
+    except Exception:  # backend may not implement cost analysis
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                out[k] = float(v)
+        if "temp_size_in_bytes" in out:
+            out["peak_bytes_est"] = (
+                out.get("argument_size_in_bytes", 0.0)
+                + out.get("output_size_in_bytes", 0.0)
+                + out["temp_size_in_bytes"])
+    except Exception:
+        pass
+    return out
+
+
+def plan(executor, mode="auto"):
+    """Build the `ExecutionPlan` for a bound Executor — the analogue of
+    `GraphExecutor::Print` plus XLA cost analysis.
+
+    mode: 'eval' (inference forward), 'train' (training forward), or
+    'train_step' (the fused fwd+bwd program backward() runs); 'auto' picks
+    'train_step' when gradients are bound else 'eval'."""
+    import jax.numpy as jnp
+
+    from .symbol import _topo_order
+
+    if mode == "auto":
+        mode = "train_step" if executor.grad_arrays is not None else "eval"
+    if mode not in ("eval", "train", "train_step"):
+        raise MXNetError("plan: unknown mode %r" % mode)
+
+    # -- per-node shapes: one forward walk with all arg shapes known -------
+    arg_shapes = {n: tuple(a.shape)
+                  for n, a in zip(executor._arg_names, executor.arg_arrays)}
+    dsize = int(np.dtype(executor.arg_arrays[0].dtype).itemsize) \
+        if executor.arg_arrays else 4
+    order = executor._order
+    entry_shape = {}
+    nodes = []
+    for node in order:
+        if node.is_variable:
+            entry_shape[(id(node), 0)] = arg_shapes.get(node.name)
+            continue
+        in_shapes = [entry_shape.get((id(s), i)) for s, i in node.inputs]
+        _, outs, _ = node.op.infer_shape(node.params, in_shapes)
+        for i, s in enumerate(outs):
+            entry_shape[(id(node), i)] = tuple(s) if s else None
+        out_shapes = [tuple(s) for s in outs if s]
+        flops, bytes_ = _node_cost(node.op.name, node.params, in_shapes,
+                                   out_shapes, dsize)
+        nodes.append(PlanNode(node.name, node.op.name,
+                              [s for s in in_shapes if s], out_shapes,
+                              flops, bytes_))
+
+    # -- lower + compile the program this executor actually runs -----------
+    args = executor._gather(executor.arg_arrays)
+    aux = executor._gather(executor.aux_arrays)
+    rng = jax.random.PRNGKey(0)
+    if mode == "train_step":
+        avals = executor._out_avals(args, aux, rng)
+        cots = tuple(jnp.ones(o.shape, o.dtype) for o in avals)
+        # the per-node table stays the forward plan (what the user built);
+        # the xla numbers describe the actual fused fwd+bwd program
+        lowered = jax.jit(executor._train_step_fn).lower(args, aux, rng, cots)
+    elif mode == "train":
+        lowered = jax.jit(lambda a, x, r: executor._fn(a, x, r, True)).lower(
+            args, aux, rng)
+    else:
+        lowered = jax.jit(lambda a, x, r: executor._fn(a, x, r, False)).lower(
+            args, aux, rng)
+    compiled = lowered.compile()
+    xla = _xla_analysis(compiled)
+    hlo = lowered.as_text()
+
+    param_bytes = sum(
+        _prod(a.shape) * np.dtype(a.dtype).itemsize
+        for a in executor.arg_arrays)
+    return ExecutionPlan(nodes, xla, hlo, mode, param_bytes)

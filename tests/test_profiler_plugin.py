@@ -64,3 +64,47 @@ def test_sframe_iter_multi_column():
 def test_sframe_iter_bad_column():
     with pytest.raises(MXNetError):
         SFrameIter({"x": np.ones(4)}, data_field="nope", batch_size=2)
+
+
+def test_execution_plan_and_debug_str():
+    """profiler.plan / Executor.debug_str: the GraphExecutor::Print
+    analogue must itemize per-node FLOPs/bytes and carry XLA's aggregate
+    cost analysis of the compiled program."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="conv0")
+    net = mx.sym.Activation(data=net, act_type="relu", name="relu0")
+    net = mx.sym.FullyConnected(data=net, num_hidden=10, name="fc0")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(4, 3, 16, 16),
+                          softmax_label=(4,))
+
+    p = profiler.plan(exe)
+    assert p.mode == "train_step"
+    by_name = {n.name: n for n in p.nodes}
+    conv = by_name["conv0"]
+    # 2 * out_elems * Cin * k*k = 2 * (4*8*16*16) * 3 * 9
+    assert conv.flops == 2 * 4 * 8 * 16 * 16 * 3 * 9
+    assert conv.out_shapes == [(4, 8, 16, 16)]
+    fc = by_name["fc0"]
+    assert fc.flops == 2 * 4 * 10 * (8 * 16 * 16)
+    assert p.total_flops == sum(n.flops for n in p.nodes)
+    # table sorted by decreasing flops and percentages sum to ~100
+    rows = p.table()
+    assert rows[0]["flops"] >= rows[-1]["flops"]
+    assert abs(sum(r["flops_pct"] for r in rows) - 100.0) < 1e-6
+    # XLA analysis present on the CPU backend, and counts the backward too
+    assert p.xla.get("flops", 0) > p.total_flops
+    assert "module" in p.hlo
+
+    s = exe.debug_str()
+    assert "conv0" in s and "GFLOPs" in s and "analytic totals" in s
+
+    # eval mode compiles the inference program
+    p_eval = profiler.plan(exe, mode="eval")
+    assert p_eval.mode == "eval"
+    assert p_eval.xla.get("flops", 0) < p.xla.get("flops", float("inf"))
