@@ -332,11 +332,21 @@ class Deconvolution(OpDef):
 register(Deconvolution)
 
 
-def _pool_out_hw(d, k, s, p, name="Pooling"):
-    """The reference's clamped ceil-mode pooled size (`pooling-inl.h:191-197`),
-    shared by Pooling and Unpooling so the contract can't desynchronize."""
-    oh = min(d[2] + 2 * p[0] - k[0] + s[0] - 1, d[2] + 2 * p[0] - 1) // s[0] + 1
-    ow = min(d[3] + 2 * p[1] - k[1] + s[1] - 1, d[3] + 2 * p[1] - 1) // s[1] + 1
+def _pool_out_hw(d, k, s, p, name="Pooling", convention="full"):
+    """Pooled output size, shared by Pooling and Unpooling so the contract
+    can't desynchronize.  convention='full' is the reference's clamped
+    ceil mode (`pooling-inl.h:191-197`); 'valid' is floor mode (the
+    convention later MXNet exposes as `pooling_convention` and the one
+    standard ResNet geometry assumes — ceil mode turns 56x56 stages into
+    TPU-hostile 57x57)."""
+    if convention == "valid":
+        oh = (d[2] + 2 * p[0] - k[0]) // s[0] + 1
+        ow = (d[3] + 2 * p[1] - k[1]) // s[1] + 1
+    else:
+        oh = min(d[2] + 2 * p[0] - k[0] + s[0] - 1,
+                 d[2] + 2 * p[0] - 1) // s[0] + 1
+        ow = min(d[3] + 2 * p[1] - k[1] + s[1] - 1,
+                 d[3] + 2 * p[1] - 1) // s[1] + 1
     if oh <= 0 or ow <= 0:
         raise MXNetError("%s: kernel size exceeds input" % name)
     return oh, ow
@@ -361,6 +371,8 @@ class Pooling(OpDef):
         "stride": Param("shape", default=(1, 1)),
         "pad": Param("shape", default=(0, 0)),
         "global_pool": Param(bool, default=False),
+        # 'full' = reference ceil mode; 'valid' = floor (later-MXNet param)
+        "pooling_convention": Param(str, default="full"),
     }
 
     def _out_hw(self, params, d):
@@ -369,7 +381,12 @@ class Pooling(OpDef):
         p = _pair(params["pad"], "pad")
         if params["global_pool"]:
             return (1, 1), (d[2], d[3]), (1, 1), (0, 0)
-        return _pool_out_hw(d, k, s, p), k, s, p
+        conv = params.get("pooling_convention") or "full"
+        if conv not in ("full", "valid"):
+            raise MXNetError(
+                "Pooling: pooling_convention must be 'full' or 'valid', "
+                "got %r" % (conv,))
+        return _pool_out_hw(d, k, s, p, convention=conv), k, s, p
 
     def infer_shape(self, params, in_shapes):
         d = in_shapes[0]

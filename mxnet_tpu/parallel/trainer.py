@@ -23,8 +23,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import os
+
 from ..base import MXNetError
-from ..executor import _build_graph_fn
+from ..executor import _build_graph_fn, _mirror_saveable
 from ..ndarray import NDArray
 from .. import random as _random
 
@@ -154,6 +156,13 @@ class SPMDTrainer:
                     jnp.ones_like(self.aux[n]), repl)
 
         graph_fn, _, _ = _build_graph_fn(symbol)
+        # MXNET_BACKWARD_DO_MIRROR (the reference's recompute-cheap-ops
+        # plan, `static_graph.cc:410-560`): save only MXU-heavy primitive
+        # results across fwd->bwd; rematerialize BN/relu/elementwise instead
+        # of storing AND re-reading them — trades free VPU flops for HBM
+        # traffic, the scarce resource on TPU
+        self._do_mirror = os.environ.get(
+            "MXNET_BACKWARD_DO_MIRROR", "0").lower() in ("1", "true", "yes")
         batch_sharding = NamedSharding(mesh, P("data"))
         self._batch_sharding = batch_sharding
         # stacked (nsteps, batch, ...) inputs for run_steps: steps axis
@@ -196,6 +205,8 @@ class SPMDTrainer:
                 outs, new_aux = graph_fn(args, aux_list, rng, True)
                 return outs, new_aux
 
+            if self._do_mirror:
+                f = jax.checkpoint(f, policy=_mirror_saveable)
             outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
             cot = tuple(jnp.ones_like(o) for o in outs)
             (grads,) = vjp(cot)
@@ -230,6 +241,8 @@ class SPMDTrainer:
                     outs, new_aux = graph_fn(args, aux_list, rng_i, True)
                     return outs, new_aux
 
+                if self._do_mirror:
+                    f = jax.checkpoint(f, policy=_mirror_saveable)
                 outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
                 cot = tuple(jnp.ones_like(o) for o in outs)
                 (grads,) = vjp(cot)
@@ -239,7 +252,8 @@ class SPMDTrainer:
                 return (new_params, new_momenta, aux_out), ()
 
             (params, momenta, aux), _ = jax.lax.scan(
-                body, (params, momenta, aux), jnp.arange(nsteps))
+                body, (params, momenta, aux), jnp.arange(nsteps),
+                unroll=2)
             return params, momenta, aux
 
         self._multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2),

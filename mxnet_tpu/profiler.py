@@ -309,3 +309,327 @@ def plan(executor, mode="auto"):
         _prod(a.shape) * np.dtype(a.dtype).itemsize
         for a in executor.arg_arrays)
     return ExecutionPlan(nodes, xla, hlo, mode, param_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Optimized-HLO breakdown: per-instruction HBM bytes / FLOPs of the program
+# XLA actually runs (post-fusion, post-layout).  This sees what the
+# symbol-level plan cannot: materialized transposes/copies from layout
+# assignment, fusion failures, f32 upcasts.  Feed it
+# `jax.jit(f).lower(...).compile().as_text()`.
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+                "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+
+def _shapes_in(text):
+    """All array shapes mentioned in one HLO line -> [(dtype, dims)]."""
+    import re
+
+    out = []
+    for m in re.finditer(r"\b(pred|[sufc]\d+|bf16)\[([\d,]*)\]", text):
+        dims = [int(d) for d in m.group(2).split(",") if d] or [1]
+        out.append((m.group(1), dims))
+    return out
+
+
+def _line_bytes(shapes):
+    return sum(_DTYPE_BYTES.get(dt, 4) * _prod(dims) for dt, dims in shapes)
+
+
+def _parse_window(line):
+    """Parse `window={size=AxB stride=... pad=lo_hi x lo_hi lhs_dilate=...}`
+    into per-dim dicts."""
+    import re
+
+    m = re.search(r"window=\{([^}]*)\}", line)
+    if not m:
+        return None
+    fields = {}
+    for kv in re.finditer(r"(\w+)=(-?[\w._\-]+(?:x-?[\w._\-]+)*)", m.group(1)):
+        fields[kv.group(1)] = kv.group(2).split("x")
+    if "size" not in fields:
+        return None
+    ndim = len(fields["size"])
+
+    def per_dim(key, default):
+        vals = fields.get(key)
+        if not vals:
+            return [default] * ndim
+        return vals
+
+    dims = []
+    for d in range(ndim):
+        pad = per_dim("pad", "0_0")[d]
+        lo, _, hi = pad.partition("_")
+        dims.append({
+            "size": int(per_dim("size", "1")[d]),
+            "stride": int(per_dim("stride", "1")[d]),
+            "pad_lo": int(lo or 0),
+            "lhs_dilate": int(per_dim("lhs_dilate", "1")[d]),
+            "rhs_dilate": int(per_dim("rhs_dilate", "1")[d]),
+        })
+    return dims
+
+
+def _conv_flops(line, out_dims, lhs_dims, rhs_dims):
+    """Exact 2*MAC count for one HLO convolution, padding/dilation-aware.
+
+    MACs = batch * out_features * in_features * prod_d(valid (out,k) index
+    pairs in spatial dim d).  The naive out*prod(rhs) formula wildly
+    overcounts gradient convs, whose windows are mostly padding."""
+    import re
+
+    if out_dims is None or rhs_dims is None or lhs_dims is None:
+        return 0
+    m = re.search(r"dim_labels=(\w+)_(\w+)->(\w+)", line)
+    win = _parse_window(line)
+    if not m or win is None:
+        return 0
+    lhs_l, rhs_l, out_l = m.groups()
+    try:
+        batch = out_dims[out_l.index("b")]
+        o_feat = rhs_dims[rhs_l.index("o")]
+        i_feat = rhs_dims[rhs_l.index("i")]
+        out_sp = [out_dims[out_l.index(c)] for c in "0123456"[:len(win)]]
+        lhs_sp = [lhs_dims[lhs_l.index(c)] for c in "0123456"[:len(win)]]
+    except (ValueError, IndexError):
+        return 0
+    pairs = 1
+    for d, w in enumerate(win):
+        n, out_n = lhs_sp[d], out_sp[d]
+        ld, rd = w["lhs_dilate"], w["rhs_dilate"]
+        logical_n = (n - 1) * ld + 1 if n > 0 else 0
+        cnt = 0
+        for k in range(w["size"]):
+            # input index for output position o: o*stride + k*rd - pad_lo;
+            # valid if in [0, logical_n) and on the lhs_dilation grid
+            base = k * rd - w["pad_lo"]
+            # o in [0, out_n): idx = o*stride + base
+            lo = max(0, -(base // w["stride"]) if base < 0 else 0)
+            for o in range(out_n):
+                idx = o * w["stride"] + base
+                if 0 <= idx < logical_n and idx % ld == 0:
+                    cnt += 1
+        pairs *= cnt
+    return 2 * batch * o_feat * i_feat * pairs
+
+
+def _dot_flops(line, out_dims, lhs_dims):
+    import re
+
+    if out_dims is None or lhs_dims is None:
+        return 0
+    m = re.search(r"lhs_contracting_dims=\{([\d,]*)\}", line)
+    if not m:
+        return 0
+    contract = 1
+    for d in m.group(1).split(","):
+        if d and int(d) < len(lhs_dims):
+            contract *= lhs_dims[int(d)]
+    return 2 * _prod(out_dims) * contract
+
+
+_HLO_OPCODES = frozenset("""
+abs add after-all all-gather all-gather-done all-gather-start all-reduce
+all-reduce-done all-reduce-start all-to-all and async-done async-start
+async-update atan2 batch-norm-grad batch-norm-inference batch-norm-training
+bitcast bitcast-convert broadcast call ceil cholesky clamp clz
+collective-broadcast collective-permute collective-permute-done
+collective-permute-start compare complex concatenate conditional constant
+convert convolution copy copy-done copy-start cosine custom-call divide
+domain dot dynamic-reshape dynamic-slice dynamic-update-slice erf exponential
+exponential-minus-one fft floor fusion gather get-dimension-size
+get-tuple-element imag infeed iota is-finite log log-plus-one logistic map
+maximum minimum multiply negate not optimization-barrier or outfeed pad
+parameter partition-id popcnt power real recv recv-done reduce
+reduce-precision reduce-scatter reduce-window remainder replica-id reshape
+reverse rng rng-bit-generator rng-get-and-update-state round-nearest-afz
+round-nearest-even rsqrt scatter select select-and-scatter send send-done
+set-dimension-size shift-left shift-right-arithmetic shift-right-logical
+sign sine slice sort sqrt stochastic-convert subtract tan tanh topk
+transpose triangular-solve tuple while xor
+""".split())
+
+_opcode_candidate_re = None
+
+
+def _parse_instruction(line):
+    """(name, opcode, type_segment, rest) for one HLO instruction line, or
+    None.  Robust to layout syntax containing parentheses — the opcode is
+    located as the first known-opcode word followed by '(' after the '='."""
+    import re
+
+    global _opcode_candidate_re
+    if _opcode_candidate_re is None:
+        _opcode_candidate_re = re.compile(
+            r"(?<![\w.%\-])([a-z][a-z0-9\-]*)\(")
+    eq = line.find("= ")
+    if eq < 0 or "%" not in line[:eq]:
+        return None
+    mname = re.match(r"\s*(?:ROOT\s+)?%([\w.\-]+)", line)
+    if not mname:
+        return None
+    for m in _opcode_candidate_re.finditer(line, eq):
+        if m.group(1) in _HLO_OPCODES:
+            return (mname.group(1), m.group(1), line[eq + 1:m.start()],
+                    line[m.end():])
+    return None
+
+
+_param_decl_re = None
+
+
+def hlo_breakdown(hlo_text, top=30):
+    """Parse optimized HLO into {rows, by_op, by_src, total_bytes,
+    total_flops}.
+
+    Two passes.  Pass 1 splits the module into computations and builds a
+    symbol table name -> result shapes (operands print without shapes in
+    scheduled HLO, so consumers resolve through it; computation-header
+    parameter declarations seed it for fusion bodies), then sums conv/dot
+    FLOPs per computation with operand shapes resolved.  Pass 2 walks
+    instructions of the directly-executed computations (entry, while
+    bodies, regions — everything NOT named `fused_*`, whose internals are
+    VMEM-resident) and charges HBM traffic per instruction: output bytes
+    written + operand bytes read.  `*-start`/`*-done` async pairs are
+    charged once (reads at start, writes at done).  Fusion calls inherit
+    the called computation's conv/dot FLOPs.
+
+    rows: top-N instructions by bytes.  by_op: per-opcode aggregate.
+    by_src: per-source-op aggregate from `metadata op_name` (which model-
+    level op the traffic belongs to — conv backward, BatchNorm, optimizer).
+    """
+    import re
+
+    global _param_decl_re
+    comp_re = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\([^=]*\)\s*->.*{$")
+    meta_re = re.compile(r'op_name="([^"]*)"')
+    calls_re = re.compile(r"calls=%?([\w.\-]+)")
+    if _param_decl_re is None:
+        _param_decl_re = re.compile(
+            r"([\w.\-]+):\s*((?:pred|[sufc]\d+|bf16)\[[\d,]*\])")
+
+    # -- pass 1a: computations + symbol table ------------------------------
+    comps = {}        # name -> [(name, opcode, type_seg, rest, line)]
+    shapes_of = {}    # instruction/param name -> [(dtype, dims), ...]
+    cur = None
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        mc = comp_re.match(s)
+        if mc:
+            cur = mc.group(1)
+            comps[cur] = []
+            # header parameter declarations carry shapes
+            for pm in _param_decl_re.finditer(s):
+                shapes_of[pm.group(1)] = _shapes_in(pm.group(2))
+            continue
+        if s.startswith("}"):
+            cur = None
+            continue
+        if cur is not None and "=" in s:
+            parsed = _parse_instruction(s)
+            if parsed:
+                comps[cur].append(parsed)
+                shapes_of[parsed[0]] = _shapes_in(parsed[2])
+
+    def result_bytes(name):
+        return _line_bytes(shapes_of.get(name, ()))
+
+    def operand_names(rest):
+        return [m.group(1) for m in re.finditer(r"%([\w.\-]+)", rest)
+                if m.group(1) not in comps]
+
+    def first_shape(name):
+        sh = shapes_of.get(name)
+        return sh[0][1] if sh else None
+
+    def inst_flops(opcode, type_seg, rest):
+        if opcode not in ("convolution", "dot"):
+            return 0
+        out_sh = _shapes_in(type_seg)
+        out_dims = out_sh[0][1] if out_sh else None
+        ops = operand_names(rest)
+        if opcode == "convolution":
+            lhs = first_shape(ops[0]) if ops else None
+            rhs = first_shape(ops[1]) if len(ops) > 1 else None
+            return _conv_flops(rest, out_dims, lhs, rhs)
+        lhs = first_shape(ops[0]) if ops else None
+        return _dot_flops(rest, out_dims, lhs)
+
+    # -- pass 1b: per-computation conv/dot flops ---------------------------
+    comp_flops = {}
+    for cname, instrs in comps.items():
+        comp_flops[cname] = sum(inst_flops(op, tseg, rest)
+                                for _, op, tseg, rest in instrs)
+
+    # -- pass 2: charge traffic in directly-executed computations ----------
+    NO_TRAFFIC = ("parameter", "constant", "get-tuple-element", "tuple",
+                  "bitcast", "after-all", "partition-id", "replica-id")
+    rows, by_op, by_src = [], {}, {}
+    for cname, instrs in comps.items():
+        if "fused" in cname:
+            continue
+        for name, opcode, type_seg, rest in instrs:
+            if opcode in NO_TRAFFIC:
+                continue
+            out_b = result_bytes(name)
+            in_b = sum(result_bytes(o) for o in operand_names(rest))
+            if opcode.endswith("-done"):
+                b = out_b          # reads were charged at the -start
+            elif opcode.endswith("-start"):
+                b = in_b
+            else:
+                b = out_b + in_b
+            if opcode == "fusion":
+                mcall = calls_re.search(rest)
+                f = comp_flops.get(mcall.group(1), 0) if mcall else 0
+            else:
+                f = inst_flops(opcode, type_seg, rest)
+            line_txt = "%s = %s %s(%s" % (name, type_seg.strip(), opcode,
+                                          rest[:120])
+            rows.append({"name": name, "op": opcode, "bytes": b, "flops": f,
+                         "line": line_txt[:200]})
+            agg = by_op.setdefault(opcode,
+                                   {"bytes": 0, "flops": 0, "count": 0})
+            agg["bytes"] += b
+            agg["flops"] += f
+            agg["count"] += 1
+            mm = meta_re.search(rest)
+            src = mm.group(1).split("/")[-1] if mm else "(no metadata)"
+            sagg = by_src.setdefault(src,
+                                     {"bytes": 0, "flops": 0, "count": 0})
+            sagg["bytes"] += b
+            sagg["flops"] += f
+            sagg["count"] += 1
+    rows.sort(key=lambda r: -r["bytes"])
+    return {
+        "rows": rows[:top] if top else rows,
+        "by_op": by_op,
+        "by_src": by_src,
+        "total_bytes": sum(a["bytes"] for a in by_op.values()),
+        "total_flops": sum(a["flops"] for a in by_op.values()),
+    }
+
+
+def format_breakdown(bd, peak_flops=None, peak_gbps=None):
+    """Human report for `hlo_breakdown` output."""
+    lines = ["%-22s %8s %12s %12s" % ("opcode", "count", "GB", "GFLOPs")]
+    for op, a in sorted(bd["by_op"].items(), key=lambda kv: -kv[1]["bytes"]):
+        lines.append("%-22s %8d %12.3f %12.1f"
+                     % (op, a["count"], a["bytes"] / 1e9, a["flops"] / 1e9))
+    lines.append("total: %.3f GB moved, %.1f GFLOPs"
+                 % (bd["total_bytes"] / 1e9, bd["total_flops"] / 1e9))
+    if peak_flops and peak_gbps:
+        t_comp = bd["total_flops"] / peak_flops
+        t_mem = bd["total_bytes"] / (peak_gbps * 1e9)
+        lines.append("roofline: compute %.2f ms vs memory %.2f ms -> %s-bound"
+                     % (1e3 * t_comp, 1e3 * t_mem,
+                        "compute" if t_comp > t_mem else "memory"))
+    lines.append("top instructions by bytes:")
+    for r in bd["rows"][:15]:
+        lines.append("  %10.1f MB %-14s %s"
+                     % (r["bytes"] / 1e6, r["op"], r["line"][:110]))
+    return "\n".join(lines)

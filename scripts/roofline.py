@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Itemized MFU roofline for the bench.py workload (VERDICT round-1 item 2).
+
+Builds the exact SPMDTrainer ResNet-50 train step bench.py times, compiles
+it for the attached backend, and prints:
+  * XLA aggregate cost/memory analysis,
+  * the per-opcode / per-instruction HBM-bytes + FLOPs breakdown of the
+    OPTIMIZED HLO (profiler.hlo_breakdown), which exposes layout copies,
+    fusion failures and dtype upcasts the symbol-level plan cannot see,
+  * a roofline verdict against the chip's peak FLOPs/bandwidth.
+
+Env: BENCH_BATCH/BENCH_IMAGE/BENCH_DTYPE like bench.py; ROOFLINE_PEAK_FLOPS
+(default v5e bf16 197e12), ROOFLINE_PEAK_GBPS (default v5e 819 GB/s).
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models, profiler
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "bfloat16"))
+    if dtype.kind == "V" or str(dtype) == "bfloat16":
+        from mxnet_tpu.base import bfloat16 as dtype
+
+    peak_flops = float(os.environ.get("ROOFLINE_PEAK_FLOPS", "197e12"))
+    peak_gbps = float(os.environ.get("ROOFLINE_PEAK_GBPS", "819"))
+
+    net = models.get_resnet(
+        num_classes=1000, num_layers=50,
+        pooling_convention=os.environ.get("BENCH_POOLCONV", "valid"))
+    n_avail = len(jax.devices())
+    n_dev = next(k for k in range(n_avail, 0, -1) if batch % k == 0)
+    mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes={"data": (batch, 3, image, image),
+                     "softmax_label": (batch,)},
+        lr=0.1, momentum=0.9, wd=1e-4, dtype=dtype)
+    rng = np.random.RandomState(0)
+    batch_np = {
+        "data": rng.randn(batch, 3, image, image).astype(np.float32).astype(dtype),
+        "softmax_label": rng.randint(0, 1000, size=(batch,)).astype(np.float32),
+    }
+    dev_batch = trainer.shard_batch(batch_np)
+    key = jax.random.PRNGKey(0)
+
+    lowered = trainer._step.lower(
+        trainer.params, trainer.momenta, trainer.aux, dev_batch, key,
+        jnp.float32(0.1))
+    compiled = lowered.compile()
+
+    print("== XLA aggregate ==")
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        for k in sorted(cost):
+            if isinstance(cost[k], float) and cost[k] > 1e6:
+                print("  %-28s %.4g" % (k, cost[k]))
+    except Exception as e:
+        print("  cost_analysis unavailable:", e)
+    try:
+        mem = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes"):
+            print("  %-28s %.4g" % (k, float(getattr(mem, k))))
+    except Exception as e:
+        print("  memory_analysis unavailable:", e)
+
+    print("\n== optimized-HLO breakdown ==")
+    bd = profiler.hlo_breakdown(compiled.as_text(), top=40)
+    print(profiler.format_breakdown(bd, peak_flops=peak_flops,
+                                    peak_gbps=peak_gbps))
+
+    model_flops = 3 * 2 * 4.089e9 * batch  # 2 FLOPs/MAC
+    print("\nmodel flops/step (3x fwd): %.1f GF" % (model_flops / 1e9))
+    print("MFU if memory-bound: %.3f"
+          % (model_flops / max(bd["total_bytes"] / (peak_gbps * 1e9), 1e-9)
+             / peak_flops))
+
+
+if __name__ == "__main__":
+    main()

@@ -426,3 +426,28 @@ def test_public_test_utils_api():
         mx.test_utils.check_numeric_gradient(
             bad, {"data": rng.randn(2, 3).astype(np.float32) + 5.0},
             rtol=1e-9)
+
+
+def test_pooling_convention_valid_vs_full():
+    """pooling_convention='valid' (floor) vs default 'full' (the
+    reference's ceil rule, pooling-inl.h:191-197): 112 -> 56 vs 57."""
+    data = mx.sym.Variable("data")
+    for conv, expect in (("full", 57), ("valid", 56)):
+        p = mx.sym.Pooling(data=data, kernel=(3, 3), stride=(2, 2),
+                           pad=(1, 1), pool_type="max",
+                           pooling_convention=conv)
+        _, outs, _ = p.infer_shape(data=(2, 4, 112, 112))
+        assert outs[0] == (2, 4, expect, expect), (conv, outs)
+    # valid-mode values match floor-mode numpy pooling
+    x = np.random.RandomState(3).randn(1, 1, 5, 5).astype(np.float32)
+    p = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max", pooling_convention="valid")
+    exe = p.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    got = exe.forward()[0].asnumpy()
+    assert got.shape == (1, 1, 2, 2)
+    expect = x[:, :, :4, :4].reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_allclose(got, expect)
+    with pytest.raises(mx.base.MXNetError):
+        mx.sym.Pooling(data=data, kernel=(2, 2),
+                       pooling_convention="bogus").infer_shape(
+            data=(1, 1, 8, 8))
