@@ -96,13 +96,57 @@ def main():
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12")) * n_dev  # v5e bf16
     mfu = flops_step / dt / peak
 
-    print(json.dumps({
+    # input-pipeline companion metric (BASELINE.md row 2: ~3,000 img/s
+    # RecordIO read+decode on a 2015 multi-core box ≈ 375 img/s/core):
+    # host-side JPEG read+decode img/s on this host's cores.  Full pipeline
+    # benchmark incl. augment/native loader/overlap: tools/benchmark_io.py.
+    io_ips = None
+    try:
+        io_ips = _io_pipeline_ips()
+    except Exception:
+        pass
+
+    result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips_chip, 2),
         "unit": "images/sec/chip (mfu=%.3f, batch=%d, dtype=%s)"
                 % (mfu, batch, np.dtype(dtype).name),
         "vs_baseline": round(ips_chip / 42.5, 2),
-    }))
+    }
+    if io_ips is not None:
+        result["extra"] = {
+            "recordio_jpeg_host_decode_img_per_sec": round(io_ips, 1),
+            "io_cores": os.cpu_count() or 1,
+        }
+    print(json.dumps(result))
+
+
+def _io_pipeline_ips(n=384):
+    """RecordIO read + JPEG decode throughput on this host (img/s)."""
+    import tempfile
+
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(0)
+    path = os.path.join(tempfile.mkdtemp(prefix="benchio"), "io.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (256, 256, 3), np.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 10), i, 0),
+                                  img, quality=90, img_fmt=".jpg"))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    t0 = time.time()
+    got = 0
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        recordio.unpack_img(rec, iscolor=1)
+        got += 1
+    r.close()
+    os.remove(path)
+    return got / (time.time() - t0)
 
 
 if __name__ == "__main__":

@@ -527,31 +527,49 @@ class ImageRecordIter(DataIter):
                 provide_label=self.provide_label,
             )
         # ---- pure-python fallback ----
-        data = np.zeros((self.batch_size,) + self._record_shape, np.float32)
-        label = np.zeros((self.batch_size,), np.float32)
-        n = 0
-        while n < self.batch_size:
+        # Host does the minimum (JPEG/PNG decode to uint8 HWC); float
+        # conversion, NCHW layout and augmentation run ON DEVICE in
+        # `_finish` — per-record numpy astype/transpose was half the cost
+        # of the decode loop, and staging uint8 moves 4x fewer bytes over
+        # the host->device link than f32.
+        rs = self._record_shape
+        rows, labels = [], []
+        fast_u8 = True
+        while len(rows) < self.batch_size:
             buf = self._read_record()
             if buf is None:
                 break
-            rs = self._record_shape
             # force the channel count at decode (grayscale JPEGs in a color
             # dataset and vice versa, like the reference's cv2 iscolor)
             iscolor = 1 if rs[0] == 3 else (0 if rs[0] == 1 else -1)
             header, img = self._recordio_mod.unpack_img(buf, iscolor=iscolor)
-            img = np.asarray(img, np.float32)
-            if (img.ndim == 3 and img.shape != rs
-                    and img.shape == (rs[1], rs[2], rs[0])):
-                img = img.transpose(2, 0, 1)  # decoded HWC -> NCHW layout
-            elif img.ndim == 2 and rs[0] == 1 and img.shape == rs[1:]:
-                img = img[None]  # grayscale HW -> 1HW
-            data[n] = img.reshape(rs)
-            label[n] = header.label
-            n += 1
+            img = np.asarray(img)
+            if img.ndim == 2 and rs[0] == 1:
+                img = img[:, :, None]  # grayscale HW -> HW1
+            if img.dtype != np.uint8 or img.shape != (rs[1], rs[2], rs[0]):
+                fast_u8 = False  # .npy float/CHW payload
+            rows.append(img)
+            labels.append(header.label)
+        n = len(rows)
         if n == 0:
             raise StopIteration
+        label = np.zeros((self.batch_size,), np.float32)
+        label[:n] = labels
+        if fast_u8:
+            data = np.zeros((self.batch_size, rs[1], rs[2], rs[0]), np.uint8)
+            for i, img in enumerate(rows):
+                data[i] = img
+            out = self._finish_hwc_u8(data)
+        else:
+            data = np.zeros((self.batch_size,) + rs, np.float32)
+            for i, img in enumerate(rows):
+                img = np.asarray(img, np.float32)
+                if img.shape == (rs[1], rs[2], rs[0]) and img.shape != rs:
+                    img = img.transpose(2, 0, 1)  # HWC -> CHW
+                data[i] = img.reshape(rs)
+            out = self._finish(data)
         return DataBatch(
-            data=[self._finish(data)], label=[array(label)],
+            data=[out], label=[array(label)],
             pad=self.batch_size - n,
             provide_data=self.provide_data,
             provide_label=self.provide_label,
@@ -579,6 +597,22 @@ class ImageRecordIter(DataIter):
         if self._augmenter is None:
             return array(data.copy() if data is not None else data)
         return NDArray(self._augmenter(data))
+
+    def _finish_hwc_u8(self, data_u8):
+        """Device-side tail of the fast decode path: stage the uint8 HWC
+        batch (4x smaller transfer than f32), then transpose to NCHW and
+        convert to float on device before the augmenter."""
+        if not hasattr(self, "_hwc_jit"):
+            import jax
+            import jax.numpy as jnp
+
+            self._hwc_jit = jax.jit(
+                lambda u8: jnp.transpose(u8, (0, 3, 1, 2)).astype(
+                    jnp.float32))
+        x = self._hwc_jit(data_u8)
+        if self._augmenter is None:
+            return NDArray(x)
+        return NDArray(self._augmenter(x))
 
     def close(self):
         if self._native and self._handle:

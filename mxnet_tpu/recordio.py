@@ -57,9 +57,12 @@ def unpack_img(s, iscolor=-1):
     from PIL import Image
 
     img = Image.open(_io.BytesIO(s))
-    if iscolor == 0:
+    # convert() copies even when the mode already matches — skip the no-op
+    # (a full extra image copy per record on the hot decode path)
+    if iscolor == 0 and img.mode != "L":
         img = img.convert("L")
-    elif iscolor == 1 or (iscolor == -1 and img.mode != "L"):
+    elif (iscolor == 1 or (iscolor == -1 and img.mode != "L")) \
+            and img.mode != "RGB":
         img = img.convert("RGB")
     return header, np.asarray(img)
 

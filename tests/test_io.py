@@ -239,3 +239,30 @@ def test_image_record_iter_grayscale_in_color_dataset(tmp_path):
     assert b.data[0].shape == (2, 3, 8, 8)
     arr = b.data[0].asnumpy()[1]
     np.testing.assert_allclose(arr[0], arr[1])  # gray replicated to RGB
+
+
+def test_image_record_iter_u8_fast_path_matches_decode():
+    """The uint8-HWC fast path (device-side transpose/float) must produce
+    exactly the decoded pixel values as float32 NCHW."""
+    import tempfile
+
+    from mxnet_tpu import recordio
+
+    path = os.path.join(tempfile.mkdtemp(), "u8.rec")
+    rng = np.random.RandomState(7)
+    imgs = []
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        img = rng.randint(0, 255, (8, 8, 3), np.uint8)
+        # PNG is lossless: decoded values equal packed values
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                  img, img_fmt=".png"))
+        imgs.append(img)
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=5, use_native=False)
+    b = next(it)
+    got = b.data[0].asnumpy()
+    expect = np.stack(imgs).transpose(0, 3, 1, 2).astype(np.float32)
+    np.testing.assert_array_equal(got, expect)
+    assert b.label[0].asnumpy().tolist() == [0.0, 1.0, 2.0, 3.0, 4.0]
