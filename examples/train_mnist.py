@@ -22,13 +22,16 @@ from mxnet_tpu import models  # noqa: E402
 from mxnet_tpu.io import MNISTIter, NDArrayIter  # noqa: E402
 
 
-def get_iters(args, flat):
+def get_iters(args, flat, rank=0, num_workers=1):
     dd = args.data_dir
     img = os.path.join(dd, "train-images-idx3-ubyte")
     if dd and os.path.exists(img):
+        # distributed: each worker reads its shard, like the reference's
+        # train_model.py part_index/num_parts wiring
         train = MNISTIter(image=img,
                           label=os.path.join(dd, "train-labels-idx1-ubyte"),
-                          batch_size=args.batch_size, flat=flat, shuffle=True)
+                          batch_size=args.batch_size, flat=flat, shuffle=True,
+                          part_index=rank, num_parts=num_workers)
         val = MNISTIter(image=os.path.join(dd, "t10k-images-idx3-ubyte"),
                         label=os.path.join(dd, "t10k-labels-idx1-ubyte"),
                         batch_size=args.batch_size, flat=flat, shuffle=False)
@@ -65,7 +68,11 @@ def main():
 
     flat = args.network == "mlp"
     net = models.get_mlp() if flat else models.get_lenet()
-    train, val = get_iters(args, flat)
+    kv_early = mx.kv.create(args.kv_store) if "dist" in args.kv_store else None
+    train, val = get_iters(
+        args, flat,
+        rank=kv_early.rank if kv_early else 0,
+        num_workers=kv_early.num_workers if kv_early else 1)
 
     if args.gpus:
         ndev = len(args.gpus.split(","))
@@ -78,7 +85,7 @@ def main():
         net, ctx=ctx, num_epoch=args.num_epochs,
         learning_rate=args.lr, momentum=0.9, wd=1e-5,
         initializer=mx.init.Xavier())
-    kv = mx.kv.create(args.kv_store)
+    kv = kv_early if kv_early is not None else mx.kv.create(args.kv_store)
     model.fit(X=train, eval_data=val, kvstore=kv,
               batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
               epoch_end_callback=(mx.callback.do_checkpoint(args.model_prefix)

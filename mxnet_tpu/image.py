@@ -1,17 +1,25 @@
 """On-device batch image augmentation + normalization.
 
-Reference: `src/io/image_augmenter.h` (random crop/resize/mirror/HSL jitter,
-applied per-image on OMP host threads) and `src/io/iter_normalize.h`
-(mean-image subtract with a cached mean.bin, scale).
+Reference: `src/io/image_augmenter.h` (affine rotation/shear/scale/aspect
+warp, random crop/resize, HSL color jitter — applied per-image on OMP host
+threads via OpenCV) and `src/io/iter_normalize.h` (mean-image subtract with
+a cached mean.bin, scale, mirror).
 
 TPU-first redesign: instead of per-image host loops, the whole batch is
-augmented in ONE jitted program on device — random crops become a batched
-dynamic-slice gather, mirrors a masked flip, color jitter a fused elementwise
-pass.  The host input pipeline stays a pure byte mover; augmentation rides
-the accelerator where it overlaps with the training step under XLA's async
-dispatch.  Rotation-by-arbitrary-angle (rare in the reference's configs) is
-intentionally not ported: it gathers poorly on TPU; do 90-degree `rot90`s
-host-side if needed.
+augmented in ONE jitted program on device — the affine family
+(max_rotate_angle/rotate/max_shear_ratio/max_random_scale/max_aspect_ratio,
+`image_augmenter.h:196-228`) becomes a batched inverse-affine bilinear
+resample, random crops a batched dynamic-slice gather, mirrors a masked
+flip, HSL jitter (`image_augmenter.h:288-307`) a vectorized
+RGB->HLS->RGB elementwise pass with OpenCV's value ranges (H in [0,180],
+L/S in [0,255], additive jitter CLAMPED like the reference's loop), and
+contrast/illumination a fused elementwise pass.  Static-shape deviations
+from the reference, by design (XLA needs fixed shapes): the affine warp
+renders into a canvas of the input size (the scale factor lives in the
+transform; min/max_img_size clamp the scale) instead of a per-image
+variable-size canvas, and min/max_crop_size+resize is folded into the same
+single bilinear resample instead of crop-then-resize (one resample, same
+pixel provenance).  inter_method is accepted; bilinear is used.
 """
 from __future__ import annotations
 
@@ -26,6 +34,74 @@ import jax.numpy as jnp
 from .base import MXNetError
 
 
+def _rgb_to_hls(r, g, b):
+    """RGB [0,255] -> OpenCV-range HLS: H in [0,180], L/S in [0,255]."""
+    r, g, b = r / 255.0, g / 255.0, b / 255.0
+    vmax = jnp.maximum(jnp.maximum(r, g), b)
+    vmin = jnp.minimum(jnp.minimum(r, g), b)
+    l = (vmax + vmin) / 2.0
+    d = vmax - vmin
+    safe_d = jnp.where(d > 0, d, 1.0)
+    s = jnp.where(
+        d > 0,
+        jnp.where(l < 0.5, d / jnp.maximum(vmax + vmin, 1e-12),
+                  d / jnp.maximum(2.0 - vmax - vmin, 1e-12)),
+        0.0)
+    hr = ((g - b) / safe_d) % 6.0
+    hg = (b - r) / safe_d + 2.0
+    hb = (r - g) / safe_d + 4.0
+    h = jnp.where(vmax == r, hr, jnp.where(vmax == g, hg, hb))
+    h = jnp.where(d > 0, h * 30.0, 0.0)  # 60 deg -> 30 OpenCV half-units
+    return h, l * 255.0, s * 255.0
+
+
+def _hls_to_rgb(h, l, s):
+    """Inverse of _rgb_to_hls (OpenCV ranges in, RGB [0,255] out)."""
+    h = h / 30.0  # back to [0,6)
+    l = l / 255.0
+    s = s / 255.0
+    c = (1.0 - jnp.abs(2.0 * l - 1.0)) * s
+    x = c * (1.0 - jnp.abs(h % 2.0 - 1.0))
+    m = l - c / 2.0
+
+    def sel(i, a, b, cc):
+        return jnp.where((h >= i) & (h < i + 1), a, cc)
+
+    r = jnp.zeros_like(h)
+    g = jnp.zeros_like(h)
+    b = jnp.zeros_like(h)
+    r = sel(0, c, x, r); g = sel(0, x, c, g)
+    r = sel(1, x, c, r); g = sel(1, c, x, g)
+    g = sel(2, c, x, g); b = sel(2, x, c, b)
+    g = sel(3, x, c, g); b = sel(3, c, x, b)
+    r = sel(4, x, c, r); b = sel(4, c, x, b)
+    r = jnp.where(h >= 5, c, r); b = jnp.where(h >= 5, x, b)
+    return (r + m) * 255.0, (g + m) * 255.0, (b + m) * 255.0
+
+
+def _bilinear_sample(img, ys, xs, fill):
+    """Sample one CHW image at float coords (ys, xs) [H',W'] with a
+    constant-fill border (cv::BORDER_CONSTANT)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yy = y0 + dy
+            xx = x0 + dx
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yi, xi]  # (c, H', W')
+            v = jnp.where(inb[None], v, fill)
+            wgt = ((wy if dy else 1 - wy) * (wx if dx else 1 - wx))[None]
+            out = out + wgt * v
+    return out
+
+
 class ImageAugmenter:
     """Batched augmentation pipeline over NCHW float batches.
 
@@ -37,13 +113,38 @@ class ImageAugmenter:
 
     def __init__(self, data_shape=None, rand_crop=False, rand_mirror=False,
                  max_random_contrast=0.0, max_random_illumination=0.0,
-                 mean_img=None, mean_rgb=None, scale=1.0, seed=0):
+                 mean_img=None, mean_rgb=None, scale=1.0, seed=0,
+                 max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+                 max_random_scale=1.0, min_random_scale=1.0,
+                 max_aspect_ratio=0.0, max_img_size=1e10, min_img_size=0.0,
+                 random_h=0, random_s=0, random_l=0, fill_value=255,
+                 crop_y_start=-1, crop_x_start=-1, max_crop_size=-1,
+                 min_crop_size=-1, inter_method=1):
         self.data_shape = tuple(data_shape) if data_shape else None
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.max_contrast = float(max_random_contrast)
         self.max_illum = float(max_random_illumination)
         self.scale = float(scale)
+        # affine family (image_augmenter.h:29-54); rotate >= 0 forces the
+        # angle like the reference's `rotate` param
+        self.max_rotate_angle = float(max_rotate_angle)
+        self.rotate = float(rotate)
+        self.max_shear_ratio = float(max_shear_ratio)
+        self.max_random_scale = float(max_random_scale)
+        self.min_random_scale = float(min_random_scale)
+        self.max_aspect_ratio = float(max_aspect_ratio)
+        self.max_img_size = float(max_img_size)
+        self.min_img_size = float(min_img_size)
+        self.random_h = float(random_h)
+        self.random_s = float(random_s)
+        self.random_l = float(random_l)
+        self.fill_value = float(fill_value)
+        self.crop_y_start = int(crop_y_start)
+        self.crop_x_start = int(crop_x_start)
+        self.max_crop_size = int(max_crop_size)
+        self.min_crop_size = int(min_crop_size)
+        self.inter_method = int(inter_method)  # accepted; bilinear used
         self._mean = None
         self._mean_path = None
         if mean_img is not None:
@@ -78,20 +179,148 @@ class ImageAugmenter:
         if path:
             np.save(path, self._mean)
 
+    @property
+    def _needs_affine(self):
+        """Same activation condition as `image_augmenter.h:173-177`."""
+        return (self.max_rotate_angle > 0 or self.max_shear_ratio > 0
+                or self.rotate >= 0 or self.max_random_scale != 1.0
+                or self.min_random_scale != 1.0
+                or self.max_aspect_ratio != 0.0
+                or self.max_img_size != 1e10 or self.min_img_size != 0.0)
+
+    def _affine_warp(self, x, key):
+        """Batched rotation/shear/scale/aspect warp, reference matrix math
+        (`image_augmenter.h:186-228`), rendered into a same-size canvas by
+        inverse-mapping bilinear sampling with fill_value borders."""
+        n, c, h, w = x.shape
+        ka, ks, kc, kr = jax.random.split(key, 4)
+        shear = jax.random.uniform(
+            ks, (n,), minval=-self.max_shear_ratio,
+            maxval=self.max_shear_ratio if self.max_shear_ratio else 1e-9)
+        if self.rotate >= 0:
+            angle = jnp.full((n,), self.rotate)
+        else:
+            angle = jax.random.uniform(
+                ka, (n,), minval=-self.max_rotate_angle,
+                maxval=self.max_rotate_angle or 1e-9)
+        scale = jax.random.uniform(
+            kc, (n,), minval=self.min_random_scale,
+            maxval=self.max_random_scale)
+        # min/max_img_size clamp the resulting image size; with a fixed
+        # canvas that is a clamp on the scale factor
+        maxdim = float(max(h, w))
+        scale = jnp.clip(scale, self.min_img_size / maxdim if
+                         self.min_img_size else 0.0,
+                         self.max_img_size / maxdim
+                         if self.max_img_size != 1e10 else jnp.inf)
+        ratio = 1.0 + jax.random.uniform(
+            kr, (n,), minval=-self.max_aspect_ratio,
+            maxval=self.max_aspect_ratio or 1e-9)
+        a = jnp.cos(angle * (np.pi / 180.0))
+        b = jnp.sin(angle * (np.pi / 180.0))
+        hs = 2.0 * scale / (1.0 + ratio)
+        ws = ratio * hs
+        # source->target matrix (image_augmenter.h:208-212)
+        m00 = hs * a - shear * b * ws
+        m01 = hs * b + shear * a * ws
+        m10 = -b * ws
+        m11 = a * ws
+        det = m00 * m11 - m01 * m10
+        det = jnp.where(jnp.abs(det) < 1e-8, 1e-8, det)
+        i00, i01 = m11 / det, -m01 / det
+        i10, i11 = -m10 / det, m00 / det
+        ys_t, xs_t = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                                  jnp.arange(w, dtype=jnp.float32),
+                                  indexing="ij")
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+
+        def warp_one(img, i00, i01, i10, i11):
+            dx = xs_t - cx
+            dy = ys_t - cy
+            sx = i00 * dx + i01 * dy + cx
+            sy = i10 * dx + i11 * dy + cy
+            return _bilinear_sample(img, sy, sx, self.fill_value)
+
+        return jax.vmap(warp_one)(x, i00, i01, i10, i11)
+
+    def _hsl_jitter(self, x, key):
+        """HSL color jitter (`image_augmenter.h:288-307`): OpenCV ranges,
+        additive per-image offsets, CLAMPED like the reference's loop.
+        Expects raw 0..255-scale RGB input."""
+        n = x.shape[0]
+        kh, ks, kl = jax.random.split(key, 3)
+        dh = jax.random.uniform(kh, (n, 1, 1),
+                                minval=-self.random_h,
+                                maxval=self.random_h or 1e-9)
+        ds = jax.random.uniform(ks, (n, 1, 1),
+                                minval=-self.random_s,
+                                maxval=self.random_s or 1e-9)
+        dl = jax.random.uniform(kl, (n, 1, 1),
+                                minval=-self.random_l,
+                                maxval=self.random_l or 1e-9)
+        r, g, b = x[:, 0], x[:, 1], x[:, 2]
+        h_, l_, s_ = _rgb_to_hls(r, g, b)
+        h_ = jnp.clip(h_ + dh, 0.0, 180.0)
+        l_ = jnp.clip(l_ + dl, 0.0, 255.0)
+        s_ = jnp.clip(s_ + ds, 0.0, 255.0)
+        r, g, b = _hls_to_rgb(h_, l_, s_)
+        return jnp.stack([r, g, b], axis=1)
+
+    def _crop_resize(self, x, key, out_hw):
+        """min/max_crop_size: random square crop then resize to data_shape
+        (`image_augmenter.h:233-253`), folded into one bilinear resample."""
+        n, c, h, w = x.shape
+        kh_, kw_ = out_hw
+        kcs, ky, kx = jax.random.split(key, 3)
+        lo = self.min_crop_size if self.min_crop_size > 0 \
+            else self.max_crop_size
+        cs = jax.random.randint(kcs, (n,), lo, self.max_crop_size + 1)
+        max_y = h - cs
+        max_x = w - cs
+        if self.rand_crop:
+            y0 = (jax.random.uniform(ky, (n,)) * (max_y + 1)).astype(
+                jnp.int32)
+            x0 = (jax.random.uniform(kx, (n,)) * (max_x + 1)).astype(
+                jnp.int32)
+        else:
+            y0 = max_y // 2
+            x0 = max_x // 2
+        iy = jnp.arange(kh_, dtype=jnp.float32)
+        ix = jnp.arange(kw_, dtype=jnp.float32)
+
+        def one(img, cs, y0, x0):
+            fy = cs.astype(jnp.float32) / kh_
+            fx = cs.astype(jnp.float32) / kw_
+            sy = y0 + (iy + 0.5) * fy - 0.5  # cv::resize coord mapping
+            sx = x0 + (ix + 0.5) * fx - 0.5
+            yy, xx = jnp.meshgrid(sy, sx, indexing="ij")
+            return _bilinear_sample(img, yy, xx, self.fill_value)
+
+        return jax.vmap(one)(x, cs, y0, x0)
+
     def _augment(self, batch, key, out_hw):
         """The jitted pipeline body: batch NCHW float32/compute dtype."""
         n, c, h, w = batch.shape
         kh, kw = out_hw
-        k1, k2, k3, k4 = jax.random.split(key, 4)
+        k1, k2, k3, k4, k5, k6, k7 = jax.random.split(key, 7)
         x = batch
+        if self._needs_affine:
+            x = self._affine_warp(x, k5)
+        if (self.random_h or self.random_s or self.random_l) and c == 3:
+            x = self._hsl_jitter(x, k6)
         if self._mean is not None:
             x = x - jnp.asarray(self._mean)
         elif self._mean_rgb is not None:
             x = x - jnp.asarray(self._mean_rgb)
-        # crop: random origin per image (train) or center (eval handled by
-        # caller passing rand=False fns)
-        if (h, w) != (kh, kw):
-            if self.rand_crop:
+        # crop: random-size crop+resize, else plain crop with random /
+        # explicit (crop_y_start) / centered origin
+        if self.max_crop_size > 0 or self.min_crop_size > 0:
+            x = self._crop_resize(x, k7, (kh, kw))
+        elif (h, w) != (kh, kw):
+            if self.crop_y_start >= 0 or self.crop_x_start >= 0:
+                oy = jnp.full((n,), max(self.crop_y_start, 0))
+                ox = jnp.full((n,), max(self.crop_x_start, 0))
+            elif self.rand_crop:
                 oy = jax.random.randint(k1, (n,), 0, h - kh + 1)
                 ox = jax.random.randint(k2, (n,), 0, w - kw + 1)
             else:

@@ -402,7 +402,15 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, mean_img=None,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, scale=1.0,
                  max_random_contrast=0.0, max_random_illumination=0.0,
-                 record_shape=None):
+                 record_shape=None,
+                 # full ImageAugmentParam set (image_augmenter.h:29-54),
+                 # handled by the on-device ImageAugmenter
+                 max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+                 max_random_scale=1.0, min_random_scale=1.0,
+                 max_aspect_ratio=0.0, max_img_size=1e10, min_img_size=0.0,
+                 random_h=0, random_s=0, random_l=0, fill_value=255,
+                 crop_y_start=-1, crop_x_start=-1, max_crop_size=-1,
+                 min_crop_size=-1, inter_method=1):
         super().__init__()
         from . import _native
         from . import recordio as _recordio
@@ -415,11 +423,30 @@ class ImageRecordIter(DataIter):
         self._record_shape = tuple(int(x) for x in check_shape(record_shape)) \
             if record_shape else self._data_shape
         self._augmenter = None
+        aug_extra = dict(
+            max_rotate_angle=max_rotate_angle, rotate=rotate,
+            max_shear_ratio=max_shear_ratio,
+            max_random_scale=max_random_scale,
+            min_random_scale=min_random_scale,
+            max_aspect_ratio=max_aspect_ratio, max_img_size=max_img_size,
+            min_img_size=min_img_size, random_h=random_h,
+            random_s=random_s, random_l=random_l, fill_value=fill_value,
+            crop_y_start=crop_y_start, crop_x_start=crop_x_start,
+            max_crop_size=max_crop_size, min_crop_size=min_crop_size,
+            inter_method=inter_method)
+        defaults = dict(
+            max_rotate_angle=0, rotate=-1, max_shear_ratio=0.0,
+            max_random_scale=1.0, min_random_scale=1.0,
+            max_aspect_ratio=0.0, max_img_size=1e10, min_img_size=0.0,
+            random_h=0, random_s=0, random_l=0, fill_value=255,
+            crop_y_start=-1, crop_x_start=-1, max_crop_size=-1,
+            min_crop_size=-1, inter_method=1)
         if (rand_crop or rand_mirror or mean_img is not None
                 or any((mean_r, mean_g, mean_b))
                 or scale != 1.0 or max_random_contrast
                 or max_random_illumination
-                or self._record_shape != self._data_shape):
+                or self._record_shape != self._data_shape
+                or any(aug_extra[k] != defaults[k] for k in defaults)):
             from .image import ImageAugmenter
 
             mean_rgb = [mean_r, mean_g, mean_b] \
@@ -429,7 +456,8 @@ class ImageRecordIter(DataIter):
                 rand_mirror=rand_mirror,
                 max_random_contrast=max_random_contrast,
                 max_random_illumination=max_random_illumination,
-                mean_img=mean_img, mean_rgb=mean_rgb, scale=scale)
+                mean_img=mean_img, mean_rgb=mean_rgb, scale=scale,
+                **aug_extra)
         self._sample_len = int(np.prod(self._record_shape))
         self._path = path_imgrec
         self._part_index = part_index

@@ -2,10 +2,45 @@
 # Nightly-style gate (reference `tests/nightly/test_all.sh`): the full test
 # suite — including the slow multi-process distributed oracles and the
 # accuracy-gated training runs in tests/test_train.py, tests/test_dist.py
-# and tests/test_examples.py — plus a CPU-mesh bench smoke.
+# and tests/test_examples.py — plus REAL-DATA convergence gates on
+# generated idx-format digit images (`tools/make_mnist.py`; this
+# environment has no egress for the real MNIST download) and a CPU-mesh
+# bench smoke.
 set -e
 cd "$(dirname "$0")/.."
 ./run_tests.sh tests/ -q
+
+CPU_ENV="env PYTHONPATH=$(pwd) JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8"
+
+# -- real-data convergence gates (test_all.sh:44-73 check_val pattern) ----
+MNIST_DIR=$(mktemp -d)/mnist
+$CPU_ENV python tools/make_mnist.py --out "$MNIST_DIR" --train 8000 --test 2000
+
+check_val() {  # check_val <logfile> <threshold> <name>
+    python - "$1" "$2" "$3" <<'PY'
+import re, sys
+log, thr, name = open(sys.argv[1]).read(), float(sys.argv[2]), sys.argv[3]
+accs = [float(m) for m in re.findall(r"final validation accuracy: ([\d.]+)", log)]
+assert accs, "%s: no accuracy line in log" % name
+assert min(accs) >= thr, "%s: accuracy %s < gate %s" % (name, accs, thr)
+print("%s gate passed: %s >= %s" % (name, accs, thr))
+PY
+}
+
+# single-device lenet, gate 0.99 (test_all.sh:55-60)
+$CPU_ENV python examples/train_mnist.py --network lenet \
+    --data-dir "$MNIST_DIR" --num-epochs 10 2>&1 | tee /tmp/nightly_lenet.log
+check_val /tmp/nightly_lenet.log 0.99 "mnist lenet"
+
+# dist_sync 2-worker lenet via the launcher, gate 0.98 (test_all.sh:71-73).
+# Each worker trains its data shard; the server sums the 2 workers' mean
+# gradients, so per-worker lr 0.05 gives the single-device-0.1 dynamics.
+$CPU_ENV python tools/launch.py -n 2 \
+    python examples/train_mnist.py --network lenet --data-dir "$MNIST_DIR" \
+    --num-epochs 10 --lr 0.05 --kv-store dist_sync 2>&1 | tee /tmp/nightly_dist.log
+check_val /tmp/nightly_dist.log 0.98 "mnist lenet dist_sync"
+
+# -- bench smoke on the CPU mesh -----------------------------------------
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     BENCH_BATCH=8 BENCH_IMAGE=64 BENCH_STEPS=2 BENCH_REPS=1 \

@@ -122,3 +122,164 @@ def test_image_record_iter_lazy_mean(tmp_path):
     np.testing.assert_allclose(mean, np.stack(imgs).mean(0), rtol=1e-5)
     np.testing.assert_allclose(b0.data[0].asnumpy(),
                                np.stack(imgs[:4]) - mean, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ImageAugmentParam parity: affine family + HSL jitter
+# (reference src/io/image_augmenter.h:29-54,186-307)
+# ---------------------------------------------------------------------------
+
+def _checker(h=32, w=32):
+    img = np.zeros((3, h, w), np.float32)
+    img[:, : h // 2, : w // 2] = 200.0
+    img[:, h // 2:, w // 2:] = 100.0
+    img[0] += 20.0
+    return img
+
+
+def test_affine_rotation_matches_scipy():
+    """Fixed-angle rotation must match scipy.ndimage bilinear rotation on
+    interior pixels (border handling differs by design: fill_value)."""
+    from scipy import ndimage
+
+    from mxnet_tpu.image import ImageAugmenter
+
+    img = _checker()
+    batch = img[None]
+    aug = ImageAugmenter(data_shape=(3, 32, 32), rotate=30, fill_value=0)
+    out = np.asarray(aug(batch))[0]
+    expect = np.stack([
+        ndimage.rotate(img[c], 30, reshape=False, order=1, mode="constant")
+        for c in range(3)])
+    # compare away from borders (sampling-grid conventions differ there)
+    sl = slice(8, 24)
+    err = np.abs(out[:, sl, sl] - expect[:, sl, sl])
+    assert np.median(err) < 2.0, np.median(err)
+
+
+def test_affine_identity_when_no_params():
+    from mxnet_tpu.image import ImageAugmenter
+
+    aug = ImageAugmenter(data_shape=(3, 32, 32))
+    assert not aug._needs_affine
+    batch = _checker()[None]
+    np.testing.assert_allclose(np.asarray(aug(batch)), batch)
+
+
+def test_affine_scale_down_keeps_center_fill_borders():
+    from mxnet_tpu.image import ImageAugmenter
+
+    img = np.full((3, 32, 32), 100.0, np.float32)
+    aug = ImageAugmenter(data_shape=(3, 32, 32), max_random_scale=0.5,
+                         min_random_scale=0.5, fill_value=7)
+    out = np.asarray(aug(img[None]))[0]
+    # center survives, corners become fill
+    assert abs(out[0, 16, 16] - 100.0) < 1.0
+    assert abs(out[0, 0, 0] - 7.0) < 1.0
+
+
+def test_shear_moves_rows_opposite_directions():
+    from mxnet_tpu.image import ImageAugmenter
+
+    img = np.zeros((3, 33, 33), np.float32)
+    img[:, :, 16] = 255.0  # vertical line
+    aug = ImageAugmenter(data_shape=(3, 33, 33), max_shear_ratio=0.3,
+                         min_random_scale=1.0, max_random_scale=1.0,
+                         fill_value=0, seed=3)
+    out = np.asarray(aug(img[None]))[0, 0]
+    top = np.argmax(out[4])
+    bot = np.argmax(out[28])
+    assert top != bot, "shear did not slant the vertical line"
+
+
+def test_hsl_jitter_zero_is_identity():
+    from mxnet_tpu.image import _hls_to_rgb, _rgb_to_hls
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    rgb = rng.uniform(0, 255, (50, 3)).astype(np.float32)
+    h, l, s = _rgb_to_hls(jnp.asarray(rgb[:, 0]), jnp.asarray(rgb[:, 1]),
+                          jnp.asarray(rgb[:, 2]))
+    r2, g2, b2 = _hls_to_rgb(h, l, s)
+    back = np.stack([np.asarray(r2), np.asarray(g2), np.asarray(b2)], 1)
+    np.testing.assert_allclose(back, rgb, atol=0.1)
+
+
+def test_hsl_matches_colorsys():
+    """RGB->HLS conversion must agree with the stdlib colorsys on OpenCV's
+    value ranges (H in [0,180], L/S in [0,255])."""
+    import colorsys
+
+    import jax.numpy as jnp
+
+    from mxnet_tpu.image import _rgb_to_hls
+
+    rng = np.random.RandomState(1)
+    for _ in range(20):
+        r, g, b = rng.uniform(0, 255, 3)
+        h, l, s = _rgb_to_hls(jnp.float32(r), jnp.float32(g),
+                              jnp.float32(b))
+        eh, el, es = colorsys.rgb_to_hls(r / 255, g / 255, b / 255)
+        assert abs(float(h) - eh * 180.0) < 0.5, (h, eh * 180)
+        assert abs(float(l) - el * 255.0) < 0.5
+        assert abs(float(s) - es * 255.0) < 1.0
+
+
+def test_hsl_lightness_jitter_brightens():
+    from mxnet_tpu.image import ImageAugmenter
+
+    img = np.full((3, 16, 16), 100.0, np.float32)
+    out_sum = 0.0
+    # random_l only; with l jitter ~ U(-50,50) mean abs change is visible
+    aug = ImageAugmenter(data_shape=(3, 16, 16), random_l=50, seed=5)
+    for _ in range(8):
+        out = np.asarray(aug(img[None]))
+        out_sum += abs(float(out.mean()) - 100.0)
+    assert out_sum > 1.0, "random_l had no effect"
+
+
+def test_crop_resize_random_size():
+    from mxnet_tpu.image import ImageAugmenter
+
+    img = np.zeros((3, 40, 40), np.float32)
+    img[:, 18:22, 18:22] = 255.0
+    aug = ImageAugmenter(data_shape=(3, 24, 24), min_crop_size=30,
+                         max_crop_size=36, rand_crop=False)
+    out = np.asarray(aug(img[None]))[0]
+    assert out.shape == (3, 24, 24)
+    # centered crop + resize keeps the bright square near the center
+    assert out[:, 10:14, 10:14].mean() > 100.0
+    assert out[:, :4, :4].mean() < 10.0
+
+
+def test_crop_y_start_explicit_origin():
+    from mxnet_tpu.image import ImageAugmenter
+
+    img = np.arange(16 * 16, dtype=np.float32).reshape(1, 1, 16, 16)
+    aug = ImageAugmenter(data_shape=(1, 8, 8), crop_y_start=2,
+                         crop_x_start=3)
+    out = np.asarray(aug(img))[0]
+    np.testing.assert_allclose(out[0], img[0, 0, 2:10, 3:11])
+
+
+def test_image_record_iter_accepts_full_param_set(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "aug.rec")
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(6):
+        img = rng.randint(0, 255, (40, 40, 3), np.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                  img, img_fmt=".png"))
+    w.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 32, 32),
+        record_shape=(3, 40, 40), batch_size=3, use_native=False,
+        rand_crop=True, rand_mirror=True, max_rotate_angle=10,
+        max_shear_ratio=0.1, max_random_scale=1.1, min_random_scale=0.9,
+        max_aspect_ratio=0.1, random_h=10, random_s=10, random_l=10,
+        fill_value=128, inter_method=1)
+    b = next(it)
+    assert b.data[0].shape == (3, 3, 32, 32)
+    assert np.isfinite(b.data[0].asnumpy()).all()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Deterministic MNIST-like dataset generator in the REAL idx format.
+
+The reference's nightly gates train on real MNIST fetched over the network
+(`tests/python/common/get_data.py`, thresholds in
+`tests/nightly/test_all.sh:44-60`).  This environment has no egress, so
+this tool renders a digit-classification dataset that is a genuine image
+problem (glyphs under random shift/scale/noise/intensity — not separable
+blobs) and writes byte-exact idx files (magic 2051/2049, big-endian
+headers) that `io.MNISTIter` — and any other MNIST reader — parses.
+
+    python tools/make_mnist.py --out data/mnist --train 20000 --test 4000
+
+Same seed -> same bytes, so gates are reproducible.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+# 5x7 digit glyphs (classic dot-matrix font)
+_FONT = {
+    0: ["#####", "#...#", "#...#", "#...#", "#...#", "#...#", "#####"],
+    1: ["..#..", ".##..", "..#..", "..#..", "..#..", "..#..", ".###."],
+    2: ["#####", "....#", "....#", "#####", "#....", "#....", "#####"],
+    3: ["#####", "....#", "....#", ".####", "....#", "....#", "#####"],
+    4: ["#...#", "#...#", "#...#", "#####", "....#", "....#", "....#"],
+    5: ["#####", "#....", "#....", "#####", "....#", "....#", "#####"],
+    6: ["#####", "#....", "#....", "#####", "#...#", "#...#", "#####"],
+    7: ["#####", "....#", "...#.", "..#..", ".#...", ".#...", ".#..."],
+    8: ["#####", "#...#", "#...#", "#####", "#...#", "#...#", "#####"],
+    9: ["#####", "#...#", "#...#", "#####", "....#", "....#", "#####"],
+}
+
+
+def _glyph(d):
+    return np.array([[c == "#" for c in row] for row in _FONT[d]],
+                    np.float32)
+
+
+def render(digit, rng):
+    """One 28x28 uint8 image: scaled glyph, random position, noise."""
+    g = _glyph(digit)
+    # random integer upscale: height 14..21, width 10..15
+    sy = rng.randint(2, 4)
+    sx = rng.randint(2, 4)
+    img = np.kron(g, np.ones((sy, sx), np.float32))
+    h, w = img.shape
+    canvas = np.zeros((28, 28), np.float32)
+    y0 = rng.randint(0, 28 - h + 1)
+    x0 = rng.randint(0, 28 - w + 1)
+    intensity = rng.uniform(120, 255)
+    canvas[y0:y0 + h, x0:x0 + w] = img * intensity
+    canvas += rng.normal(0, 12, canvas.shape)
+    return np.clip(canvas, 0, 255).astype(np.uint8)
+
+
+def write_idx(outdir, prefix, n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    images = np.zeros((n, 28, 28), np.uint8)
+    for i in range(n):
+        images[i] = render(int(labels[i]), rng)
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, prefix + "-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with open(os.path.join(outdir, prefix + "-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return images, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/mnist")
+    ap.add_argument("--train", type=int, default=20000)
+    ap.add_argument("--test", type=int, default=4000)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+    write_idx(args.out, "train", args.train, args.seed)
+    write_idx(args.out, "t10k", args.test, args.seed + 1)
+    print("wrote %d train / %d test idx images to %s"
+          % (args.train, args.test, args.out))
+
+
+if __name__ == "__main__":
+    main()
