@@ -16,6 +16,7 @@ index — debugging doesn't need the compiled path.
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
 
@@ -275,18 +276,37 @@ class ExportedPredictor:
         self._params = (args, aux)
         self._outputs = None
 
+    def set_input(self, name, array):
+        """`MXPredSetInput` parity: stage one input for the next forward."""
+        if name not in self._input_names:
+            raise MXNetError(
+                "ExportedPredictor: %r is not an input (inputs: %s)"
+                % (name, self._input_names))
+        a = np.asarray(getattr(array, "asnumpy", lambda: array)())
+        if tuple(a.shape) != self._input_shapes[name]:
+            raise MXNetError(
+                "ExportedPredictor: input %s has shape %s, expected %s"
+                % (name, a.shape, self._input_shapes[name]))
+        if not hasattr(self, "_staged"):
+            self._staged = {}
+        self._staged[name] = a.astype(self._dtype, copy=False)
+        self._outputs = None
+
     def forward(self, **inputs):
         unknown = [n for n in inputs if n not in self._input_names]
         if unknown:
             raise MXNetError(
                 "ExportedPredictor: unknown inputs %s (inputs: %s)"
                 % (unknown, self._input_names))
-        # absent inputs zero-fill, like the predict ABI which only takes
-        # data inputs (label heads are inert at inference)
+        # kwargs override staged set_input values; absent inputs zero-fill,
+        # like the predict ABI which only takes data inputs (label heads
+        # are inert at inference)
+        staged = dict(getattr(self, "_staged", {}))
+        staged.update(inputs)
         vals = tuple(
             jnp.asarray(
-                getattr(inputs[n], "asnumpy", lambda n=n: inputs[n])())
-            if n in inputs
+                getattr(staged[n], "asnumpy", lambda n=n: staged[n])())
+            if n in staged
             else jnp.zeros(self._input_shapes[n], self._dtype)
             for n in self._input_names)
         self._outputs = self._fn.call(vals, self._params)
@@ -304,6 +324,55 @@ class ExportedPredictor:
 def load_exported(path, ctx=None):
     """Load a single-artifact predictor written by `Predictor.export`."""
     return ExportedPredictor(path, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# Entry points for the native C predict shim (`native/predict_api.cc`, the
+# reference's `include/mxnet/c_predict_api.h` surface).  The C side embeds
+# CPython and calls these with plain bytes/str/tuple arguments only.
+# ---------------------------------------------------------------------------
+
+def _create_for_c_api(symbol_json, param_bytes, input_names, input_shapes,
+                      dev_type, dev_id):
+    """MXPredCreate body: symbol JSON text + raw .params bytes."""
+    import tempfile
+
+    from .context import Context
+
+    ctx = Context("cpu" if dev_type == 1 else "tpu", dev_id)
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(param_bytes)
+        path = f.name
+    try:
+        shapes = {n: tuple(int(x) for x in s)
+                  for n, s in zip(input_names, input_shapes)}
+        return Predictor(symbol_json, path, shapes, ctx=ctx)
+    finally:
+        os.remove(path)
+
+
+def _set_input_from_buffer(pred, key, buf):
+    """MXPredSetInput body: raw little-endian f32 bytes.  Works for both
+    Predictor and ExportedPredictor handles."""
+    if key not in pred._input_names:
+        raise MXNetError(
+            "%r is not an input (inputs: %s)" % (key, pred._input_names))
+    if hasattr(pred, "_arg_index"):
+        shape = tuple(pred._arg_arrays[pred._arg_index[key]].shape)
+    else:
+        shape = pred._input_shapes[key]
+    arr = np.frombuffer(buf, np.float32)
+    if arr.size != int(np.prod(shape)):
+        raise MXNetError(
+            "input %s: got %d floats, expected %d (shape %s)"
+            % (key, arr.size, int(np.prod(shape)), shape))
+    pred.set_input(key, arr.reshape(shape))
+
+
+def _get_output_bytes(pred, index):
+    """MXPredGetOutput body: output as raw f32 bytes."""
+    return np.ascontiguousarray(
+        pred.get_output(index), np.float32).tobytes()
 
 
 def load(prefix, epoch, input_shapes, ctx=None, **kwargs):

@@ -1,0 +1,64 @@
+/*
+ * C predict API — the stable serving boundary for non-Python consumers.
+ *
+ * Mirrors the reference's `include/mxnet/c_predict_api.h` surface
+ * (MXPredCreate / SetInput / Forward / PartialForward / GetOutput / Free,
+ * MXGetLastError): self-contained, no other headers needed.  One addition:
+ * MXPredCreateFromArtifact loads the single-file StableHLO deployment
+ * artifact written by `Predictor.export` (the amalgamation analogue).
+ *
+ * Implementation (predict_api.cc) embeds CPython and drives
+ * `mxnet_tpu.predictor`; consumers link `libmxtpu_predict.so` and never
+ * touch Python.  Set JAX_PLATFORMS / PYTHONPATH in the process environment
+ * to choose the device and locate the package.
+ *
+ * Every function returns 0 on success, -1 on failure; call MXGetLastError()
+ * for the message (thread-local, like the reference's c_api_error.h).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+const char *MXGetLastError(void);
+
+/* Create from symbol JSON text + raw .params file bytes (reference
+ * MXPredCreate signature: per-input shapes in CSR form). */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/* Create from a `Predictor.export()` single-file artifact (StableHLO +
+ * params npz): no symbol graph or op registry at load time. */
+int MXPredCreateFromArtifact(const char *artifact_path, PredictorHandle *out);
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint out_index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+int MXPredForward(PredictorHandle handle);
+
+/* Run only the first `step` graph nodes (debugging); *step_left reports how
+ * many remain (reference MXPredPartialForward). Unsupported for artifact
+ * handles (the graph is compiled away). */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,288 @@
+/*
+ * C predict API implementation: embeds CPython and drives
+ * `mxnet_tpu.predictor` (see c_predict_api.h for the contract; reference
+ * surface: `src/c_api/c_predict_api.cc`).
+ *
+ * Design: the reference's predict ABI bound a NaiveEngine executor; here
+ * the Python side AOT-compiles the graph with XLA once at create time and
+ * every MXPredForward is a single compiled-executable launch, so the
+ * interpreter only marshals buffers.  All entry points grab the GIL
+ * (callable from any thread) and translate Python exceptions into the
+ * thread-local MXGetLastError string (the API_BEGIN/API_END pattern,
+ * reference `src/c_api/c_api_error.h`).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string>
+#include <vector>
+
+#include "c_predict_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct PredictorState {
+  PyObject *pred = nullptr;             // mxnet_tpu Predictor instance
+  bool is_artifact = false;             // ExportedPredictor (no graph)
+  std::vector<mx_uint> shape_buf;       // storage for GetOutputShape
+};
+
+PyObject *g_mod = nullptr;  // mxnet_tpu.predictor module
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Initialize the embedded interpreter once; afterwards the GIL is released
+// so any caller thread can PyGILState_Ensure.
+bool ensure_python() {
+  static bool initialized = false;
+  static bool ok = false;
+  if (initialized) return ok;
+  initialized = true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // hand the GIL back; every API call re-acquires via PyGILState
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  g_mod = PyImport_ImportModule("mxnet_tpu.predictor");
+  if (g_mod == nullptr) {
+    set_error_from_python();
+    ok = false;
+  } else {
+    ok = true;
+  }
+  PyGILState_Release(st);
+  return ok;
+}
+
+int fail() { return -1; }
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  if (!ensure_python()) return fail();
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *names = nullptr, *shapes = nullptr, *res = nullptr;
+  do {
+    names = PyList_New(num_input_nodes);
+    shapes = PyList_New(num_input_nodes);
+    if (names == nullptr || shapes == nullptr) break;
+    for (mx_uint i = 0; i < num_input_nodes; ++i) {
+      PyList_SET_ITEM(names, i, PyUnicode_FromString(input_keys[i]));
+      mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject *shape = PyTuple_New(hi - lo);
+      for (mx_uint j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(shape, j - lo,
+                         PyLong_FromUnsignedLong(input_shape_data[j]));
+      PyList_SET_ITEM(shapes, i, shape);
+    }
+    res = PyObject_CallMethod(
+        g_mod, "_create_for_c_api", "sy#OOii", symbol_json_str,
+        static_cast<const char *>(param_bytes),
+        static_cast<Py_ssize_t>(param_size), names, shapes, dev_type,
+        dev_id);
+    if (res == nullptr) break;
+    auto *state = new PredictorState();
+    state->pred = res;
+    res = nullptr;  // ownership moved
+    *out = state;
+    rc = 0;
+  } while (false);
+  if (rc != 0) set_error_from_python();
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  Py_XDECREF(res);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredCreateFromArtifact(const char *artifact_path,
+                             PredictorHandle *out) {
+  if (!ensure_python()) return fail();
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *res =
+      PyObject_CallMethod(g_mod, "load_exported", "s", artifact_path);
+  if (res != nullptr) {
+    auto *state = new PredictorState();
+    state->pred = res;
+    state->is_artifact = true;
+    *out = state;
+    rc = 0;
+  } else {
+    set_error_from_python();
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint out_index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  auto *state = static_cast<PredictorState *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *shapes =
+      PyObject_GetAttrString(state->pred, "output_shapes");
+  do {
+    if (shapes == nullptr) break;
+    PyObject *shape = PySequence_GetItem(shapes, out_index);
+    if (shape == nullptr) break;
+    Py_ssize_t n = PySequence_Size(shape);
+    state->shape_buf.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *d = PySequence_GetItem(shape, i);
+      state->shape_buf.push_back(
+          static_cast<mx_uint>(PyLong_AsUnsignedLong(d)));
+      Py_XDECREF(d);
+    }
+    Py_DECREF(shape);
+    *shape_data = state->shape_buf.data();
+    *shape_ndim = static_cast<mx_uint>(state->shape_buf.size());
+    rc = 0;
+  } while (false);
+  if (rc != 0) set_error_from_python();
+  Py_XDECREF(shapes);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  auto *state = static_cast<PredictorState *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *res = PyObject_CallMethod(
+      g_mod, "_set_input_from_buffer", "Osy#", state->pred, key,
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(mx_float)));
+  if (res != nullptr) {
+    rc = 0;
+  } else {
+    set_error_from_python();
+  }
+  Py_XDECREF(res);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto *state = static_cast<PredictorState *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *res = PyObject_CallMethod(state->pred, "forward", nullptr);
+  if (res != nullptr) {
+    rc = 0;
+  } else {
+    set_error_from_python();
+  }
+  Py_XDECREF(res);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  auto *state = static_cast<PredictorState *>(handle);
+  if (state->is_artifact) {
+    g_last_error =
+        "partial_forward is unavailable for artifact predictors (the "
+        "graph is compiled away)";
+    return fail();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *res =
+      PyObject_CallMethod(state->pred, "partial_forward", "i", step);
+  PyObject *order = nullptr;
+  do {
+    if (res == nullptr) break;
+    order = PyObject_GetAttrString(state->pred, "_order");
+    if (order == nullptr) break;
+    // nodes that actually execute = non-variable entries
+    Py_ssize_t total = 0, n = PySequence_Size(order);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *node = PySequence_GetItem(order, i);
+      PyObject *isvar = PyObject_GetAttrString(node, "is_variable");
+      if (isvar != nullptr && !PyObject_IsTrue(isvar)) total += 1;
+      Py_XDECREF(isvar);
+      Py_XDECREF(node);
+    }
+    if (step_left != nullptr)
+      *step_left = static_cast<int>(total > step ? total - step : 0);
+    rc = 0;
+  } while (false);
+  if (rc != 0) set_error_from_python();
+  Py_XDECREF(order);
+  Py_XDECREF(res);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  auto *state = static_cast<PredictorState *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *bytes = PyObject_CallMethod(g_mod, "_get_output_bytes", "OI",
+                                        state->pred, index);
+  do {
+    if (bytes == nullptr) break;
+    char *buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) break;
+    if (static_cast<Py_ssize_t>(size * sizeof(mx_float)) != len) {
+      g_last_error = "MXPredGetOutput: buffer size " +
+                     std::to_string(size) + " floats, output has " +
+                     std::to_string(len / sizeof(mx_float));
+      Py_DECREF(bytes);
+      PyGILState_Release(st);
+      return fail();
+    }
+    memcpy(data, buf, len);
+    rc = 0;
+  } while (false);
+  if (rc != 0) set_error_from_python();
+  Py_XDECREF(bytes);
+  PyGILState_Release(st);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  auto *state = static_cast<PredictorState *>(handle);
+  if (state == nullptr) return 0;
+  if (Py_IsInitialized()) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    Py_XDECREF(state->pred);
+    PyGILState_Release(st);
+  }
+  delete state;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,185 @@
+"""End-to-end test of the C predict shim (native/predict_api.cc).
+
+Builds a real C driver with g++, links libmxtpu_predict.so, and runs it in
+a fresh process (true embedded-CPython deployment, no Python in the
+consumer's code) against a checkpoint written here; its output must match
+the in-process Python Predictor bit-for-bit (both paths run the same XLA
+executable on the CPU backend).
+"""
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import predictor
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+NATIVE = os.path.join(ROOT, "native")
+SHIM = os.path.join(NATIVE, "libmxtpu_predict.so")
+
+C_DRIVER = textwrap.dedent("""
+    #include <stdio.h>
+    #include <stdlib.h>
+    #include <string.h>
+    #include "c_predict_api.h"
+
+    static char *read_file(const char *path, long *size) {
+        FILE *f = fopen(path, "rb");
+        if (!f) { fprintf(stderr, "open %s failed\\n", path); exit(2); }
+        fseek(f, 0, SEEK_END); *size = ftell(f); fseek(f, 0, SEEK_SET);
+        char *buf = malloc(*size + 1);
+        if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+        buf[*size] = 0; fclose(f);
+        return buf;
+    }
+
+    int main(int argc, char **argv) {
+        if (argc < 4) { fprintf(stderr, "usage: sym params n\\n"); return 2; }
+        long sym_size, param_size;
+        char *sym = read_file(argv[1], &sym_size);
+        char *params = read_file(argv[2], &param_size);
+        int n = atoi(argv[3]);
+
+        const char *keys[] = {"data"};
+        mx_uint indptr[] = {0, 2};
+        mx_uint shape[] = {(mx_uint)n, 6};
+        PredictorHandle h = NULL;
+        if (MXPredCreate(sym, params, (int)param_size, 1, 0, 1, keys,
+                         indptr, shape, &h) != 0) {
+            fprintf(stderr, "create: %s\\n", MXGetLastError()); return 1;
+        }
+        float *in = malloc(sizeof(float) * n * 6);
+        for (int i = 0; i < n * 6; ++i) in[i] = (float)i / 10.0f - 1.0f;
+        if (MXPredSetInput(h, "data", in, n * 6) != 0) {
+            fprintf(stderr, "set_input: %s\\n", MXGetLastError()); return 1;
+        }
+        if (MXPredForward(h) != 0) {
+            fprintf(stderr, "forward: %s\\n", MXGetLastError()); return 1;
+        }
+        mx_uint *oshape, ondim;
+        if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+            fprintf(stderr, "shape: %s\\n", MXGetLastError()); return 1;
+        }
+        mx_uint osize = 1;
+        for (mx_uint i = 0; i < ondim; ++i) osize *= oshape[i];
+        float *out = malloc(sizeof(float) * osize);
+        if (MXPredGetOutput(h, 0, out, osize) != 0) {
+            fprintf(stderr, "get_output: %s\\n", MXGetLastError()); return 1;
+        }
+        for (mx_uint i = 0; i < osize; ++i) printf("%.6e\\n", out[i]);
+        /* error path: bad input name must fail with a message */
+        if (MXPredSetInput(h, "nope", in, n * 6) == 0) {
+            fprintf(stderr, "bad input name accepted\\n"); return 1;
+        }
+        if (strlen(MXGetLastError()) == 0) {
+            fprintf(stderr, "empty error message\\n"); return 1;
+        }
+        int left = -1;
+        if (MXPredPartialForward(h, 1, &left) != 0) {
+            fprintf(stderr, "partial: %s\\n", MXGetLastError()); return 1;
+        }
+        if (left <= 0) { fprintf(stderr, "left=%d\\n", left); return 1; }
+        MXPredFree(h);
+        return 0;
+    }
+""")
+
+
+def _model_files(tmp_path):
+    net = mx.sym.FullyConnected(data=mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    net = mx.sym.Activation(data=net, act_type="tanh")
+    net = mx.sym.FullyConnected(data=net, num_hidden=3, name="out")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    rng = np.random.RandomState(5)
+    args = {}
+    for name, s in zip(net.list_arguments(),
+                       net.infer_shape(data=(2, 6), softmax_label=(2,))[0]):
+        if name not in ("data", "softmax_label"):
+            args["arg:" + name] = mx.nd.array(
+                rng.randn(*s).astype(np.float32) * 0.3)
+    sym_path = str(tmp_path / "m-symbol.json")
+    params_path = str(tmp_path / "m-0001.params")
+    net.save(sym_path)
+    mx.nd.save(params_path, args)
+    return net, sym_path, params_path
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_c_driver_matches_python_predictor(tmp_path):
+    if not os.path.exists(SHIM):
+        rc = subprocess.run(["make", "-C", NATIVE], capture_output=True)
+        if rc.returncode != 0 or not os.path.exists(SHIM):
+            pytest.skip("predict shim not buildable here")
+
+    net, sym_path, params_path = _model_files(tmp_path)
+
+    n = 2
+    x = (np.arange(n * 6, dtype=np.float32) / 10.0 - 1.0).reshape(n, 6)
+    pred = predictor.Predictor(sym_path, params_path, {"data": (n, 6)})
+    expect = pred.predict(data=x)
+
+    driver_c = tmp_path / "driver.c"
+    driver_c.write_text(C_DRIVER)
+    exe = str(tmp_path / "driver")
+    subprocess.run(
+        ["g++", "-x", "c", str(driver_c), "-o", exe, "-I", NATIVE,
+         "-L", NATIVE, "-lmxtpu_predict",
+         "-Wl,-rpath," + NATIVE],
+        check=True, capture_output=True)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([exe, sym_path, params_path, str(n)],
+                          capture_output=True, text=True, timeout=300,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    got = np.array([float(v) for v in proc.stdout.split()],
+                   np.float32).reshape(expect.shape)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_artifact_create_via_ctypes(tmp_path):
+    """MXPredCreateFromArtifact drives an ExportedPredictor (StableHLO npz)
+    through the same C surface; exercised in-process via ctypes (the shim
+    detects the already-running interpreter)."""
+    import ctypes
+
+    if not os.path.exists(SHIM):
+        pytest.skip("predict shim not built")
+    net, sym_path, params_path = _model_files(tmp_path)
+    pred = predictor.Predictor(sym_path, params_path, {"data": (2, 6)})
+    artifact = str(tmp_path / "model.mxa")
+    pred.export(artifact)
+    x = np.linspace(-1, 1, 12, dtype=np.float32).reshape(2, 6)
+    expect = pred.predict(data=x)
+
+    lib = ctypes.CDLL(SHIM)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    h = ctypes.c_void_p()
+    rc = lib.MXPredCreateFromArtifact(artifact.encode(), ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+    buf = np.ascontiguousarray(x, np.float32)
+    rc = lib.MXPredSetInput(
+        h, b"data", buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(buf.size))
+    assert rc == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(h) == 0, lib.MXGetLastError()
+    out = np.zeros(expect.size, np.float32)
+    rc = lib.MXPredGetOutput(
+        h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(out.size))
+    assert rc == 0, lib.MXGetLastError()
+    np.testing.assert_allclose(out.reshape(expect.shape), expect,
+                               rtol=1e-5, atol=1e-6)
+    # partial_forward must refuse cleanly on artifact handles
+    left = ctypes.c_int(-1)
+    assert lib.MXPredPartialForward(h, 1, ctypes.byref(left)) != 0
+    assert b"compiled away" in lib.MXGetLastError()
+    assert lib.MXPredFree(h) == 0
