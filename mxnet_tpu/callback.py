@@ -49,38 +49,45 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Log samples/sec every `frequent` batches (`callback.py:57`)."""
+    """Log samples/sec every `frequent` batches.
+
+    The throughput metric of every reference example and nightly.  The LOG
+    LINE FORMAT is a compatibility contract — `tools/parse_log.py` and the
+    reference's nightly `check_val` grep it — but the bookkeeping is our
+    own: one window anchor (the wall-clock time and batch number where the
+    current measurement window opened), re-anchored whenever the batch
+    counter runs backwards (new epoch).
+    """
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._window = None  # (anchor_time, anchor_batch) of current window
         self.last_speed = None
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                self.last_speed = speed
-                if param.eval_metric is not None:
-                    for name, value in param.eval_metric.get_name_value():
-                        logging.info(
-                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                            param.epoch, count, speed, name, value,
-                        )
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        nbatch = param.nbatch
+        if self._window is None or nbatch < self._window[1]:
+            self._window = (time.time(), nbatch)  # epoch rollover: re-anchor
+            return
+        if nbatch % self.frequent != 0:
+            return
+        now = time.time()
+        elapsed = now - self._window[0]
+        done = nbatch - self._window[1]
+        self._window = (now, nbatch)
+        if elapsed <= 0 or done <= 0:
+            return
+        self.last_speed = done * self.batch_size / elapsed
+        metrics = (param.eval_metric.get_name_value()
+                   if param.eval_metric is not None else [])
+        if not metrics:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, nbatch, self.last_speed)
+        for name, value in metrics:
+            logging.info(
+                "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
+                param.epoch, nbatch, self.last_speed, name, value)
 
 
 class ProgressBar:

@@ -29,18 +29,22 @@ class FactorScheduler(LRScheduler):
         self.count = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info(
-                    "Update[%d]: lr reached stop factor, freeze at %0.5e",
-                    num_update, self.base_lr,
-                )
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
+        # lazy decay: apply every step boundary crossed since the last
+        # query at once, so a run resumed at update K lands on the same lr
+        # as one that queried every update
+        boundaries_passed = max(0, (num_update - 1 - self.count) // self.step)
+        if not boundaries_passed:
+            return self.base_lr
+        self.count += boundaries_passed * self.step
+        decayed = self.base_lr * self.factor ** boundaries_passed
+        if decayed < self.stop_factor_lr:
+            self.base_lr = self.stop_factor_lr
+            logging.info("Update[%d]: lr hit the stop floor; holding %0.5e",
+                         num_update, self.base_lr)
+        else:
+            self.base_lr = decayed
+            logging.info("Update[%d]: learning rate decayed to %0.5e",
+                         num_update, self.base_lr)
         return self.base_lr
 
 

@@ -126,3 +126,49 @@ def test_optimizer_picklable():
     opt = SGD(learning_rate=0.1, momentum=0.9)
     opt2 = pickle.loads(pickle.dumps(opt))
     assert opt2.lr == 0.1
+
+
+def test_factor_scheduler_lazy_catchup_matches_stepwise():
+    """Querying once at update K must land on the same lr as querying at
+    every update (the reference's while-loop semantics)."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    for k in (1, 2, 3, 7, 20, 21, 100):
+        a = FactorScheduler(step=7, factor=0.5, stop_factor_lr=1e-6)
+        a.base_lr = 2.0
+        b = FactorScheduler(step=7, factor=0.5, stop_factor_lr=1e-6)
+        b.base_lr = 2.0
+        stepwise = [a(u) for u in range(1, k + 1)][-1]
+        lazy = b(k)
+        assert stepwise == pytest.approx(lazy), (k, stepwise, lazy)
+
+
+def test_factor_scheduler_stop_floor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    s = FactorScheduler(step=1, factor=0.1, stop_factor_lr=1e-3)
+    s.base_lr = 1.0
+    assert s(100) == pytest.approx(1e-3)
+
+
+def test_speedometer_log_format_parse_log_compatible(caplog):
+    """tools/parse_log.py greps `Epoch[..] .. Speed: N samples`; the
+    Speedometer line must keep matching it."""
+    import logging
+    import re
+    import time as _time
+
+    from mxnet_tpu.callback import BatchEndParam, Speedometer
+    from mxnet_tpu.metric import Accuracy
+
+    m = Accuracy()
+    m.sum_metric, m.num_inst = 3.0, 4  # pretend state
+    s = Speedometer(batch_size=8, frequent=2)
+    with caplog.at_level(logging.INFO):
+        s(BatchEndParam(epoch=1, nbatch=1, eval_metric=m))
+        _time.sleep(0.01)
+        s(BatchEndParam(epoch=1, nbatch=2, eval_metric=m))
+    pat = re.compile(r"Epoch\[(\d+)\].*?Speed:\s*([0-9.]+)\s*samples")
+    hits = [pat.search(r.getMessage()) for r in caplog.records]
+    assert any(hits), [r.getMessage() for r in caplog.records]
+    assert s.last_speed is not None and s.last_speed > 0
