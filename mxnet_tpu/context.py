@@ -64,16 +64,24 @@ class Context:
         tpu -> accelerator devices of the default backend; cpu -> cpu backend.
         Falls back to the default backend's devices when the requested platform
         is unavailable so multi-device logic is testable on a host-only mesh.
+        In a multi-process job, contexts address THIS process's devices
+        (copying a host value onto another process's device is impossible —
+        global placement happens through shardings, not contexts).
         """
         import jax
 
+        def _devs(platform=None):
+            if jax.process_count() > 1:
+                return jax.local_devices(backend=platform)
+            return jax.devices(platform)
+
         if self.device_type in ("tpu", "gpu"):
-            devs = jax.devices()  # default backend = accelerator when present
+            devs = _devs()  # default backend = accelerator when present
         else:
             try:
-                devs = jax.devices("cpu")
+                devs = _devs("cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = _devs()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "context %s out of range: only %d %s device(s) visible"

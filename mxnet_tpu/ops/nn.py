@@ -25,20 +25,20 @@ from .registry import OpCtx, OpDef, Param, register
 
 
 def _accum_kwargs(*operands):
-    """f32-accumulation request for low-precision matmuls/convs off-TPU.
+    """Accumulation-dtype policy for low-precision matmuls/convs.
 
-    The TPU MXU accumulates bf16 contractions in f32 natively, so on TPU no
-    annotation is needed (and keeping the output dtype == operand dtype lets
-    XLA fuse freely).  On other backends — notably the CPU mesh the test
-    suite runs on — bf16 contractions may accumulate in bf16, silently
-    degrading the mixed-precision path; request f32 accumulation there and
-    cast back (callers pair this with ``.astype(jnp.result_type(*operands))``
-    so output dtypes are backend-invariant)."""
-    if jax.default_backend() == "tpu":
-        return {}
-    dt = jnp.result_type(*operands)
-    if dt in (jnp.bfloat16, jnp.float16):
-        return {"preferred_element_type": jnp.float32}
+    The TPU MXU accumulates bf16 contractions in f32 natively, so no
+    annotation is needed on the target platform (and keeping output dtype
+    == operand dtype lets XLA fuse freely).  On other backends — the CPU
+    mesh the test suite runs on — bf16 contractions may accumulate at
+    reduced precision; requesting `preferred_element_type=f32` there is
+    NOT an option, because this jax version cannot transpose a
+    dtype-mismatched conv in the vjp (bf16 cotangent against an f32-
+    accumulated primal fails `conv_general_dilated` dtype checks).  The
+    documented contract is therefore: bf16 mixed-precision NUMERICS are
+    validated on TPU; the CPU mesh validates shapes/semantics, and tests
+    asserting tight numerics run in f32."""
+    del operands
     return {}
 
 

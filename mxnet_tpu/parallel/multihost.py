@@ -61,11 +61,59 @@ def init_from_env(coordinator=None, num_processes=None, process_id=None):
             % (process_id, num_processes))
     logging.info("jax.distributed: %s rank %d/%d", coordinator, process_id,
                  num_processes)
+    # Multi-process over the CPU backend (the localhost test/dev story,
+    # like the reference's multi-process-localhost PS tests) needs a real
+    # cross-process collectives implementation; without it every process
+    # sees only its own devices and process_count() stays 1.  Set both the
+    # env default (read at backend init) and the live config.  Only the
+    # CPU backend reads this, so it is harmless on TPU jobs.
+    os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:  # older jax / no gloo build: TPU doesn't need it
+        logging.warning("cpu collectives config not applied: %s", e)
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
     _initialized = True
+    # Establish the cross-process collectives context NOW, while every
+    # process is aligned at the same point (they all just left the same
+    # initialize rendezvous).  The context bring-up has a hard ~30s peer
+    # deadline; if it is instead first triggered by a real program, two
+    # processes whose compile times skew by more than that spuriously time
+    # out (easy on a loaded single-core host).
+    barrier("mxnet_tpu.multihost.init")
     return num_processes
+
+
+def barrier(name="mxnet_tpu.barrier"):
+    """Block until every process reaches this point (and, first time,
+    bring up the cross-process collectives contexts).  The SPMD analogue
+    of the kvstore barrier.
+
+    Two warm-ups on purpose: `sync_global_devices` establishes the
+    process-level (one rank per host) context, and the tiny sharded
+    reduce below establishes the device-level (one rank per device)
+    context that real SPMD programs use — each has its own peer
+    rendezvous with the same hard deadline."""
+    try:
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        multihost_utils.sync_global_devices(name)
+        if jax.process_count() > 1:
+            from .mesh import make_mesh
+
+            mesh = make_mesh(shape=(jax.device_count(),),
+                             axis_names=("_barrier",),
+                             devices=jax.devices())
+            x = jax.device_put(
+                _np.ones((jax.device_count(),), _np.float32),
+                NamedSharding(mesh, PartitionSpec("_barrier")))
+            jax.block_until_ready(jax.jit(lambda a: a.sum())(x))
+    except Exception as e:
+        logging.warning("multihost barrier %r failed: %s", name, e)
 
 
 def _dmlc_coordinator():

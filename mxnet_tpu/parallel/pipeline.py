@@ -78,9 +78,13 @@ class PipelineParallel:
         T = M + S - 1
         zero = jnp.zeros_like(x_mb[0])
         total0 = jnp.zeros((), jnp.float32)
-        if hasattr(jax.lax, "pvary"):
-            # carries flow through ppermute/psum, so they are device-varying
-            # over the pipe axis; the init must carry the same type
+        # carries flow through ppermute/psum, so they are device-varying
+        # over the pipe axis; the init must carry the same type.  pcast
+        # replaced the deprecated pvary in jax 0.9.
+        if hasattr(jax.lax, "pcast"):
+            zero = jax.lax.pcast(zero, ax, to="varying")
+            total0 = jax.lax.pcast(total0, ax, to="varying")
+        elif "pvary" in dir(jax.lax):
             zero = jax.lax.pvary(zero, (ax,))
             total0 = jax.lax.pvary(total0, (ax,))
 

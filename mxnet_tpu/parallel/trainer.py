@@ -31,6 +31,16 @@ from ..ndarray import NDArray
 from .. import random as _random
 
 
+def _put_global(arr, sharding):
+    """device_put that works in multi-process jobs: a LOCAL jax array
+    cannot be copied onto non-addressable devices, so materialize host-side
+    first (each process then provides its addressable shards; every process
+    must pass the same global value)."""
+    if jax.process_count() > 1 and isinstance(arr, jax.Array):
+        arr = np.asarray(arr)
+    return jax.device_put(arr, sharding)
+
+
 def _wd_mult(name):
     """Reference `Optimizer.set_wd_mult` default: weight decay applies to
     *_weight/*_gamma only — biases/beta/BN stats are excluded
@@ -130,30 +140,31 @@ class SPMDTrainer:
             initializer(n, host)
             sh = (param_sharding or {}).get(n, repl)
             self._param_sharding[n] = sh
-            params[n] = jax.device_put(host.data, sh)
+            params[n] = _put_global(host.data, sh)
         self.params = params
         if self.optimizer == "adam":
             self.momenta = {"_t": jnp.zeros((), jnp.float32)}
             self.momenta.update({
-                n: (jax.device_put(jnp.zeros_like(v),
-                                   self._param_sharding[n]),
-                    jax.device_put(jnp.zeros_like(v),
-                                   self._param_sharding[n]))
+                n: (_put_global(np.zeros(v.shape, np.float32),
+                                self._param_sharding[n]),
+                    _put_global(np.zeros(v.shape, np.float32),
+                                self._param_sharding[n]))
                 for n, v in params.items()
             })
         else:
             self.momenta = {
-                n: jax.device_put(jnp.zeros_like(v), self._param_sharding[n])
+                n: _put_global(np.zeros(v.shape, np.float32),
+                               self._param_sharding[n])
                 for n, v in params.items()
             }
         self.aux = {
-            n: jax.device_put(jnp.zeros(s, dtype=np.float32), repl)
+            n: _put_global(np.zeros(s, np.float32), repl)
             for n, s in zip(self.aux_names, aux_shapes)
         }
         for n in self.aux_names:  # aux init: means 0, vars 1
             if n.endswith("moving_var"):
-                self.aux[n] = jax.device_put(
-                    jnp.ones_like(self.aux[n]), repl)
+                self.aux[n] = _put_global(
+                    np.ones(self.aux[n].shape, np.float32), repl)
 
         graph_fn, _, _ = _build_graph_fn(symbol)
         # MXNET_BACKWARD_DO_MIRROR (the reference's recompute-cheap-ops
@@ -277,7 +288,7 @@ class SPMDTrainer:
             arr = v.data if isinstance(v, NDArray) else jnp.asarray(v)
             stacked = (n in self._shape_of
                        and arr.ndim > len(self._shape_of[n]))
-            out[n] = jax.device_put(
+            out[n] = _put_global(
                 arr, self._stacked_sharding if stacked
                 else self._batch_sharding)
         return out
