@@ -154,6 +154,21 @@ class CrossEntropy(EvalMetric):
             self.num_inst += label.shape[0]
 
 
+class Torch(EvalMetric):
+    """Average of criterion outputs (`metric.py:337` Torch): torch-bridge
+    criterions (TorchCriterion) emit per-batch loss values; this metric
+    tracks their running mean, ignoring labels."""
+
+    def __init__(self):
+        super().__init__("torch")
+
+    def update(self, labels, preds):
+        del labels  # criterion outputs already consumed the labels
+        for pred in preds:
+            self.sum_metric += float(_np(pred).mean())
+        self.num_inst += 1
+
+
 class CustomMetric(EvalMetric):
     """Wrap a feval(label, pred) function (`metric.py` CustomMetric)."""
 
@@ -236,6 +251,7 @@ def create(metric):
         "mse": MSE,
         "rmse": RMSE,
         "ce": CrossEntropy,
+        "torch": Torch,
     }
     m = metric.lower()
     if m not in metrics:

@@ -81,3 +81,18 @@ def test_torch_module_trains():
     pred = model.predict(mx.io.NDArrayIter(x, batch_size=8))
     acc = ((pred.argmax(axis=1) == y).mean())
     assert acc > 0.9, acc
+
+
+def test_torch_metric_parity():
+    """metric.Torch (`metric.py:337`): running mean of criterion outputs,
+    labels ignored."""
+    import numpy as np
+
+    from mxnet_tpu import metric, nd
+
+    m = metric.create("torch")
+    m.update(None, [nd.array(np.array([2.0, 4.0], np.float32))])
+    m.update(None, [nd.array(np.array([6.0], np.float32))])
+    name, value = m.get()
+    assert name == "torch"
+    assert value == (3.0 + 6.0) / 2
