@@ -109,7 +109,10 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+    # the TPU lowering requires >=2D tile-aligned blocks: lse carries a
+    # broadcast 128-lane minor dim (sliced off by the wrapper)
+    lse_ref[0, 0] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None],
+                                     (bq, 128))
 
 
 def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
@@ -138,7 +141,8 @@ def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda i, j, k_, qo, ko: (i, j, k_, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j, k_, qo, ko: (i, j, k_)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda i, j, k_, qo, ko: (i, j, k_, 0)),
         ],
     )
     out, lse = pl.pallas_call(
@@ -146,7 +150,7 @@ def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq_p, 128), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq_p * skv_p * d,
@@ -155,6 +159,7 @@ def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
         ),
     )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
       qp, kp, vp)
+    lse = lse[..., 0]  # drop the broadcast lane dim
     if pad_q:
         out, lse = out[:, :, :sq], lse[:, :, :sq]
     return out, lse

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Transformer-LM training MFU on one chip.
+
+The ResNet-50 north star is HBM-bound at ~30% MFU on v5e
+(docs/mfu_roofline.md); transformers are where TPU MFU headroom actually
+lives — matmul-dominated, flash attention (ops/pallas_kernels) keeping the
+sequence dimension out of HBM.  This benchmark trains the decoder-only LM
+from models/transformer.py with the fused SPMD step and reports tokens/sec
+and MFU.
+
+MFU accounting (2 ops per MAC, PaLM convention): per token
+  6 * n_params_active  (fwd+bwd matmul flops, params minus embeddings)
++ 12 * L * H * S       (attention scores+values, causal halves it)
+Prints ONE JSON line.
+
+Env: TBENCH_LAYERS/EMBED/HEADS/SEQ/BATCH/STEPS/DTYPE/PEAK_FLOPS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import jax
+
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    L = int(os.environ.get("TBENCH_LAYERS", "12"))
+    D = int(os.environ.get("TBENCH_EMBED", "768"))
+    H = int(os.environ.get("TBENCH_HEADS", "12"))
+    S = int(os.environ.get("TBENCH_SEQ", "1024"))
+    B = int(os.environ.get("TBENCH_BATCH", "32"))
+    V = int(os.environ.get("TBENCH_VOCAB", "32768"))
+    steps = int(os.environ.get("TBENCH_STEPS", "15"))
+    reps = int(os.environ.get("TBENCH_REPS", "3"))
+    dtype = os.environ.get("TBENCH_DTYPE", "bfloat16")
+    if dtype == "bfloat16":
+        from mxnet_tpu.base import bfloat16 as dtype
+
+    net = models.get_transformer_lm(
+        vocab_size=V, seq_len=S, num_layers=L, num_heads=H, num_embed=D)
+    n_dev = len(jax.devices())
+    n_dev = next(k for k in range(n_dev, 0, -1) if B % k == 0)
+    mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
+    trainer = SPMDTrainer(
+        net, mesh,
+        data_shapes={"data": (B, S), "softmax_label": (B, S)},
+        lr=1e-3, optimizer="adam", wd=0.0, dtype=dtype)
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.randint(0, V, (B, S)).astype(np.int32),
+        "softmax_label": rng.randint(0, V, (B, S)).astype(np.float32),
+    }
+    dev_batch = trainer.shard_batch(batch)
+    trainer.run_steps(dev_batch, steps)  # compile + warm
+    jax.block_until_ready(trainer.params)
+    t0 = time.time()
+    for _ in range(reps):
+        trainer.run_steps(dev_batch, steps)
+    jax.block_until_ready(trainer.params)
+    dt = (time.time() - t0) / (steps * reps)
+
+    tokens_per_sec = B * S / dt
+    # active params: matmul-participating weights (incl. the tied-size
+    # output head; embedding table lookups are gathers, not matmuls)
+    n_matmul_params = (L * (4 * D * D + 2 * D * 4 * D)) + D * V
+    flops_token = 6 * n_matmul_params + 12 * L * D * S // 2  # causal
+    peak = float(os.environ.get("TBENCH_PEAK_FLOPS", "197e12")) * n_dev
+    mfu = flops_token * B * S / dt / peak
+
+    print(json.dumps({
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n_dev, 1),
+        "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d S=%d B=%d, %s)"
+                % (mfu, L, D, S, B, np.dtype(dtype).name),
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()
