@@ -129,3 +129,15 @@ def test_moe_lm_example():
 def test_deploy_predictor_example():
     out = run_example("deploy_predictor.py", "--num-epoch", "4")
     assert "exported artifact" in out
+
+
+def test_speech_demo_example():
+    """`example/speech-demo` analogue: bucketed spliced-frame acoustic
+    model must learn the synthetic phone corpus."""
+    out = run_example("speech_demo.py", "--num-utts", "60",
+                      "--num-epochs", "2", "--num-hidden", "32")
+    import re
+
+    m = re.search(r"final frame accuracy: ([\d.]+)", out)
+    assert m, out[-1500:]
+    assert float(m.group(1)) > 0.7, out[-500:]
