@@ -43,8 +43,12 @@ def _find_lib():
             with open(lock_path, "w") as lock:
                 fcntl.flock(lock, fcntl.LOCK_EX)  # winner builds, rest wait
                 if not os.path.exists(built):
-                    subprocess.run(["make", "-C", native_dir], check=True,
-                                   capture_output=True, timeout=120)
+                    # build only the core library: the predict shim needs
+                    # python3-config --embed and must not take libmxtpu.so
+                    # down with it on hosts without python dev headers
+                    subprocess.run(["make", "-C", native_dir, "libmxtpu.so"],
+                                   check=True, capture_output=True,
+                                   timeout=120)
         except Exception:
             return None
         if os.path.exists(built):

@@ -144,6 +144,18 @@ class ImageAugmenter:
         self.crop_x_start = int(crop_x_start)
         self.max_crop_size = int(max_crop_size)
         self.min_crop_size = int(min_crop_size)
+        if self.max_crop_size > 0 or self.min_crop_size > 0:
+            # reference CHECKs res.cols >= max_crop_size >= min_crop_size
+            # (`image_augmenter.h:233-253`); a lone min_crop_size would make
+            # randint(lo, max+1) an inverted range producing garbage sizes
+            if self.max_crop_size <= 0:
+                raise MXNetError(
+                    "min_crop_size=%d requires max_crop_size > 0"
+                    % self.min_crop_size)
+            if 0 < self.max_crop_size < self.min_crop_size:
+                raise MXNetError(
+                    "max_crop_size=%d < min_crop_size=%d"
+                    % (self.max_crop_size, self.min_crop_size))
         self.inter_method = int(inter_method)  # accepted; bilinear used
         self._mean = None
         self._mean_path = None
@@ -271,6 +283,10 @@ class ImageAugmenter:
         (`image_augmenter.h:233-253`), folded into one bilinear resample."""
         n, c, h, w = x.shape
         kh_, kw_ = out_hw
+        if self.max_crop_size > min(h, w):
+            raise MXNetError(
+                "max_crop_size=%d exceeds image size %dx%d"
+                % (self.max_crop_size, h, w))
         kcs, ky, kx = jax.random.split(key, 3)
         lo = self.min_crop_size if self.min_crop_size > 0 \
             else self.max_crop_size

@@ -317,6 +317,16 @@ class ExportedPredictor:
             raise MXNetError("ExportedPredictor: call forward() first")
         return np.asarray(self._outputs[index])
 
+    @property
+    def num_outputs(self):
+        return len(self._out_shapes)
+
+    @property
+    def output_shapes(self):
+        # native/predict_api.cc MXPredGetOutputShape reads this on every
+        # handle kind — artifact handles must serve it like Predictor does
+        return list(self._out_shapes)
+
     def predict(self, **inputs):
         return self.forward(**inputs).get_output(0)
 

@@ -110,12 +110,27 @@ def _model_files(tmp_path):
     return net, sym_path, params_path
 
 
+def _ensure_shim():
+    """Build the predict shim if absent; skip when unbuildable (needs
+    python3-config --embed).  The .so is never committed — it is tied to
+    the build host's libpython ABI.  Takes the same flock as
+    mxnet_tpu/_native.py so concurrent workers never interleave make."""
+    if not os.path.exists(SHIM):
+        import fcntl
+
+        with open(os.path.join(NATIVE, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(SHIM):
+                rc = subprocess.run(
+                    ["make", "-C", NATIVE, "libmxtpu_predict.so"],
+                    capture_output=True)
+                if rc.returncode != 0 or not os.path.exists(SHIM):
+                    pytest.skip("predict shim not buildable here")
+
+
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
 def test_c_driver_matches_python_predictor(tmp_path):
-    if not os.path.exists(SHIM):
-        rc = subprocess.run(["make", "-C", NATIVE], capture_output=True)
-        if rc.returncode != 0 or not os.path.exists(SHIM):
-            pytest.skip("predict shim not buildable here")
+    _ensure_shim()
 
     net, sym_path, params_path = _model_files(tmp_path)
 
@@ -151,8 +166,7 @@ def test_artifact_create_via_ctypes(tmp_path):
     detects the already-running interpreter)."""
     import ctypes
 
-    if not os.path.exists(SHIM):
-        pytest.skip("predict shim not built")
+    _ensure_shim()
     net, sym_path, params_path = _model_files(tmp_path)
     pred = predictor.Predictor(sym_path, params_path, {"data": (2, 6)})
     artifact = str(tmp_path / "model.mxa")
@@ -160,11 +174,22 @@ def test_artifact_create_via_ctypes(tmp_path):
     x = np.linspace(-1, 1, 12, dtype=np.float32).reshape(2, 6)
     expect = pred.predict(data=x)
 
-    lib = ctypes.CDLL(SHIM)
+    try:
+        lib = ctypes.CDLL(SHIM)
+    except OSError as e:  # stale .so from a different libpython ABI
+        pytest.skip("predict shim not loadable here: %s" % e)
     lib.MXGetLastError.restype = ctypes.c_char_p
     h = ctypes.c_void_p()
     rc = lib.MXPredCreateFromArtifact(artifact.encode(), ctypes.byref(h))
     assert rc == 0, lib.MXGetLastError()
+    # the standard C consumer flow reads the output shape before the
+    # output; artifact handles must serve it like MXPredCreate handles
+    oshape = ctypes.POINTER(ctypes.c_uint)()
+    ondim = ctypes.c_uint(0)
+    rc = lib.MXPredGetOutputShape(h, 0, ctypes.byref(oshape),
+                                  ctypes.byref(ondim))
+    assert rc == 0, lib.MXGetLastError()
+    assert tuple(oshape[i] for i in range(ondim.value)) == expect.shape
     buf = np.ascontiguousarray(x, np.float32)
     rc = lib.MXPredSetInput(
         h, b"data", buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

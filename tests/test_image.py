@@ -283,3 +283,18 @@ def test_image_record_iter_accepts_full_param_set(tmp_path):
     b = next(it)
     assert b.data[0].shape == (3, 3, 32, 32)
     assert np.isfinite(b.data[0].asnumpy()).all()
+
+
+def test_crop_size_params_validated():
+    from mxnet_tpu.image import ImageAugmenter
+
+    # lone min_crop_size would make randint(lo, max+1) an inverted range
+    with pytest.raises(MXNetError):
+        ImageAugmenter(data_shape=(3, 8, 8), min_crop_size=4)
+    with pytest.raises(MXNetError):
+        ImageAugmenter(data_shape=(3, 8, 8), min_crop_size=6,
+                       max_crop_size=4)
+    # crop size larger than the image is rejected at augment time
+    aug = ImageAugmenter(data_shape=(3, 8, 8), max_crop_size=32)
+    with pytest.raises(MXNetError):
+        aug(make_batch(h=16, w=16))
