@@ -69,12 +69,18 @@ def transformer_block(x, name, seq_len, num_heads, num_embed,
 
 def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
                        num_embed=128, num_ffn_hidden=None, dropout=0.0,
-                       causal=True):
+                       causal=True, fused_head=False):
     """Decoder-only LM.  data: (batch, seq) token ids; softmax_label:
     (batch, seq) next-token ids.  Loss rows are position-major like the
     reference's unrolled-LSTM head (`example/rnn/lstm.py:102-104`) is
     batch-major — here rows stay (batch*seq, vocab) with labels reshaped to
-    match."""
+    match.
+
+    ``fused_head=True`` replaces FullyConnected+SoftmaxOutput with the
+    flash-style `FusedSoftmaxCE` head (identical parameter names/shapes and
+    gradients; the output becomes per-token NLL instead of the (tokens,
+    vocab) probabilities — the training-speed configuration, since the
+    logits never touch HBM)."""
     if num_embed % num_heads != 0:
         raise ValueError("num_embed must be divisible by num_heads")
     if num_ffn_hidden is None:
@@ -96,7 +102,10 @@ def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
 
     x = sym.LayerNorm(data=x, name="final_ln")
     xf = sym.Reshape(data=x, shape=(-1, num_embed), name="final_flat")
-    logits = sym.FullyConnected(data=xf, num_hidden=vocab_size, name="pred")
     label = sym.Variable("softmax_label")
     label_flat = sym.Reshape(data=label, shape=(-1,), name="label_flat")
+    if fused_head:
+        return sym.FusedSoftmaxCE(data=xf, label=label_flat,
+                                  num_hidden=vocab_size, name="pred")
+    logits = sym.FullyConnected(data=xf, num_hidden=vocab_size, name="pred")
     return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -185,6 +187,79 @@ class SoftmaxCrossEntropy(OpDef):
 
 
 register(SoftmaxCrossEntropy)
+
+
+# -- FusedSoftmaxCE (flash-style projection + CE head) --------------------
+
+
+class FusedSoftmaxCE(OpDef):
+    """Fused FullyConnected+SoftmaxOutput head; logits never materialize.
+
+    Flash-style projection + CE loss (`ops/pallas_kernels/fused_ce.py`):
+    the (tokens x vocab) logit matrix never touches HBM.  Combines `fully_connected-inl.h` and `softmax_output-inl.h` semantics:
+    forward outputs the per-token negative log-likelihood of
+    ``softmax(data @ weight.T + bias)`` at ``label`` (float32, shape
+    (tokens,)); the training gradient is the loss-head rule
+    ``dlogits = (softmax - onehot(label)) * grad_scale`` with the incoming
+    cotangent ignored, exactly like SoftmaxOutput — so swapping the dense
+    head for this one leaves every parameter gradient unchanged.
+
+    Weight/bias naming matches FullyConnected ((num_hidden, features) /
+    (num_hidden,)), so checkpoints are interchangeable with the dense head.
+    """
+
+    name = "FusedSoftmaxCE"
+    params = {
+        "num_hidden": Param(int, required=True),
+        "grad_scale": Param(float, default=1.0),
+        "ignore_label": Param(float, default=-1.0),
+        "use_ignore": Param(bool, default=False),
+        "no_bias": Param(bool, default=False),
+        "block_n": Param(int, default=512),
+        "block_v": Param(int, default=2048),
+    }
+
+    def list_arguments(self, params):
+        args = ["data", "weight"]
+        if not params["no_bias"]:
+            args.append("bias")
+        return args + ["label"]
+
+    def infer_shape(self, params, in_shapes):
+        nh = params["num_hidden"]
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        if len(d) < 2:
+            raise MXNetError(
+                "FusedSoftmaxCE: data must be (batch, ...) with at least "
+                "2 dims, got %s" % (d,))
+        flat = int(np.prod(d[1:]))
+        shapes = [d, (nh, flat)]
+        if not params["no_bias"]:
+            shapes.append((nh,))
+        shapes.append((d[0],))
+        return shapes, [(d[0],)], []
+
+    def apply(self, octx, params, inputs, aux):
+        from .pallas_kernels.fused_ce import fused_softmax_ce
+
+        x = inputs[0].reshape(inputs[0].shape[0], -1)
+        w = inputs[1]
+        b = None if params["no_bias"] else inputs[2]
+        label = inputs[-1]
+        nll = fused_softmax_ce(
+            x, w, b, label,
+            grad_scale=params["grad_scale"],
+            ignore_label=params["ignore_label"],
+            use_ignore=params["use_ignore"],
+            block_n=params["block_n"],
+            block_v=params["block_v"],
+        )
+        return [nll], []
+
+
+register(FusedSoftmaxCE)
 
 
 # -- IdentityAttachKLSparseReg -------------------------------------------

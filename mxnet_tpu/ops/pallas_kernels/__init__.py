@@ -10,5 +10,6 @@ Every kernel has a pure-jnp blockwise fallback with identical math, used on
 non-TPU backends (the 8-device CPU test mesh) and as the reference in tests.
 """
 from .flash_attention import flash_attention
+from .fused_ce import fused_softmax_ce
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_softmax_ce"]

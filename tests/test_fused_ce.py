@@ -1,0 +1,236 @@
+"""FusedSoftmaxCE: flash-style projection+CE head.
+
+Contract: identical loss values and parameter gradients to the dense
+FullyConnected -> SoftmaxOutput composite it replaces (reference semantics
+`fully_connected-inl.h` + `softmax_output-inl.h`), without materializing
+the (tokens, vocab) logits.  The Pallas TPU kernels are checked against the
+jnp fallback on real hardware (tests/test_tpu_kernels.py-style gate);
+everything here runs the fallback on CPU.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.pallas_kernels.fused_ce import fused_softmax_ce
+
+
+def _dense_ref(x, w, b, label):
+    logits = x.astype(np.float32) @ w.astype(np.float32).T + b
+    m = logits.max(axis=1, keepdims=True)
+    lse = (m + np.log(np.exp(logits - m).sum(axis=1, keepdims=True)))[:, 0]
+    picked = logits[np.arange(len(label)), label.astype(int)]
+    return lse - picked
+
+
+def _make(n=24, d=16, v=37, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(dtype) * 0.5
+    w = rng.randn(v, d).astype(dtype) * 0.3
+    b = rng.randn(v).astype(np.float32) * 0.1
+    label = rng.randint(0, v, (n,)).astype(np.float32)
+    return x, w, b, label
+
+
+def test_forward_matches_dense():
+    x, w, b, label = _make()
+    nll = np.asarray(fused_softmax_ce(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), jnp.asarray(label),
+        block_v=16))  # forces multiple tiles + a ragged last tile
+    np.testing.assert_allclose(nll, _dense_ref(x, w, b, label),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_dense_head_composite():
+    """vjp through the fused op == vjp through FC+SoftmaxOutput with the
+    all-ones cotangent the training loop uses."""
+    x, w, b, label = _make(n=20, d=12, v=29)
+    xj, wj, bj, lj = map(jnp.asarray, (x, w, b, label))
+
+    # loss-head semantics: cotangent is ignored, so drive vjp directly
+    _, vjp = jax.vjp(
+        lambda x_, w_, b_: fused_softmax_ce(x_, w_, b_, lj, block_v=8),
+        xj, wj, bj)
+    dx, dw, db = vjp(jnp.ones((len(x),), jnp.float32))
+
+    # dense composite with identical numerics
+    from mxnet_tpu.ops.loss import _softmax_output
+
+    def dense(x_, w_, b_):
+        logits = x_ @ w_.T + b_
+        return _softmax_output(logits, lj, 1.0, -1.0, False, False)
+
+    _, vjp_d = jax.vjp(dense, xj, wj, bj)
+    probs = np.asarray(dense(xj, wj, bj))
+    dx_d, dw_d, db_d = vjp_d(jnp.ones_like(jnp.asarray(probs)))
+
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_d),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_d),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(db_d),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grad_scale_scales_grads_not_loss():
+    x, w, b, label = _make(n=8, d=8, v=11)
+    xj, wj, bj, lj = map(jnp.asarray, (x, w, b, label))
+
+    def run(gs):
+        out, vjp = jax.vjp(
+            lambda x_: fused_softmax_ce(x_, wj, bj, lj, grad_scale=gs,
+                                        block_v=4), xj)
+        (dx,) = vjp(jnp.ones_like(out))
+        return np.asarray(out), np.asarray(dx)
+
+    nll1, dx1 = run(1.0)
+    nll2, dx2 = run(2.5)
+    np.testing.assert_allclose(nll1, nll2, rtol=1e-6)
+    np.testing.assert_allclose(dx2, dx1 * 2.5, rtol=1e-5, atol=1e-6)
+
+
+def test_use_ignore_masks_rows():
+    x, w, b, label = _make(n=10, d=8, v=13)
+    label = np.arange(10, dtype=np.float32)
+    label[5] = 6.0  # keep the ignore class only on rows 3 and 7
+    label[3] = label[7] = 5.0
+    xj, wj, bj = map(jnp.asarray, (x, w, b))
+    lj = jnp.asarray(label)
+    out, vjp = jax.vjp(
+        lambda x_: fused_softmax_ce(x_, wj, bj, lj, ignore_label=5.0,
+                                    use_ignore=True, block_v=8), xj)
+    (dx,) = vjp(jnp.ones_like(out))
+    out, dx = np.asarray(out), np.asarray(dx)
+    assert out[3] == 0.0 and out[7] == 0.0
+    assert np.all(out[[0, 1, 2, 4, 5, 6, 8, 9]] > 0)
+    np.testing.assert_allclose(dx[3], 0.0, atol=1e-7)
+    np.testing.assert_allclose(dx[7], 0.0, atol=1e-7)
+    assert np.abs(dx[0]).max() > 0
+
+
+def test_symbol_op_shapes_and_executor():
+    """FusedSoftmaxCE as a Symbol: shape inference + bound train step, and
+    weight grads equal the dense head's through the executor path."""
+    v, d, n = 21, 10, 12
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    net = mx.sym.FusedSoftmaxCE(data=data, label=label, num_hidden=v,
+                                name="pred")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(n, d),
+                                                softmax_label=(n,))
+    assert out_shapes == [(n,)]
+    shape_of = dict(zip(net.list_arguments(), arg_shapes))
+    assert shape_of["pred_weight"] == (v, d)
+    assert shape_of["pred_bias"] == (v,)
+
+    dense = mx.sym.SoftmaxOutput(
+        data=mx.sym.FullyConnected(data=data, num_hidden=v, name="pred"),
+        label=label, name="softmax")
+
+    rng = np.random.RandomState(3)
+    args = {"data": mx.nd.array(rng.randn(n, d).astype(np.float32)),
+            "softmax_label": mx.nd.array(
+                rng.randint(0, v, (n,)).astype(np.float32)),
+            "pred_weight": mx.nd.array(
+                rng.randn(v, d).astype(np.float32) * 0.2),
+            "pred_bias": mx.nd.array(np.zeros(v, np.float32))}
+
+    grads = {}
+    for which, s in (("fused", net), ("dense", dense)):
+        g = {k: mx.nd.zeros(a.shape) for k, a in args.items()}
+        exe = s.bind(mx.cpu(), {k: a.copy() for k, a in args.items()},
+                     args_grad=g)
+        exe.forward(is_train=True)
+        exe.backward()
+        grads[which] = {k: a.asnumpy() for k, a in g.items()}
+
+    for k in ("pred_weight", "pred_bias", "data"):
+        np.testing.assert_allclose(grads["fused"][k], grads["dense"][k],
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg="grad mismatch for %s" % k)
+
+
+def test_transformer_fused_head_grads_match_dense():
+    """End-to-end: get_transformer_lm(fused_head=True) must produce the
+    same parameter gradients as the dense-head model."""
+    from mxnet_tpu import models
+
+    vocab, seq, batch = 19, 6, 4
+    kwargs = dict(vocab_size=vocab, seq_len=seq, num_layers=1, num_heads=2,
+                  num_embed=16)
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, vocab, (batch, seq)).astype(np.float32)
+    Y = rng.randint(0, vocab, (batch, seq)).astype(np.float32)
+
+    grads = {}
+    for which, fused in (("fused", True), ("dense", False)):
+        net = models.get_transformer_lm(fused_head=fused, **kwargs)
+        arg_shapes, _, _ = net.infer_shape(data=(batch, seq),
+                                           softmax_label=(batch, seq))
+        prng = np.random.RandomState(7)
+        args, g = {}, {}
+        for name, s in zip(net.list_arguments(), arg_shapes):
+            if name == "data":
+                args[name] = mx.nd.array(X)
+            elif name == "softmax_label":
+                args[name] = mx.nd.array(Y)
+            else:
+                args[name] = mx.nd.array(
+                    prng.randn(*s).astype(np.float32) * 0.1)
+            g[name] = mx.nd.zeros(s)
+        exe = net.bind(mx.cpu(), args, args_grad=g)
+        exe.forward(is_train=True)
+        exe.backward()
+        grads[which] = {k: a.asnumpy() for k, a in g.items()}
+
+    for k in grads["fused"]:
+        if k in ("data", "softmax_label"):
+            continue
+        np.testing.assert_allclose(
+            grads["fused"][k], grads["dense"][k], rtol=2e-4, atol=1e-5,
+            err_msg="grad mismatch for %s" % k)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="pallas kernels need real TPU")
+def test_pallas_matches_jnp_on_tpu():
+    """The Pallas forward/backward kernels vs the jnp fallback, on-chip,
+    at shapes that take the kernel path (round-2 lesson: the interpreter
+    passing is not evidence — verify lowering on hardware)."""
+    from mxnet_tpu.ops.pallas_kernels import fused_ce
+
+    n, d, v = 1024, 256, 4100  # ragged vocab tile + padded tokens
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, d).astype(np.float32) * 0.5,
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.randn(v, d).astype(np.float32) * 0.3, jnp.bfloat16)
+    b = jnp.asarray(rng.randn(v).astype(np.float32) * 0.1, jnp.bfloat16)
+    label = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
+
+    assert fused_ce._use_pallas(x, w)
+    fwd_p = jax.jit(lambda: fused_ce._fwd_pallas(
+        x, w, b, label, 1.0, -1.0, False, 512, 2048))
+    fwd_j = jax.jit(lambda: fused_ce._fwd_jnp(
+        x, w, b, label, 1.0, -1.0, False, 2048))
+    (nll_p, lse_p), (nll_j, lse_j) = fwd_p(), fwd_j()
+    np.testing.assert_allclose(np.asarray(nll_p), np.asarray(nll_j),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lse_p), np.asarray(lse_j),
+                               rtol=2e-3, atol=2e-3)
+
+    bwd_p = jax.jit(lambda: fused_ce._bwd_pallas(
+        x, w, b, label, lse_j, 1.0, -1.0, False, 512, 2048))
+    bwd_j = jax.jit(lambda: fused_ce._bwd_jnp(
+        x, w, b, label, lse_j, 1.0, -1.0, False, 2048))
+    (dx_p, dw_p, db_p), (dx_j, dw_j, db_j) = bwd_p(), bwd_j()
+    np.testing.assert_allclose(np.asarray(dx_p, np.float32),
+                               np.asarray(dx_j, np.float32),
+                               rtol=5e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dw_p, np.float32),
+                               np.asarray(dw_j, np.float32),
+                               rtol=5e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(db_p, np.float32),
+                               np.asarray(db_j, np.float32),
+                               rtol=5e-2, atol=2e-3)

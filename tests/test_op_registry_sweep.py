@@ -30,6 +30,7 @@ PARAMS = {
     "Pooling": {"kernel": (2, 2), "stride": (2, 2)},
     "Activation": {"act_type": "relu"},
     "FullyConnected": {"num_hidden": 6},
+    "FusedSoftmaxCE": {"num_hidden": 6},
     "Embedding": {"input_dim": 11, "output_dim": 5},
     "Reshape": {"target_shape": (0, 192)},
     "SliceChannel": {"num_outputs": 2},

@@ -41,12 +41,18 @@ def main():
     V = int(os.environ.get("TBENCH_VOCAB", "32768"))
     steps = int(os.environ.get("TBENCH_STEPS", "15"))
     reps = int(os.environ.get("TBENCH_REPS", "3"))
+    # fused head: measured faster per-step on single-dispatch but slower
+    # under the scan-fused run_steps path (see docs/mfu_roofline.md round-3
+    # notes) — default stays dense until that interaction is resolved
+    fused = os.environ.get("TBENCH_FUSED_HEAD", "0").lower() in (
+        "1", "true", "yes")
     dtype = os.environ.get("TBENCH_DTYPE", "bfloat16")
     if dtype == "bfloat16":
         from mxnet_tpu.base import bfloat16 as dtype
 
     net = models.get_transformer_lm(
-        vocab_size=V, seq_len=S, num_layers=L, num_heads=H, num_embed=D)
+        vocab_size=V, seq_len=S, num_layers=L, num_heads=H, num_embed=D,
+        fused_head=fused)
     n_dev = len(jax.devices())
     n_dev = next(k for k in range(n_dev, 0, -1) if B % k == 0)
     mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
@@ -79,8 +85,9 @@ def main():
     print(json.dumps({
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_dev, 1),
-        "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d S=%d B=%d, %s)"
-                % (mfu, L, D, S, B, np.dtype(dtype).name),
+        "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d S=%d B=%d, %s, %s head)"
+                % (mfu, L, D, S, B, np.dtype(dtype).name,
+                   "fused" if fused else "dense"),
         "vs_baseline": None,
     }))
 
