@@ -41,9 +41,70 @@ from .ops.registry import OpCtx
 from .symbol import Symbol, _topo_order
 
 
+def _mirror_segments(order):
+    """Plan the MXNET_BACKWARD_MIRROR_STEP rematerialization regions.
+
+    The reference keeps every MIRROR_STEP-th eligible node as a checkpoint
+    boundary and recomputes the nodes in between during backward
+    (`static_graph.cc:423-438`).  The XLA form of the same trade: group
+    consecutive graph nodes into segments of ``step`` ops, wrap each
+    segment in `jax.checkpoint` — segment boundaries are stored across
+    fwd->bwd, interiors are recomputed (sqrt-checkpointing over the Symbol
+    graph; for a transformer, step ≈ nodes-per-block gives per-layer
+    remat).
+
+    Per-node overrides via the reference's `force_mirroring` attr:
+    ``"0"``/``"False"`` pins the node as a boundary (its outputs always
+    stored); anything truthy keeps it inside a remat segment even where
+    the step count would cut one.
+
+    Returns None when MXNET_BACKWARD_MIRROR_STEP is unset, else a list of
+    (nodes, remat) runs covering `order` in topo sequence.
+    """
+    step_env = os.environ.get("MXNET_BACKWARD_MIRROR_STEP", "")
+    if not step_env:
+        return None
+    step = max(int(step_env), 1)
+
+    def boundary_attr(node):
+        v = (node.attrs or {}).get("force_mirroring")
+        if v is None:
+            return None
+        return str(v).lower() in ("0", "false")
+
+    segments = []
+    run, count = [], 0
+    for node in order:
+        if node.is_variable:
+            # variables carry no compute; flush so bound args stay plain
+            if run:
+                segments.append((run, True))
+                run, count = [], 0
+            segments.append(([node], False))
+            continue
+        forced_boundary = boundary_attr(node)
+        if forced_boundary:
+            if run:
+                segments.append((run, True))
+                run, count = [], 0
+            segments.append(([node], False))
+            continue
+        run.append(node)
+        count += 1
+        if count >= step and forced_boundary is None:
+            segments.append((run, True))
+            run, count = [], 0
+    if run:
+        segments.append((run, True))
+    return segments
+
+
 def _build_graph_fn(symbol: Symbol):
     """Trace plan: returns fn(arg_arrays, aux_arrays, rng, is_train) ->
-    (outputs, new_aux).  Pure — jit/vjp/pjit compose over it."""
+    (outputs, new_aux).  Pure — jit/vjp/pjit compose over it.
+
+    When MXNET_BACKWARD_MIRROR_STEP is set, node runs execute inside
+    `jax.checkpoint` segments (see `_mirror_segments`)."""
     heads = symbol._heads
     order = _topo_order(heads)
     arg_names = symbol.list_arguments()
@@ -57,19 +118,18 @@ def _build_graph_fn(symbol: Symbol):
             if k:
                 aux_slots[id(node)] = (n_aux, n_aux + k)
                 n_aux += k
+    seq_of = {id(node): seq for seq, node in enumerate(order)}
+    segments = _mirror_segments(order)
 
-    def fn(arg_arrays, aux_arrays, rng, is_train):
-        env = {}
-        new_aux = list(aux_arrays)
-        for seq, node in enumerate(order):
+    def _run_nodes(nodes, env, new_aux, rng, is_train):
+        for node in nodes:
             if node.is_variable:
-                env[(id(node), 0)] = arg_arrays[arg_index[node.name]]
                 continue
             inputs = [env[(id(s), i)] for s, i in node.inputs]
             lo, hi = aux_slots.get(id(node), (0, 0))
             aux_in = new_aux[lo:hi]
             key = (
-                jax.random.fold_in(rng, seq)
+                jax.random.fold_in(rng, seq_of[id(node)])
                 if getattr(node.op, "need_rng", False) and rng is not None
                 else None
             )
@@ -80,8 +140,88 @@ def _build_graph_fn(symbol: Symbol):
             for i, u in enumerate(aux_up):
                 if u is not None:
                     new_aux[lo + i] = u
+
+    def _plain_fn(arg_arrays, aux_arrays, rng, is_train):
+        env = {}
+        new_aux = list(aux_arrays)
+        for node in order:
+            if node.is_variable:
+                env[(id(node), 0)] = arg_arrays[arg_index[node.name]]
+            else:
+                _run_nodes([node], env, new_aux, rng, is_train)
         outputs = tuple(env[(id(n), i)] for n, i in heads)
         return outputs, tuple(new_aux)
+
+    if segments is None:
+        fn = _plain_fn
+    else:
+        # static plan per segment: which env entries flow in (produced
+        # before) and out (consumed after, or graph heads)
+        head_keys = {(id(n), i) for n, i in heads}
+        plans = []
+        for nodes, remat in segments:
+            in_keys = []
+            local = set()
+            for node in nodes:
+                if node.is_variable:
+                    local.add((id(node), 0))
+                    continue
+                for s, i in node.inputs:
+                    k = (id(s), i)
+                    if k not in local and k not in in_keys:
+                        in_keys.append(k)
+                for i in range(len(node.op.list_outputs(node.params))):
+                    local.add((id(node), i))
+            plans.append((nodes, remat, in_keys, sorted(local)))
+        # entries needed after each segment: consumed by later segments or
+        # heads — only those are segment outputs (the checkpoint boundary)
+        needed_later = [set() for _ in plans]
+        running = set(head_keys)
+        for idx in range(len(plans) - 1, -1, -1):
+            nodes, _, in_keys, local = plans[idx]
+            needed_later[idx] = {k for k in local if k in running}
+            running |= set(in_keys)
+        segment_plans = [
+            (nodes, remat, in_keys, sorted(needed_later[idx]))
+            for idx, (nodes, remat, in_keys, _) in enumerate(plans)
+        ]
+
+        def _seg_fn(arg_arrays, aux_arrays, rng, is_train):
+            env = {}
+            new_aux = list(aux_arrays)
+            for nodes, remat, in_keys, out_keys in segment_plans:
+                if nodes[0].is_variable:
+                    node = nodes[0]
+                    env[(id(node), 0)] = arg_arrays[arg_index[node.name]]
+                    continue
+                aux_ranges = [aux_slots[id(n)] for n in nodes
+                              if id(n) in aux_slots]
+                if not remat or not is_train:
+                    _run_nodes(nodes, env, new_aux, rng, is_train)
+                    continue
+
+                def seg(in_vals, aux_vals, nodes=nodes, in_keys=in_keys,
+                        out_keys=out_keys, aux_ranges=aux_ranges):
+                    local_env = dict(zip(in_keys, in_vals))
+                    local_aux = [None] * len(new_aux)  # only own slots used
+                    for (lo, hi), vals in zip(aux_ranges, aux_vals):
+                        local_aux[lo:hi] = vals
+                    _run_nodes(nodes, local_env, local_aux, rng, is_train)
+                    return ([local_env[k] for k in out_keys],
+                            [local_aux[lo:hi] for lo, hi in aux_ranges])
+
+                seg = jax.checkpoint(
+                    seg, policy=jax.checkpoint_policies.nothing_saveable)
+                outs, aux_outs = seg(
+                    [env[k] for k in in_keys],
+                    [new_aux[lo:hi] for lo, hi in aux_ranges])
+                env.update(zip(out_keys, outs))
+                for (lo, hi), vals in zip(aux_ranges, aux_outs):
+                    new_aux[lo:hi] = vals
+            outputs = tuple(env[(id(n), i)] for n, i in heads)
+            return outputs, tuple(new_aux)
+
+        fn = _seg_fn
 
     internal_entries = []
     for node in order:
@@ -99,6 +239,47 @@ def _mirror_saveable(prim, *_, **__):
     primitive results, rematerialize the rest (the reference's rule that
     Convolution/FullyConnected are never mirrored, `static_graph.cc:423-438`)."""
     return prim.name in ("dot_general", "conv_general_dilated")
+
+
+def _mirror_policy():
+    """Whole-graph rematerialization policy from the environment.
+
+    The reference's mirroring plan is tunable per run and per node
+    (`MXNET_BACKWARD_DO_MIRROR`, `MXNET_BACKWARD_MIRROR_STEP`, node attr
+    `force_mirroring`; `static_graph.cc:410-560`).  The XLA counterpart is
+    a `jax.checkpoint` policy choosing which fwd values survive to bwd:
+
+    MXNET_BACKWARD_MIRROR_POLICY =
+      ``dots``    save dot/conv results, remat elementwise/BN (the
+                  round-2 MXNET_BACKWARD_DO_MIRROR=1 behavior; right for
+                  conv nets, wrong for transformers where dot results are
+                  most activations)
+      ``attn``    save only attention-op outputs (`checkpoint_name` tag
+                  "attn_out"), remat projections/FFN/LN — the transformer
+                  memory policy
+      ``nothing`` save nothing inside the step, recompute the whole
+                  forward in backward
+
+    MXNET_BACKWARD_DO_MIRROR=1 with no POLICY keeps meaning ``dots``.
+    Returns a jax.checkpoint policy or None (XLA's default).  Segment
+    (step-k) remat is separate — see `_mirror_segments`.
+    """
+    pol = os.environ.get("MXNET_BACKWARD_MIRROR_POLICY", "").lower()
+    if not pol or pol == "none":
+        if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").lower() in (
+                "1", "true", "yes"):
+            pol = "dots"
+        else:
+            return None
+    if pol == "dots":
+        return _mirror_saveable
+    if pol in ("attn", "attn_out"):
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if pol == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    raise MXNetError(
+        "MXNET_BACKWARD_MIRROR_POLICY must be one of none/dots/attn/"
+        "nothing, got %r" % pol)
 
 
 def _as_list(arrays, names, what, allow_missing=False):
@@ -187,13 +368,12 @@ class Executor:
         # mirroring (`static_graph.cc:423-438`); the jax.checkpoint policy
         # below is the same trade — MXU-heavy primitive results are saved,
         # everything else is rematerialized.
-        do_mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").lower() in (
-            "1", "true", "yes")
+        mirror_policy = _mirror_policy()
 
         def train_step(args, aux, rng, cots):
             f = lambda a: fn(a, aux, rng, True)
-            if do_mirror:
-                f = jax.checkpoint(f, policy=_mirror_saveable)
+            if mirror_policy is not None:
+                f = jax.checkpoint(f, policy=mirror_policy)
             outs, vjp_fn, new_aux = jax.vjp(f, args, has_aux=True)
             (grads,) = vjp_fn(cots)
             return outs, new_aux, grads

@@ -71,6 +71,10 @@ class DotProductAttention(OpDef):
             block_q=params["block_q"],
             block_k=params["block_k"],
         )
+        # tag for MXNET_BACKWARD_MIRROR_POLICY=attn (save attention
+        # outputs, rematerialize everything else — executor._mirror_policy)
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "attn_out")
         return [out], []
 
 

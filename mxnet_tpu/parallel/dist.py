@@ -19,12 +19,43 @@ import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
 from ..base import MXNetError
 from ..kvstore import KVStore
 from ..ndarray import NDArray, array
+
+
+def _num_servers():
+    return max(1, int(os.environ.get("DMLC_NUM_SERVER", "1")))
+
+
+def _bigarray_bound():
+    """Arrays >= this many elements are range-partitioned over all servers;
+    smaller ones live whole on one hashed server (the reference's
+    `EncodeKey` split rule, `kvstore_dist.h:230-268`,
+    `MXNET_KVSTORE_BIGARRAY_BOUND`)."""
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
+
+
+def _server_of(key, num_servers):
+    """Stable key->server hash for small arrays (Python's hash() is
+    per-process salted; crc32 is not)."""
+    return zlib.crc32(str(key).encode()) % num_servers
+
+
+def _shard_slices(size, num_servers):
+    """Even contiguous ranges of a flattened big array, one per server
+    (server i may get one extra element when size % num_servers != 0)."""
+    base, rem = divmod(size, num_servers)
+    slices, start = [], 0
+    for i in range(num_servers):
+        n = base + (1 if i < rem else 0)
+        slices.append((start, start + n))
+        start += n
+    return slices
 
 
 def _send_msg(sock, obj):
@@ -283,30 +314,40 @@ class ParameterServer:
 
 class DistKVStore(KVStore):
     """Worker-side distributed store (`kvstore_dist.h`): local merge then
-    push/pull to the server; rank 0 inits (`kvstore_dist.h:49-60`)."""
+    push/pull to the server(s); rank 0 inits (`kvstore_dist.h:49-60`).
+
+    With DMLC_NUM_SERVER > 1 keys shard the reference way
+    (`EncodeKey`, `kvstore_dist.h:230-268`): small arrays whole on one
+    hashed server, big arrays range-partitioned over all servers — server
+    ``i`` listens on DMLC_PS_ROOT_PORT + i."""
 
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
         uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
-        self._addr = (uri, port)
-        # the server process imports jax before it binds; retry refused
-        # connections until it is up (`ps::Postoffice` handshakes similarly)
+        self.num_servers = _num_servers()
+        self._addrs = [(uri, port + i) for i in range(self.num_servers)]
+        self._bigarray_bound = _bigarray_bound()
+        # the server processes import jax before they bind; retry refused
+        # connections until each is up (`ps::Postoffice` handshakes similarly)
         deadline = time.time() + float(
             os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
-        while True:
-            try:
-                self._sock = socket.create_connection(self._addr, timeout=120)
-                break
-            except (ConnectionRefusedError, OSError):
-                if time.time() > deadline:
-                    raise MXNetError(
-                        "cannot reach parameter server at %s:%d"
-                        % self._addr)
-                time.sleep(0.2)
-        self._sock_lock = threading.Lock()
+        self._socks = []
+        for addr in self._addrs:
+            while True:
+                try:
+                    self._socks.append(
+                        socket.create_connection(addr, timeout=120))
+                    break
+                except (ConnectionRefusedError, OSError):
+                    if time.time() > deadline:
+                        raise MXNetError(
+                            "cannot reach parameter server at %s:%d" % addr)
+                    time.sleep(0.2)
+        self._sock_locks = [threading.Lock() for _ in self._socks]
         if "async" in kv_type:
-            self._rpc({"op": "set_sync", "sync": False})
+            for sid in range(self.num_servers):
+                self._rpc({"op": "set_sync", "sync": False}, server=sid)
         # heartbeat on its own connection so a long-blocked push/barrier on
         # the main socket doesn't starve liveness reporting
         interval = float(os.environ.get("MXNET_PS_HEARTBEAT_INTERVAL", "5"))
@@ -320,53 +361,78 @@ class DistKVStore(KVStore):
         # A transient socket error must not silence liveness reporting for
         # the rest of the job (the watchdog would then falsely declare this
         # rank dead and poison every blocked BSP waiter): reconnect with
-        # capped exponential backoff instead of exiting.
-        sock = None
-        backoff = min(interval, 1.0)
+        # capped exponential backoff instead of exiting.  Backoff state is
+        # PER SERVER, and reconnect attempts use a short timeout, so one
+        # partitioned server can never starve heartbeats to healthy ones
+        # past their watchdog window.
+        socks = [None] * self.num_servers
+        backoff = [min(interval, 1.0)] * self.num_servers
+        next_try = [0.0] * self.num_servers
+        connect_timeout = min(interval, 5.0)
         while not self._hb_stop.is_set():
-            if sock is None:
+            now = time.time()
+            for sid, addr in enumerate(self._addrs):
+                if socks[sid] is None:
+                    if now < next_try[sid]:
+                        continue
+                    try:
+                        socks[sid] = socket.create_connection(
+                            addr, timeout=connect_timeout)
+                        backoff[sid] = min(interval, 1.0)
+                    except OSError:
+                        next_try[sid] = time.time() + backoff[sid]
+                        backoff[sid] = min(backoff[sid] * 2, 30.0)
+                        continue
                 try:
-                    sock = socket.create_connection(self._addr, timeout=30)
-                    backoff = min(interval, 1.0)
+                    _send_msg(socks[sid],
+                              {"op": "heartbeat", "rank": self.rank})
+                    _recv_msg(socks[sid])
                 except OSError:
-                    if self._hb_stop.wait(backoff):
-                        break
-                    backoff = min(backoff * 2, 30.0)
-                    continue
-            try:
-                _send_msg(sock, {"op": "heartbeat", "rank": self.rank})
-                _recv_msg(sock)
-            except OSError:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                sock = None
-                continue
+                    try:
+                        socks[sid].close()
+                    except OSError:
+                        pass
+                    socks[sid] = None
+                    next_try[sid] = time.time() + backoff[sid]
+                    backoff[sid] = min(backoff[sid] * 2, 30.0)
             if self._hb_stop.wait(interval):
                 break
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        for s in socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
-    def _rpc(self, msg):
+    def _rpc(self, msg, server=0):
         msg.setdefault("rank", self.rank)
-        with self._sock_lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+        with self._sock_locks[server]:
+            _send_msg(self._socks[server], msg)
+            reply = _recv_msg(self._socks[server])
         if isinstance(reply, dict) and "error" in reply:
             raise MXNetError(reply["error"])
         return reply
+
+    def _route(self, key, size):
+        """(server, slice)-routing of one key (`EncodeKey`): whole array to
+        one hashed server when small, contiguous flat ranges over all
+        servers when size >= MXNET_KVSTORE_BIGARRAY_BOUND."""
+        if self.num_servers == 1 or size < self._bigarray_bound:
+            return [(_server_of(key, self.num_servers), None)]
+        return [(sid, sl) for sid, sl in
+                enumerate(_shard_slices(size, self.num_servers))]
 
     def init(self, key, value):
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
         for k, vlist in zip(keys, vals):
             if self.rank == 0:
-                self._rpc({"op": "init", "key": k,
-                           "value": vlist[0].asnumpy()})
+                v = vlist[0].asnumpy()
+                for sid, sl in self._route(k, v.size):
+                    shard = v if sl is None else v.reshape(-1)[sl[0]:sl[1]]
+                    self._rpc({"op": "init", "key": k,
+                               "value": np.ascontiguousarray(shard)},
+                              server=sid)
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -374,7 +440,12 @@ class DistKVStore(KVStore):
         vals = self._vallist(value, len(keys))
         for k, vlist in zip(keys, vals):
             merged = np.asarray(self._merge(vlist))
-            self._rpc({"op": "push", "key": k, "value": merged})
+            for sid, sl in self._route(k, merged.size):
+                shard = merged if sl is None \
+                    else merged.reshape(-1)[sl[0]:sl[1]]
+                self._rpc({"op": "push", "key": k,
+                           "value": np.ascontiguousarray(shard)},
+                          server=sid)
 
     def pull(self, key, out=None, priority=0):
         if out is None:
@@ -387,48 +458,67 @@ class DistKVStore(KVStore):
         else:
             outs = [[o] if isinstance(o, NDArray) else list(o) for o in out]
         for k, olist in zip(keys, outs):
-            val = self._rpc({"op": "pull", "key": k})["value"]
+            size = int(np.prod(olist[0].shape)) if olist[0].shape else 1
+            route = self._route(k, size)
+            if len(route) == 1:
+                val = self._rpc({"op": "pull", "key": k},
+                                server=route[0][0])["value"]
+            else:
+                parts = [self._rpc({"op": "pull", "key": k},
+                                   server=sid)["value"]
+                         for sid, _ in route]
+                val = np.concatenate([p.reshape(-1) for p in parts])
+                val = val.reshape(olist[0].shape)
             src = array(val)
             for o in olist:
                 src.copyto(o)
 
     def set_optimizer(self, optimizer):
         if self.rank == 0:
-            self._rpc({"op": "set_optimizer",
-                       "optimizer": pickle.dumps(optimizer)})
+            blob = pickle.dumps(optimizer)
+            for sid in range(self.num_servers):
+                self._rpc({"op": "set_optimizer", "optimizer": blob},
+                          server=sid)
         self.barrier()
 
     def barrier(self):
-        self._rpc({"op": "barrier"})
+        # one barrier authority (server 0), like the reference's scheduler
+        self._rpc({"op": "barrier"}, server=0)
 
     def stop_server(self):
         if self.rank == 0:
-            self._rpc({"op": "stop"})
+            for sid in range(self.num_servers):
+                self._rpc({"op": "stop"}, server=sid)
         self.close()
 
     def close(self):
-        """Deliberately leave the job: stop heartbeating, tell the server to
-        deregister this rank (so our silence doesn't trip the watchdog for
-        the ranks still running), and drop the connections."""
+        """Deliberately leave the job: stop heartbeating, tell the servers
+        to deregister this rank (so our silence doesn't trip the watchdog
+        for the ranks still running), and drop the connections."""
         hb = getattr(self, "_hb_stop", None)
         if hb is not None:
             hb.set()
             self._hb_thread.join(timeout=5)
-        try:
-            self._rpc({"op": "goodbye"})
-        except (OSError, MXNetError):
-            pass  # server already gone
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for sid in range(self.num_servers):
+            try:
+                self._rpc({"op": "goodbye"}, server=sid)
+            except (OSError, MXNetError):
+                pass  # server already gone
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def run_server():
     """Server-process entry (`python/mxnet/kvstore_server.py:47-68`): called
-    when DMLC_ROLE=server; blocks until kStopServer."""
+    when DMLC_ROLE=server; blocks until kStopServer.  Server ``i`` of a
+    multi-server job (DMLC_SERVER_ID, set by `tools/launch.py -s N`) binds
+    DMLC_PS_ROOT_PORT + i."""
     uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    server = ParameterServer(uri, port, num_workers)
+    server = ParameterServer(uri, port + server_id, num_workers)
     server.run()

@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import os
 
 from ..base import MXNetError
-from ..executor import _build_graph_fn, _mirror_saveable
+from ..executor import _build_graph_fn, _mirror_policy
 from ..ndarray import NDArray
 from .. import random as _random
 
@@ -68,9 +68,14 @@ def _sgd_update(params, grads, momenta, lr, momentum, wd, rescale,
 
 
 def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps,
-                 clip=None):
+                 clip=None, v_dtype=None):
     """Fused Adam with the `optimizer.Adam` numerics (wd folded into the
-    gradient, bias-corrected lr).  state: {"_t": count, k: (m, v)}."""
+    gradient, bias-corrected lr).  state: {"_t": count, k: (m, v)}.
+
+    ``v_dtype`` (e.g. bfloat16) stores the second-moment table in reduced
+    precision — the moment math stays float32, only the stored v rounds —
+    halving the biggest optimizer-state HBM stream (the embedding/head
+    tables read+written every step)."""
     t = state["_t"] + 1
     coef1 = 1 - b1 ** t
     coef2 = 1 - b2 ** t
@@ -81,8 +86,8 @@ def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps,
         g = _clip(grads[k] * rescale, clip) + wd * _wd_mult(k) * p
         m, v = state[k]
         m = b1 * m + (1 - b1) * g
-        v = b2 * v + (1 - b2) * jnp.square(g)
-        new_state[k] = (m, v)
+        v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
+        new_state[k] = (m, v.astype(v_dtype) if v_dtype else v)
         new_p[k] = p - lr_t * m / (jnp.sqrt(v) + eps)
     return new_p, new_state
 
@@ -102,7 +107,8 @@ class SPMDTrainer:
     def __init__(self, symbol, mesh, data_shapes, initializer=None, lr=0.01,
                  momentum=0.9, wd=0.0001, dtype=np.float32,
                  param_sharding=None, optimizer="sgd", beta1=0.9,
-                 beta2=0.999, epsilon=1e-8, clip_gradient=None):
+                 beta2=0.999, epsilon=1e-8, clip_gradient=None,
+                 adam_v_dtype=None):
         self.symbol = symbol
         self.mesh = mesh
         self.lr, self.momentum, self.wd = lr, momentum, wd
@@ -112,6 +118,8 @@ class SPMDTrainer:
                 "supported (got %r)" % (optimizer,))
         self.optimizer = "sgd" if optimizer == "ccsgd" else optimizer
         self._adam_hp = (beta1, beta2, epsilon)
+        # reduced-precision second-moment table (see _adam_update)
+        self._adam_v_dtype = jnp.dtype(adam_v_dtype) if adam_v_dtype else None
         self.clip_gradient = clip_gradient
         # Mixed precision, the TPU way: master params/momenta/aux stay f32,
         # compute casts to `dtype` (bf16 on the MXU) inside the jitted step,
@@ -143,11 +151,13 @@ class SPMDTrainer:
             params[n] = _put_global(host.data, sh)
         self.params = params
         if self.optimizer == "adam":
+            vdt = np.dtype(self._adam_v_dtype) if self._adam_v_dtype \
+                else np.float32
             self.momenta = {"_t": jnp.zeros((), jnp.float32)}
             self.momenta.update({
                 n: (_put_global(np.zeros(v.shape, np.float32),
                                 self._param_sharding[n]),
-                    _put_global(np.zeros(v.shape, np.float32),
+                    _put_global(np.zeros(v.shape, vdt),
                                 self._param_sharding[n]))
                 for n, v in params.items()
             })
@@ -167,13 +177,13 @@ class SPMDTrainer:
                     np.ones(self.aux[n].shape, np.float32), repl)
 
         graph_fn, _, _ = _build_graph_fn(symbol)
-        # MXNET_BACKWARD_DO_MIRROR (the reference's recompute-cheap-ops
-        # plan, `static_graph.cc:410-560`): save only MXU-heavy primitive
-        # results across fwd->bwd; rematerialize BN/relu/elementwise instead
-        # of storing AND re-reading them — trades free VPU flops for HBM
-        # traffic, the scarce resource on TPU
-        self._do_mirror = os.environ.get(
-            "MXNET_BACKWARD_DO_MIRROR", "0").lower() in ("1", "true", "yes")
+        # Rematerialization knobs (the reference's tunable mirroring plan,
+        # `static_graph.cc:410-560`): MXNET_BACKWARD_MIRROR_POLICY selects
+        # what survives fwd->bwd (dots / attn / nothing — see
+        # executor._mirror_policy); MXNET_BACKWARD_MIRROR_STEP=k adds
+        # segment remat inside _build_graph_fn.  Both trade free recompute
+        # FLOPs for HBM, the scarce resource on TPU.
+        self._mirror_policy = _mirror_policy()
         batch_sharding = NamedSharding(mesh, P("data"))
         self._batch_sharding = batch_sharding
         # stacked (nsteps, batch, ...) inputs for run_steps: steps axis
@@ -192,7 +202,8 @@ class SPMDTrainer:
             def opt_update(params, grads, state, lr):
                 return _adam_update(params, grads, state, lr, self.wd,
                                     rescale, b1, b2, eps,
-                                    clip=self.clip_gradient)
+                                    clip=self.clip_gradient,
+                                    v_dtype=self._adam_v_dtype)
         else:
             def opt_update(params, grads, state, lr):
                 return _sgd_update(params, grads, state, lr, self.momentum,
@@ -216,8 +227,8 @@ class SPMDTrainer:
                 outs, new_aux = graph_fn(args, aux_list, rng, True)
                 return outs, new_aux
 
-            if self._do_mirror:
-                f = jax.checkpoint(f, policy=_mirror_saveable)
+            if self._mirror_policy is not None:
+                f = jax.checkpoint(f, policy=self._mirror_policy)
             outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
             cot = tuple(jnp.ones_like(o) for o in outs)
             (grads,) = vjp(cot)
@@ -252,8 +263,8 @@ class SPMDTrainer:
                     outs, new_aux = graph_fn(args, aux_list, rng_i, True)
                     return outs, new_aux
 
-                if self._do_mirror:
-                    f = jax.checkpoint(f, policy=_mirror_saveable)
+                if self._mirror_policy is not None:
+                    f = jax.checkpoint(f, policy=self._mirror_policy)
                 outs, vjp, new_aux = jax.vjp(f, params, has_aux=True)
                 cot = tuple(jnp.ones_like(o) for o in outs)
                 (grads,) = vjp(cot)

@@ -130,3 +130,75 @@ def test_dist_training_converges():
             re.findall(r"final validation accuracy: ([\d.]+)", out)]
     assert len(accs) == 2, out[-2000:]
     assert all(a > 0.9 for a in accs), accs
+
+
+# -- multi-server sharding (kvstore_dist.h EncodeKey) ----------------------
+
+MULTISERVER_WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+
+    big_shape = (3, 4)    # 12 elems >= bound(8) -> range-partitioned
+    small_shape = (2,)    # 2 elems < bound -> one hashed server
+    nrepeat = 3
+    rate = 2.0
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_servers == 2, kv.num_servers
+    rank, nworker = kv.rank, kv.num_workers
+    kv.init(3, mx.nd.ones(big_shape))
+    kv.init(5, mx.nd.ones(small_shape))
+    kv.set_optimizer(mx.optimizer.Test(rescale_grad=rate))
+    if rank == 0:
+        # both servers must actually hold a shard of the big key
+        sizes = sorted(kv._rpc({"op": "pull", "key": 3},
+                               server=s)["value"].size for s in (0, 1))
+        assert sizes == [6, 6], sizes
+        print("shards distributed:", sizes)
+    kv.barrier()
+    out_b = mx.nd.zeros(big_shape)
+    out_s = mx.nd.zeros(small_shape)
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(big_shape) * (rank + 1))
+        kv.push(5, mx.nd.ones(small_shape) * (rank + 1))
+        kv.pull(3, out=out_b)
+        kv.pull(5, out=out_s)
+    kv.barrier()
+    kv.pull(3, out=out_b)
+    kv.pull(5, out=out_s)
+    expect = 1 + rate * nworker * (nworker + 1) / 2 * nrepeat
+    for got in (out_b.asnumpy(), out_s.asnumpy()):
+        assert np.allclose(got, expect), (got.ravel()[0], expect)
+    print("rank %d multiserver oracle ok: %.1f" % (rank, expect))
+    kv.barrier()
+    if rank == 0:
+        kv.stop_server()
+""")
+
+
+def test_dist_sync_two_servers_sharded_oracle():
+    """`launch.py -s 2`: closed-form BSP oracle with the big array
+    range-partitioned across both servers and the small one hashed."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "3", "-s", "2", sys.executable, "-c", MULTISERVER_WORKER],
+        capture_output=True, text=True, timeout=180, env=env, cwd=ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count("multiserver oracle ok") == 3, out[-2000:]
+    assert "shards distributed: [6, 6]" in out, out[-2000:]
+
+
+def test_shard_routing_unit():
+    from mxnet_tpu.parallel.dist import (_server_of, _shard_slices)
+
+    assert _shard_slices(12, 2) == [(0, 6), (6, 12)]
+    assert _shard_slices(13, 3) == [(0, 5), (5, 9), (9, 13)]
+    assert _shard_slices(2, 4) == [(0, 1), (1, 2), (2, 2), (2, 2)]
+    # stable across processes and spread over servers
+    seen = {_server_of(k, 4) for k in range(64)}
+    assert seen == {0, 1, 2, 3}
+    assert _server_of("w0", 4) == _server_of("w0", 4)

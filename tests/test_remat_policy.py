@@ -1,0 +1,207 @@
+"""Rematerialization policy knobs (the reference's tunable mirroring,
+`static_graph.cc:410-560`, `MXNET_BACKWARD_DO_MIRROR` /
+`MXNET_BACKWARD_MIRROR_STEP` / per-node `force_mirroring` attr).
+
+Remat changes WHEN values are computed, never WHAT: every policy must
+reproduce the default policy's outputs and gradients."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import executor as executor_mod
+from mxnet_tpu.executor import _mirror_policy, _mirror_segments
+from mxnet_tpu.symbol import _topo_order
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(data=net, act_type="tanh", name="t1")
+    net = mx.sym.FullyConnected(data=net, num_hidden=8, name="fc2")
+    net = mx.sym.Activation(data=net, act_type="relu", name="r1")
+    net = mx.sym.FullyConnected(data=net, num_hidden=4, name="fc3")
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _train_grads(net, seed=0):
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, _ = net.infer_shape(data=(6, 10), softmax_label=(6,))
+    args, grads = {}, {}
+    for name, s in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            args[name] = mx.nd.array(rng.randn(*s).astype(np.float32))
+        elif name == "softmax_label":
+            args[name] = mx.nd.array(rng.randint(0, 4, s).astype(np.float32))
+        else:
+            args[name] = mx.nd.array(rng.randn(*s).astype(np.float32) * 0.3)
+        grads[name] = mx.nd.zeros(s)
+    exe = net.bind(mx.cpu(), args, args_grad=grads)
+    exe.forward(is_train=True)
+    exe.backward()
+    out = exe.outputs[0].asnumpy()
+    return out, {k: g.asnumpy() for k, g in grads.items()}
+
+
+def _with_env(monkeypatch, **env):
+    for k in ("MXNET_BACKWARD_DO_MIRROR", "MXNET_BACKWARD_MIRROR_POLICY",
+              "MXNET_BACKWARD_MIRROR_STEP"):
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+
+
+def test_policy_selector(monkeypatch):
+    import jax
+
+    _with_env(monkeypatch)
+    assert _mirror_policy() is None
+    _with_env(monkeypatch, MXNET_BACKWARD_DO_MIRROR="1")
+    assert _mirror_policy() is executor_mod._mirror_saveable
+    _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_POLICY="dots")
+    assert _mirror_policy() is executor_mod._mirror_saveable
+    for pol in ("attn", "nothing"):
+        _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_POLICY=pol)
+        assert _mirror_policy() is not None
+    _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_POLICY="bogus")
+    with pytest.raises(mx.base.MXNetError):
+        _mirror_policy()
+
+
+@pytest.mark.parametrize("env", [
+    {"MXNET_BACKWARD_MIRROR_POLICY": "dots"},
+    {"MXNET_BACKWARD_MIRROR_POLICY": "nothing"},
+    {"MXNET_BACKWARD_MIRROR_STEP": "2"},
+    {"MXNET_BACKWARD_MIRROR_STEP": "1"},
+    {"MXNET_BACKWARD_MIRROR_STEP": "3",
+     "MXNET_BACKWARD_MIRROR_POLICY": "nothing"},
+], ids=["dots", "nothing", "step2", "step1", "step3+nothing"])
+def test_remat_is_invisible_to_numerics(monkeypatch, env):
+    _with_env(monkeypatch)
+    out_ref, grads_ref = _train_grads(_mlp())
+    _with_env(monkeypatch, **env)
+    out, grads = _train_grads(_mlp())
+    np.testing.assert_allclose(out, out_ref, rtol=1e-6, atol=1e-7)
+    for k in grads_ref:
+        np.testing.assert_allclose(grads[k], grads_ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_attn_policy_on_transformer(monkeypatch):
+    from mxnet_tpu import models
+
+    kwargs = dict(vocab_size=13, seq_len=8, num_layers=2, num_heads=2,
+                  num_embed=16)
+    rng = np.random.RandomState(1)
+    X = rng.randint(0, 13, (2, 8)).astype(np.float32)
+    Y = rng.randint(0, 13, (2, 8)).astype(np.float32)
+
+    def run():
+        net = models.get_transformer_lm(**kwargs)
+        arg_shapes, _, _ = net.infer_shape(data=(2, 8),
+                                           softmax_label=(2, 8))
+        prng = np.random.RandomState(5)
+        args, grads = {}, {}
+        for name, s in zip(net.list_arguments(), arg_shapes):
+            if name == "data":
+                args[name] = mx.nd.array(X)
+            elif name == "softmax_label":
+                args[name] = mx.nd.array(Y)
+            else:
+                args[name] = mx.nd.array(
+                    prng.randn(*s).astype(np.float32) * 0.1)
+            grads[name] = mx.nd.zeros(s)
+        exe = net.bind(mx.cpu(), args, args_grad=grads)
+        exe.forward(is_train=True)
+        exe.backward()
+        return {k: g.asnumpy() for k, g in grads.items()}
+
+    _with_env(monkeypatch)
+    ref = run()
+    _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_POLICY="attn")
+    got = run()
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-4, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_force_mirroring_attr_segments(monkeypatch):
+    """force_mirroring='0' pins a node as a boundary; truthy keeps the run
+    going past the step count.  Check the plan and the numerics."""
+    _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_STEP="2")
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(data=h, act_type="tanh", name="t1")
+    with mx.AttrScope(force_mirroring="0"):
+        h = mx.sym.FullyConnected(data=h, num_hidden=8, name="fc2")
+    h = mx.sym.Activation(data=h, act_type="relu", name="r1")
+    h = mx.sym.FullyConnected(data=h, num_hidden=4, name="fc3")
+    net = mx.sym.SoftmaxOutput(data=h, name="softmax")
+
+    segs = _mirror_segments(_topo_order(net._heads))
+    by_node = {}
+    for nodes, remat in segs:
+        for n in nodes:
+            if not n.is_variable:
+                by_node[n.name] = remat
+    assert by_node["fc2"] is False        # pinned boundary
+    assert by_node["fc1"] and by_node["t1"]
+
+    out_ref, grads_ref = None, None
+    _with_env(monkeypatch)
+    out_ref, grads_ref = _train_grads(net)
+    _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_STEP="2")
+    out, grads = _train_grads(net)
+    np.testing.assert_allclose(out, out_ref, rtol=1e-6, atol=1e-7)
+    for k in grads_ref:
+        np.testing.assert_allclose(grads[k], grads_ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_segment_remat_with_aux_state(monkeypatch):
+    """BatchNorm inside a remat segment: aux (moving stats) updates must
+    come through the checkpoint wrapper unchanged."""
+    def build():
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data=net, num_hidden=8, name="fc1")
+        net = mx.sym.BatchNorm(data=net, name="bn1")
+        net = mx.sym.Activation(data=net, act_type="relu", name="r1")
+        net = mx.sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+        return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+    def run():
+        net = build()
+        rng = np.random.RandomState(2)
+        arg_shapes, _, aux_shapes = net.infer_shape(data=(6, 10),
+                                                    softmax_label=(6,))
+        args, grads = {}, {}
+        for name, s in zip(net.list_arguments(), arg_shapes):
+            if name == "data":
+                args[name] = mx.nd.array(rng.randn(*s).astype(np.float32))
+            elif name == "softmax_label":
+                args[name] = mx.nd.array(
+                    rng.randint(0, 4, s).astype(np.float32))
+            else:
+                args[name] = mx.nd.array(
+                    rng.randn(*s).astype(np.float32) * 0.3)
+            grads[name] = mx.nd.zeros(s)
+        aux = [mx.nd.ones(s) if n.endswith("var") else mx.nd.zeros(s)
+               for n, s in zip(net.list_auxiliary_states(), aux_shapes)]
+        exe = net.bind(mx.cpu(), args, args_grad=grads, aux_states=aux)
+        exe.forward(is_train=True)
+        exe.backward()
+        return ({k: g.asnumpy() for k, g in grads.items()},
+                {n: a.asnumpy() for n, a in zip(
+                    net.list_auxiliary_states(), exe.aux_arrays)})
+
+    _with_env(monkeypatch)
+    grads_ref, aux_ref = run()
+    _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_STEP="2")
+    grads, aux = run()
+    for k in grads_ref:
+        np.testing.assert_allclose(grads[k], grads_ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+    for k in aux_ref:
+        np.testing.assert_allclose(aux[k], aux_ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)

@@ -59,7 +59,8 @@ def main():
     trainer = SPMDTrainer(
         net, mesh,
         data_shapes={"data": (B, S), "softmax_label": (B, S)},
-        lr=1e-3, optimizer="adam", wd=0.0, dtype=dtype)
+        lr=1e-3, optimizer="adam", wd=0.0, dtype=dtype,
+        adam_v_dtype=os.environ.get("TBENCH_ADAM_V_DTYPE") or None)
     rng = np.random.RandomState(0)
     batch = {
         "data": rng.randint(0, V, (B, S)).astype(np.int32),

@@ -25,19 +25,35 @@ import subprocess
 import sys
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_ports(n):
+    """A contiguous run of n free ports starting at the returned base
+    (server i binds base+i; probing only the base would crash server i>0
+    at bind on a collision)."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        held = [probe]
+        try:
+            for i in range(1, n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                held.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+    raise RuntimeError("could not reserve %d contiguous ports" % n)
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1,
-                    help="only 1 server supported by the TCP backend")
+                    help="parameter servers; server i binds PORT+i and keys "
+                         "shard over them (hash small, range big arrays)")
     ap.add_argument("--host", default=None,
                     help="address workers use to reach the parameter server "
                          "(default 127.0.0.1; required with --hostfile)")
@@ -61,7 +77,7 @@ def main():
     if args.host is None:
         args.host = "127.0.0.1"
 
-    port = args.port or _free_port()
+    port = args.port or _free_ports(max(1, args.num_servers))
     base_env = dict(os.environ)
     for kv in args.env:
         k, _, v = kv.partition("=")
@@ -75,12 +91,15 @@ def main():
 
     procs = []
 
-    # server process (single TCP server; kvstore_dist_server analogue)
-    senv = dict(base_env)
-    senv["DMLC_ROLE"] = "server"
+    # server processes (kvstore_dist_server analogue): server i binds PORT+i
+    num_servers = max(1, args.num_servers)
     server_cmd = [sys.executable, "-c",
                   "from mxnet_tpu.parallel.dist import run_server; run_server()"]
-    procs.append(subprocess.Popen(server_cmd, env=senv))
+    for sid in range(num_servers):
+        senv = dict(base_env)
+        senv["DMLC_ROLE"] = "server"
+        senv["DMLC_SERVER_ID"] = str(sid)
+        procs.append(subprocess.Popen(server_cmd, env=senv))
 
     extra_keys = {kv.partition("=")[0] for kv in args.env}
     for rank in range(args.num_workers):
@@ -108,16 +127,17 @@ def main():
     signal.signal(signal.SIGTERM, _terminate)
 
     rc = 0
-    # wait for workers (skip the server, procs[0]: it exits on kStopServer)
-    for p in procs[1:]:
+    # wait for workers (skip the servers: they exit on kStopServer)
+    for p in procs[num_servers:]:
         p.wait()
         rc = rc or p.returncode
     # workers that never created a dist kvstore never send kStopServer;
-    # don't hang on the server in that case
-    try:
-        procs[0].wait(timeout=10)
-    except subprocess.TimeoutExpired:
-        procs[0].terminate()
+    # don't hang on the servers in that case
+    for p in procs[:num_servers]:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.terminate()
     sys.exit(rc)
 
 
