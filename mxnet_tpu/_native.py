@@ -106,6 +106,16 @@ def _load():
     lib.mxtpu_loader_reset.argtypes = [H]
     lib.mxtpu_loader_close.argtypes = [H]
 
+    try:  # u8 JPEG fast path (absent in older builds of the .so)
+        lib.mxtpu_loader_open_u8.restype = H
+        lib.mxtpu_loader_open_u8.argtypes = lib.mxtpu_loader_open.argtypes
+        lib.mxtpu_loader_next_u8.restype = ctypes.c_int
+        lib.mxtpu_loader_next_u8.argtypes = [
+            H, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_float)]
+    except AttributeError:
+        pass
+
     try:  # sgd entry points (absent in older builds of the .so)
         lib.mxtpu_sgd_create.restype = H
         lib.mxtpu_sgd_create.argtypes = [ctypes.c_float] * 5 + [ctypes.c_int]
@@ -123,6 +133,10 @@ def _load():
 
 def has_sgd() -> bool:
     return LIB is not None and hasattr(LIB, "mxtpu_sgd_create")
+
+
+def has_u8_loader() -> bool:
+    return LIB is not None and hasattr(LIB, "mxtpu_loader_open_u8")
 
 
 LIB = _load()

@@ -265,7 +265,9 @@ def _mirror_policy():
     (step-k) remat is separate — see `_mirror_segments`.
     """
     pol = os.environ.get("MXNET_BACKWARD_MIRROR_POLICY", "").lower()
-    if not pol or pol == "none":
+    if pol == "none":
+        return None  # explicit 'none' wins over MXNET_BACKWARD_DO_MIRROR
+    if not pol:
         if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0").lower() in (
                 "1", "true", "yes"):
             pol = "dots"

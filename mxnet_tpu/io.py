@@ -464,21 +464,36 @@ class ImageRecordIter(DataIter):
         self._num_parts = num_parts
         self._data_name = data_name
         self._label_name = label_name
+        kind = self._payload_kind()
         if use_native is None:
-            use_native = _native.available()
+            use_native = _native.available() and kind in ("npy", "jpeg")
         self._native = bool(use_native) and _native.available()
+        # JPEG fast path: the C++ loader keeps batches uint8 HWC (no host
+        # deinterleave/float widening, 4x smaller copies); the device does
+        # layout+convert in _finish_hwc_u8
+        self._native_u8 = (self._native and kind == "jpeg"
+                           and _native.has_u8_loader()
+                           and self._record_shape[0] in (1, 3))
         if self._native:
+            import ctypes
             self._lib = _native.LIB
-            self._handle = self._lib.mxtpu_loader_open(
+            opener = (self._lib.mxtpu_loader_open_u8 if self._native_u8
+                      else self._lib.mxtpu_loader_open)
+            self._handle = opener(
                 path_imgrec.encode(), part_index, num_parts, batch_size,
                 self._sample_len, preprocess_threads, prefetch_buffer)
             _native.check(self._handle != 0, "loader_open")
-            import ctypes
-            self._data_buf = np.zeros((batch_size,) + self._record_shape,
-                                      np.float32)
+            if self._native_u8:
+                c, h, w = self._record_shape
+                self._data_buf = np.zeros((batch_size, h, w, c), np.uint8)
+                self._data_ptr = self._data_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8))
+            else:
+                self._data_buf = np.zeros(
+                    (batch_size,) + self._record_shape, np.float32)
+                self._data_ptr = self._data_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float))
             self._label_buf = np.zeros((batch_size,), np.float32)
-            self._data_ptr = self._data_buf.ctypes.data_as(
-                ctypes.POINTER(ctypes.c_float))
             self._label_ptr = self._label_buf.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_float))
         else:
@@ -493,6 +508,29 @@ class ImageRecordIter(DataIter):
             self._begin = 0 if part_index == 0 \
                 else self._resync(raw_begin, fsize)
             self._f.seek(self._begin)
+
+    def _payload_kind(self):
+        """Sniff the first record's payload kind ('npy' / 'jpeg' /
+        'other').  The C++ loader handles .npy and JPEG; anything else
+        (PNG) must take the Python/PIL path rather than silently
+        zero-filling samples."""
+        try:
+            with open(self._path, "rb") as f:
+                head = f.read(8)
+                if len(head) < 8:
+                    return "other"
+                magic, lrec = struct.unpack("<II", head)
+                if magic != 0xCED7230A:
+                    return "other"
+                payload = f.read(min(lrec & ((1 << 29) - 1), 32))
+        except OSError:
+            return "other"
+        body = payload[24:24 + 6]
+        if body[:6] == b"\x93NUMPY":
+            return "npy"
+        if body[:3] == b"\xff\xd8\xff":
+            return "jpeg"
+        return "other"
 
     @property
     def provide_data(self):
@@ -543,12 +581,15 @@ class ImageRecordIter(DataIter):
     def next(self):
         self._ensure_mean()  # before any record is consumed for this batch
         if self._native:
-            n = self._lib.mxtpu_loader_next(self._handle, self._data_ptr,
-                                            self._label_ptr)
+            nextfn = (self._lib.mxtpu_loader_next_u8 if self._native_u8
+                      else self._lib.mxtpu_loader_next)
+            n = nextfn(self._handle, self._data_ptr, self._label_ptr)
             if n <= 0:
                 raise StopIteration
+            out = (self._finish_hwc_u8(self._data_buf) if self._native_u8
+                   else self._finish(self._data_buf))
             return DataBatch(
-                data=[self._finish(self._data_buf)],
+                data=[out],
                 label=[array(self._label_buf.copy())],
                 pad=self.batch_size - n,
                 provide_data=self.provide_data,

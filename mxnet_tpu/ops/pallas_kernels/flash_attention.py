@@ -216,7 +216,221 @@ def _flash_fwd_jnp(q, k, v, q_off, k_off, scale, causal, block_k):
 
 
 # ---------------------------------------------------------------------------
-# Backward: flash-style recompute, scan over K blocks
+# Pallas backward kernels: dq pass (grid over Q blocks) + dk/dv pass (grid
+# over K blocks), each recomputing p from the saved lse — the round-2 jnp
+# scan dragged the stacked K/V blocks through the while-loop carry (811 MB
+# per layer at GPT-2-small shape); here every tile lives only in VMEM.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, causal, block_q, block_k,
+                   kv_len, q_len):
+    qi = pl.program_id(2)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32)                   # (bq, D)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                   # (bq,)
+    delta = delta_ref[0, 0]
+    bq, d = q.shape
+
+    num_kb = pl.cdiv(kv_len, block_k)
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        hi = (last_q - k_off) // block_k + 1
+        num_kb = jnp.clip(hi, 0, num_kb)
+
+    q_rel = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 0)
+    q_pos = q_off + q_rel
+
+    def body(kb, dq):
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k.astype(k_ref.dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                    block_k, kv_len, q_len):
+    ki = pl.program_id(2)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    k = k_ref[0, 0].astype(jnp.float32)                   # (bk, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+    bk, d = k.shape
+    sq_p = q_ref.shape[2]
+    num_qb = sq_p // block_q
+
+    lo = 0
+    if causal:
+        # q blocks whose last query precedes this K block's first key
+        # contribute nothing: need q_off + (qi+1)*bq - 1 >= k_off + ki*bk
+        first_k = k_off + ki * block_k
+        lo = jnp.clip((first_k - q_off - block_q + 1 + block_q - 1)
+                      // block_q, 0, num_qb)
+
+    k_rel = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, bk), 1)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_rel = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_off + q_rel >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q.astype(q_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lo, num_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
+    q, k, v, o, lse, q_off, k_off = res
+    g, glse = grads
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    block_q = min(block_q, max(sq, 128))
+    block_k = min(block_k, max(skv, 128))
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    dop = jnp.pad(g, ((0, 0), (0, 0), (0, pad_q), (0, 0))) if pad_q else g
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    # delta_i = sum_j dO_ij O_ij - glse_i (the lse cotangent folds in here:
+    # d lse_i / d s_ij = p_ij, same sign structure as the delta term)
+    delta = (jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+             - glse.astype(jnp.float32))
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q else delta
+
+    qo = jnp.asarray([q_off], jnp.int32)
+    ko = jnp.asarray([k_off], jnp.int32)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=skv, q_len=sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, sq_p // block_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, skv_p, d),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, skv_p, d),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, block_q),
+                             lambda i, j, k_, qo, ko: (i, j, k_)),
+                pl.BlockSpec((1, 1, block_q),
+                             lambda i, j, k_, qo, ko: (i, j, k_)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, d),
+                                   lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * b * h * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * h * sq_p * skv_p,
+        ),
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, skv_p // block_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, sq_p, d),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, sq_p, d),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, sq_p),
+                             lambda i, j, k_, qo, ko: (i, j, 0)),
+                pl.BlockSpec((1, 1, sq_p),
+                             lambda i, j, k_, qo, ko: (i, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, skv_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, skv_p, d), v.dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=8 * b * h * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * h * sq_p * skv_p,
+        ),
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    if pad_q:
+        dq = dq[:, :, :sq]
+    if pad_k:
+        dk, dv = dk[:, :, :skv], dv[:, :, :skv]
+    zero_off = (jnp.asarray(q_off, jnp.float32) * 0,
+                jnp.asarray(k_off, jnp.float32) * 0)
+    return (dq, dk, dv) + zero_off
+
+
+# ---------------------------------------------------------------------------
+# Backward fallback: flash-style recompute, scan over K blocks
 # ---------------------------------------------------------------------------
 
 
@@ -295,6 +509,10 @@ def _flash_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, res, grads):
+    q = res[0]
+    if _HAS_PALLAS and _use_pallas(q):
+        return _flash_bwd_pallas(scale, causal, block_q, block_k, res,
+                                 grads)
     return _flash_bwd(scale, causal, block_k, res, grads)
 
 

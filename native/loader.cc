@@ -11,15 +11,19 @@
  * numpy and hands jax the host buffer — keeping HBM feeding off the
  * Python thread.
  *
- * Payload format: IRHeader 'IfQQ' (flag, label, id, id2) followed by a raw
- * .npy blob (see `mxnet_tpu/recordio.py` pack_img).  Supported dtypes:
- * <f4, <f8, |u1, <i1, <i4, <i8 — converted to float32.
+ * Payload format: IRHeader 'IfQQ' (flag, label, id, id2) followed by either
+ * a raw .npy blob or a JPEG (see `mxnet_tpu/recordio.py` pack_img).  npy
+ * dtypes <f4, <f8, |u1, <i1, <i4, <i8 convert to float32; JPEG decodes via
+ * libjpeg to RGB/grayscale (PIL-compatible colors) and lands CHW float32 —
+ * the reference's OMP cv2::imdecode role (`iter_image_recordio.cc:184-194`)
+ * without per-record Python overhead.
  */
 #include "mxtpu.h"
 #include "error.h"
 
 #include <atomic>
 #include <condition_variable>
+#include <csetjmp>
 #include <cstring>
 #include <deque>
 #include <functional>
@@ -28,10 +32,121 @@
 #include <thread>
 #include <vector>
 
+#include <jpeglib.h>
+
 namespace {
 
+/* libjpeg error handling: longjmp out instead of exit() */
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  JpegErr* e = reinterpret_cast<JpegErr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, e->msg);
+  longjmp(e->jb, 1);
+}
+
+bool IsJpeg(const unsigned char* p, uint64_t len) {
+  return len >= 3 && p[0] == 0xFF && p[1] == 0xD8 && p[2] == 0xFF;
+}
+
+/* PIL convert('L') exact luma: (19595 R + 38470 G + 7471 B + 0x8000)>>16
+ * (Pillow ImagingConvert L24 rounding). */
+inline uint8_t PilLuma(const unsigned char* px) {
+  return (uint8_t)((19595u * px[0] + 38470u * px[1] + 7471u * px[2]
+                    + 0x8000u) >> 16);
+}
+
+/* Decode a JPEG payload.  Exactly one of outf (CHW float32) / outu8 (HWC
+ * uint8) is set.  Channel count is inferred from sample_len / (h*w).
+ * Bit-identical to the Python/PIL path: c==3 decodes RGB; c==1 returns Y
+ * directly for grayscale-encoded JPEGs and the PIL luma of the RGB decode
+ * for color-encoded ones (JCS_GRAYSCALE on a color source would return
+ * the encoded Y component instead, which PIL does not). */
+bool DecodeJpegImpl(const unsigned char* buf, uint64_t len,
+                    uint64_t sample_len, float* outf, uint8_t* outu8,
+                    std::string* err) {
+  // declared before setjmp: longjmp past a live non-trivial automatic is UB
+  std::vector<unsigned char> row;
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrExit;
+  if (setjmp(jerr.jb)) {
+    *err = std::string("jpeg decode failed: ") + jerr.msg;
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  uint64_t h = cinfo.image_height, w = cinfo.image_width;
+  if (h == 0 || w == 0 || sample_len % (h * w) != 0) {
+    *err = "jpeg dims do not divide sample_len";
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  uint64_t c = sample_len / (h * w);
+  bool luma_convert = false;  // c==1 from a color source: RGB -> PIL luma
+  if (c == 3) {
+    cinfo.out_color_space = JCS_RGB;
+  } else if (c == 1) {
+    if (cinfo.jpeg_color_space == JCS_GRAYSCALE) {
+      cinfo.out_color_space = JCS_GRAYSCALE;
+    } else {
+      cinfo.out_color_space = JCS_RGB;
+      luma_convert = true;
+    }
+  } else {
+    *err = "jpeg: only 1 or 3 channel samples supported";
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_start_decompress(&cinfo);
+  uint64_t dec_c = luma_convert ? 3 : c;
+  bool direct_u8 = outu8 != nullptr && !luma_convert;
+  if (!direct_u8) row.resize(w * dec_c);
+  while (cinfo.output_scanline < h) {
+    uint64_t y = cinfo.output_scanline;
+    unsigned char* rp =
+        direct_u8 ? outu8 + y * w * c : row.data();
+    jpeg_read_scanlines(&cinfo, &rp, 1);
+    if (direct_u8) continue;
+    if (outu8 != nullptr) {  // luma_convert into u8 output
+      for (uint64_t x = 0; x < w; ++x)
+        outu8[y * w + x] = PilLuma(rp + x * 3);
+    } else if (luma_convert) {
+      float* dst = outf + y * w;
+      for (uint64_t x = 0; x < w; ++x)
+        dst[x] = (float)PilLuma(rp + x * 3);
+    } else {
+      for (uint64_t ch = 0; ch < c; ++ch) {
+        float* dst = outf + ch * h * w + y * w;
+        for (uint64_t x = 0; x < w; ++x) dst[x] = (float)rp[x * c + ch];
+      }
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool DecodeJpeg(const unsigned char* buf, uint64_t len, uint64_t sample_len,
+                float* out, std::string* err) {
+  return DecodeJpegImpl(buf, len, sample_len, out, nullptr, err);
+}
+
+bool DecodeJpegU8(const unsigned char* buf, uint64_t len,
+                  uint64_t sample_len, uint8_t* out, std::string* err) {
+  return DecodeJpegImpl(buf, len, sample_len, nullptr, out, err);
+}
+
 struct Batch {
-  std::vector<float> data;
+  std::vector<float> data;      // CHW float mode
+  std::vector<uint8_t> data_u8; // HWC uint8 mode (JPEG fast path)
   std::vector<float> label;
   int n = 0;
   bool epoch_end = false;
@@ -94,10 +209,10 @@ bool ParseNpy(const char* buf, uint64_t len, uint64_t sample_len,
 class Loader {
  public:
   Loader(mxtpu_handle reader, int batch_size, uint64_t sample_len,
-         int n_threads, int prefetch)
+         int n_threads, int prefetch, bool u8 = false)
       : reader_(reader), batch_size_(batch_size), sample_len_(sample_len),
         n_threads_(n_threads < 1 ? 1 : n_threads),
-        prefetch_(prefetch < 1 ? 1 : prefetch) {
+        prefetch_(prefetch < 1 ? 1 : prefetch), u8_(u8) {
     Start();
   }
 
@@ -114,10 +229,25 @@ class Loader {
     cv_prod_.notify_one();
     if (b.epoch_end) {
       // keep returning 0 until reset
-      queue_.push_front(Batch{{}, {}, 0, true});
+      queue_.push_front(Batch{{}, {}, {}, 0, true});
       return 0;
     }
     memcpy(data, b.data.data(), b.data.size() * sizeof(float));
+    memcpy(label, b.label.data(), b.label.size() * sizeof(float));
+    return b.n;
+  }
+
+  int NextU8(uint8_t* data, float* label) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_cons_.wait(lk, [this] { return !queue_.empty(); });
+    Batch b = std::move(queue_.front());
+    queue_.pop_front();
+    cv_prod_.notify_one();
+    if (b.epoch_end) {
+      queue_.push_front(Batch{{}, {}, {}, 0, true});
+      return 0;
+    }
+    memcpy(data, b.data_u8.data(), b.data_u8.size());
     memcpy(label, b.label.data(), b.label.size() * sizeof(float));
     return b.n;
   }
@@ -161,7 +291,11 @@ class Loader {
       if (!raw.empty()) {
         Batch b;
         b.n = (int)raw.size();
-        b.data.assign((size_t)batch_size_ * sample_len_, 0.0f);
+        if (u8_) {
+          b.data_u8.assign((size_t)batch_size_ * sample_len_, 0);
+        } else {
+          b.data.assign((size_t)batch_size_ * sample_len_, 0.0f);
+        }
         b.label.assign(batch_size_, 0.0f);
         DecodeBatch(raw, &b);
         std::unique_lock<std::mutex> lk(mu_);
@@ -174,7 +308,7 @@ class Loader {
       }
     }
     std::unique_lock<std::mutex> lk(mu_);
-    queue_.push_back(Batch{{}, {}, 0, true});
+    queue_.push_back(Batch{{}, {}, {}, 0, true});
     cv_cons_.notify_one();
   }
 
@@ -205,8 +339,22 @@ class Loader {
     memcpy(&lbl, rec.data() + 4, 4);
     b->label[slot] = lbl;
     std::string err;
-    if (!ParseNpy(rec.data() + 24, rec.size() - 24, sample_len_,
-                  b->data.data() + (size_t)slot * sample_len_, &err)) {
+    const unsigned char* payload =
+        reinterpret_cast<const unsigned char*>(rec.data()) + 24;
+    uint64_t plen = rec.size() - 24;
+    bool ok;
+    if (u8_) {
+      uint8_t* out = b->data_u8.data() + (size_t)slot * sample_len_;
+      ok = IsJpeg(payload, plen)
+               ? DecodeJpegU8(payload, plen, sample_len_, out, &err)
+               : (err = "u8 loader requires jpeg payloads", false);
+    } else {
+      float* out = b->data.data() + (size_t)slot * sample_len_;
+      ok = IsJpeg(payload, plen)
+               ? DecodeJpeg(payload, plen, sample_len_, out, &err)
+               : ParseNpy(rec.data() + 24, plen, sample_len_, out, &err);
+    }
+    if (!ok) {
       mxtpu_err() = err;  // sample left zero-filled
     }
   }
@@ -216,6 +364,7 @@ class Loader {
   uint64_t sample_len_;
   int n_threads_;
   int prefetch_;
+  bool u8_ = false;
 
   std::thread producer_;
   std::mutex mu_;
@@ -237,23 +386,49 @@ Loader* FindLoader(mxtpu_handle h) {
 
 }  // namespace
 
-mxtpu_handle mxtpu_loader_open(const char* path, int part_index,
-                               int num_parts, int batch_size,
-                               uint64_t sample_len, int n_threads,
-                               int prefetch) {
+namespace {
+
+mxtpu_handle OpenLoader(const char* path, int part_index, int num_parts,
+                        int batch_size, uint64_t sample_len, int n_threads,
+                        int prefetch, bool u8) {
   mxtpu_handle rd = mxtpu_recio_reader_open(path, part_index, num_parts);
   if (!rd) return 0;
-  Loader* l = new Loader(rd, batch_size, sample_len, n_threads, prefetch);
+  Loader* l =
+      new Loader(rd, batch_size, sample_len, n_threads, prefetch, u8);
   std::unique_lock<std::mutex> lk(g_lmu);
   mxtpu_handle h = g_lnext++;
   g_loaders.emplace_back(h, l);
   return h;
 }
 
+}  // namespace
+
+mxtpu_handle mxtpu_loader_open(const char* path, int part_index,
+                               int num_parts, int batch_size,
+                               uint64_t sample_len, int n_threads,
+                               int prefetch) {
+  return OpenLoader(path, part_index, num_parts, batch_size, sample_len,
+                    n_threads, prefetch, /*u8=*/false);
+}
+
+mxtpu_handle mxtpu_loader_open_u8(const char* path, int part_index,
+                                  int num_parts, int batch_size,
+                                  uint64_t sample_len, int n_threads,
+                                  int prefetch) {
+  return OpenLoader(path, part_index, num_parts, batch_size, sample_len,
+                    n_threads, prefetch, /*u8=*/true);
+}
+
 int mxtpu_loader_next(mxtpu_handle h, float* data, float* label) {
   Loader* l = FindLoader(h);
   if (!l) { mxtpu_err() = "bad loader handle"; return -1; }
   return l->Next(data, label);
+}
+
+int mxtpu_loader_next_u8(mxtpu_handle h, uint8_t* data, float* label) {
+  Loader* l = FindLoader(h);
+  if (!l) { mxtpu_err() = "bad loader handle"; return -1; }
+  return l->NextU8(data, label);
 }
 
 void mxtpu_loader_reset(mxtpu_handle h) {

@@ -73,6 +73,16 @@ MXTPU_API mxtpu_handle mxtpu_loader_open(const char* path, int part_index,
 /* copies next batch into caller buffers; returns number of valid samples
  * (0 at epoch end; < batch_size on last partial batch, rest zero-padded) */
 MXTPU_API int mxtpu_loader_next(mxtpu_handle l, float* data, float* label);
+/* JPEG fast path: batches stay uint8 HWC exactly as libjpeg emits them —
+ * no host-side deinterleave/float widening, 4x smaller copies; the device
+ * does layout+convert.  Only valid for JPEG payloads. */
+MXTPU_API mxtpu_handle mxtpu_loader_open_u8(const char* path,
+                                            int part_index, int num_parts,
+                                            int batch_size,
+                                            uint64_t sample_len,
+                                            int n_threads, int prefetch);
+MXTPU_API int mxtpu_loader_next_u8(mxtpu_handle l, uint8_t* data,
+                                   float* label);
 MXTPU_API void mxtpu_loader_reset(mxtpu_handle l);
 MXTPU_API void mxtpu_loader_close(mxtpu_handle l);
 

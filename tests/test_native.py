@@ -297,3 +297,164 @@ def test_native_sgd_str_keys():
     upd("fc2_weight", g, w2)  # distinct momentum state per str key
     upd("fc1_weight", g, w1)
     assert np.isfinite(w1).all() and not np.allclose(w1, w2)
+
+
+# -- native JPEG decode (loader.cc DecodeJpeg/DecodeJpegU8) ----------------
+
+
+def _write_jpeg_pack(path, imgs_hwc, labels, quality=95):
+    from mxnet_tpu import recordio
+
+    w = recordio.MXRecordIO(path, "w")
+    for i, img in enumerate(imgs_hwc):
+        hdr = recordio.IRHeader(0, float(labels[i]), i, 0)
+        w.write(recordio.pack_img(hdr, img, quality=quality,
+                                  img_fmt=".jpg"))
+    w.close()
+
+
+@pytest.mark.skipif(not _native.available(), reason="native lib not built")
+def test_native_jpeg_decode_matches_pil(tmp_path):
+    """C++ libjpeg decode (u8 fast path) must be bit-identical to the
+    Python/PIL path (both sit on libjpeg)."""
+    from mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(0)
+    imgs = [(rng.rand(24, 32, 3) * 255).astype(np.uint8) for _ in range(9)]
+    path = str(tmp_path / "j.rec")
+    _write_jpeg_pack(path, imgs, list(range(9)))
+
+    it_n = ImageRecordIter(path_imgrec=path, data_shape=(3, 24, 32),
+                           batch_size=4, use_native=True,
+                           preprocess_threads=2)
+    assert it_n._native_u8, "u8 JPEG fast path not engaged"
+    it_p = ImageRecordIter(path_imgrec=path, data_shape=(3, 24, 32),
+                           batch_size=4, use_native=False)
+    n_batches = 0
+    for bn, bp in zip(it_n, it_p):
+        np.testing.assert_array_equal(bn.data[0].asnumpy(),
+                                      bp.data[0].asnumpy())
+        np.testing.assert_array_equal(bn.label[0].asnumpy(),
+                                      bp.label[0].asnumpy())
+        assert bn.pad == bp.pad
+        n_batches += 1
+    assert n_batches == 3
+    it_n.close()
+
+
+@pytest.mark.skipif(not _native.available(), reason="native lib not built")
+def test_native_jpeg_grayscale(tmp_path):
+    from mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(1)
+    imgs = [(rng.rand(16, 16) * 255).astype(np.uint8) for _ in range(4)]
+    path = str(tmp_path / "g.rec")
+    _write_jpeg_pack(path, imgs, [3, 1, 4, 1])
+    it = ImageRecordIter(path_imgrec=path, data_shape=(1, 16, 16),
+                         batch_size=4, use_native=True)
+    assert it._native_u8
+    b = next(it)
+    got = b.data[0].asnumpy()
+    assert got.shape == (4, 1, 16, 16)
+    # JPEG is lossy: compare to the PIL decode, which must be exact
+    it_p = ImageRecordIter(path_imgrec=path, data_shape=(1, 16, 16),
+                           batch_size=4, use_native=False)
+    np.testing.assert_array_equal(got, next(it_p).data[0].asnumpy())
+    it.close()
+
+
+@pytest.mark.skipif(not _native.available(), reason="native lib not built")
+def test_native_jpeg_gray_from_color_source_matches_pil(tmp_path):
+    """A c=1 dataset packed from COLOR jpegs: the native path must apply
+    PIL's convert('L') luma, not libjpeg's encoded-Y shortcut."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(7)
+    imgs = [(rng.rand(16, 16, 3) * 255).astype(np.uint8) for _ in range(4)]
+    path = str(tmp_path / "c2g.rec")
+    _write_jpeg_pack(path, imgs, [0, 1, 2, 3])
+    it_n = ImageRecordIter(path_imgrec=path, data_shape=(1, 16, 16),
+                           batch_size=4, use_native=True)
+    it_p = ImageRecordIter(path_imgrec=path, data_shape=(1, 16, 16),
+                           batch_size=4, use_native=False)
+    np.testing.assert_array_equal(next(it_n).data[0].asnumpy(),
+                                  next(it_p).data[0].asnumpy())
+    it_n.close()
+
+
+@pytest.mark.skipif(not _native.available(), reason="native lib not built")
+def test_native_jpeg_corrupt_record_zero_fills(tmp_path):
+    """A truncated JPEG must fail that sample cleanly (zero-filled, error
+    recorded) without crashing the worker pool."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(8)
+    img = (rng.rand(16, 16, 3) * 255).astype(np.uint8)
+    path = str(tmp_path / "bad.rec")
+    w = recordio.MXRecordIO(path, "w")
+    good = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                             img_fmt=".jpg")
+    w.write(good)
+    w.write(good[:40])  # header + truncated JPEG body
+    w.write(good)
+    w.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                         batch_size=3, use_native=True)
+    b = next(it)
+    d = b.data[0].asnumpy()
+    assert d[0].mean() > 1 and d[2].mean() > 1  # good records decoded
+    it.close()
+
+
+@pytest.mark.skipif(not _native.available(), reason="native lib not built")
+def test_png_pack_falls_back_to_python(tmp_path):
+    """The C++ loader cannot decode PNG; the payload sniff must route the
+    iterator to the PIL path instead of zero-filling samples."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(2)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    path = str(tmp_path / "p.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(recordio.pack_img(recordio.IRHeader(0, 7.0, 0, 0), img,
+                              img_fmt=".png"))
+    w.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=1)
+    assert not it._native  # sniffed as 'other' -> python path
+    b = next(it)
+    np.testing.assert_array_equal(
+        b.data[0].asnumpy()[0].transpose(1, 2, 0).astype(np.uint8), img)
+
+
+@pytest.mark.skipif(not _native.available(), reason="native lib not built")
+def test_native_jpeg_thread_count_invariant(tmp_path):
+    """Decode results must not depend on the worker-pool size."""
+    from mxnet_tpu.io import ImageRecordIter
+
+    rng = np.random.RandomState(3)
+    imgs = [(rng.rand(12, 12, 3) * 255).astype(np.uint8)
+            for _ in range(13)]
+    path = str(tmp_path / "t.rec")
+    _write_jpeg_pack(path, imgs, list(range(13)))
+
+    def drain(threads):
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                             batch_size=5, use_native=True,
+                             preprocess_threads=threads)
+        got = [(b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad)
+               for b in it]
+        it.close()
+        return got
+
+    ref = drain(1)
+    for threads in (2, 4):
+        got = drain(threads)
+        assert len(got) == len(ref)
+        for (d1, l1, p1), (d2, l2, p2) in zip(ref, got):
+            np.testing.assert_array_equal(d1, d2)
+            np.testing.assert_array_equal(l1, l2)
+            assert p1 == p2

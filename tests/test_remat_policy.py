@@ -64,6 +64,10 @@ def test_policy_selector(monkeypatch):
     for pol in ("attn", "nothing"):
         _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_POLICY=pol)
         assert _mirror_policy() is not None
+    # explicit 'none' wins over a globally-set DO_MIRROR
+    _with_env(monkeypatch, MXNET_BACKWARD_DO_MIRROR="1",
+              MXNET_BACKWARD_MIRROR_POLICY="none")
+    assert _mirror_policy() is None
     _with_env(monkeypatch, MXNET_BACKWARD_MIRROR_POLICY="bogus")
     with pytest.raises(mx.base.MXNetError):
         _mirror_policy()

@@ -98,6 +98,20 @@ def main():
     it.reset()  # jit warm
     out["jpeg_read_decode"] = round(_drain(it), 1)
 
+    # C++ libjpeg decode in the threaded loader: uint8 HWC batches, no
+    # Python in the decode loop (scales with preprocess_threads on
+    # multi-core hosts; bit-identical to the PIL path)
+    from mxnet_tpu import _native
+
+    if _native.has_u8_loader():
+        it = mx.io.ImageRecordIter(
+            path_imgrec=jpg, data_shape=(3, 256, 256), batch_size=batch,
+            use_native=True, preprocess_threads=os.cpu_count() or 1)
+        next(it)
+        it.reset()
+        out["jpeg_native_u8_decode"] = round(_drain(it), 1)
+        it.close()
+
     it = mx.io.ImageRecordIter(path_imgrec=jpg, data_shape=(3, 224, 224),
                                record_shape=(3, 256, 256), rand_crop=True,
                                rand_mirror=True, batch_size=batch,
