@@ -141,3 +141,24 @@ def test_speech_demo_example():
     m = re.search(r"final frame accuracy: ([\d.]+)", out)
     assert m, out[-1500:]
     assert float(m.group(1)) > 0.7, out[-500:]
+
+
+def test_dec_example():
+    """Deep embedded clustering must recover the synthetic mixture."""
+    out = run_example("dec.py", "--num-points", "512",
+                      "--pretrain-epochs", "10", "--max-steps", "200")
+    import re
+
+    m = re.search(r"DEC acc ([\d.]+)", out)
+    assert m, out[-1000:]
+    assert float(m.group(1)) >= 0.9, out[-1000:]
+
+
+def test_kaggle_ndsb1_example():
+    """Competition pipeline: pack -> train -> predict -> submission CSV."""
+    out = run_example("kaggle_ndsb1.py", "--num-train", "360",
+                      "--num-classes", "4")
+    line = [l for l in out.splitlines() if l.startswith("NDSB1")][-1]
+    acc = float(line.split()[3].rstrip(";"))
+    assert acc >= 0.6, out[-1000:]
+    assert "submission header: image,plankton_class_00" in out
