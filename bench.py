@@ -121,9 +121,12 @@ def main():
         })
     # transformer-LM companion metric (the round-3 perf campaign lives
     # here — docs/mfu_roofline.md); a short GPT-2-small-shape run so the
-    # driver records tokens/s + MFU mechanically.  Guarded: the flagship
-    # ResNet number must survive a transformer failure.
+    # driver records tokens/s + MFU mechanically.  Runs IN-PROCESS (a
+    # subprocess would deadlock on the single-chip relay grant this
+    # process holds) after the ResNet state is dropped.  Guarded: the
+    # flagship ResNet number must survive a transformer failure.
     if os.environ.get("BENCH_TRANSFORMER", "1") not in ("0", "false"):
+        del trainer, dev_batch, batch_np  # free HBM for the LM state
         try:
             extra.update(_transformer_metrics())
         except Exception as e:  # pragma: no cover
@@ -135,27 +138,17 @@ def main():
 
 def _transformer_metrics():
     """Small-steps transformer-LM training throughput (tokens/s/chip +
-    MFU) via tools/benchmark_transformer.py's accounting."""
-    import re
-    import subprocess
-
-    env = dict(os.environ)
-    env.setdefault("TBENCH_STEPS", "10")
-    env.setdefault("TBENCH_REPS", "2")
+    MFU) via tools/benchmark_transformer.py's accounting, in-process."""
     here = os.path.dirname(os.path.abspath(__file__))
-    proc = subprocess.run(
-        [sys.executable, os.path.join(here, "tools",
-                                      "benchmark_transformer.py")],
-        capture_output=True, text=True, timeout=900, env=env)
-    if proc.returncode != 0:
-        raise RuntimeError("benchmark_transformer failed: "
-                           + proc.stderr[-200:])
-    line = proc.stdout.strip().splitlines()[-1]
-    data = json.loads(line)
-    mfu = re.search(r"mfu=([\d.]+)", data["unit"])
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import benchmark_transformer
+
+    os.environ.setdefault("TBENCH_STEPS", "10")
+    os.environ.setdefault("TBENCH_REPS", "2")
+    data = benchmark_transformer.run()
     return {
         "transformer_lm_tokens_per_sec_per_chip": data["value"],
-        "transformer_lm_mfu": float(mfu.group(1)) if mfu else None,
+        "transformer_lm_mfu": data.get("mfu"),
         "transformer_lm_config": data["unit"],
     }
 

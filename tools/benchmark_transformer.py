@@ -27,7 +27,10 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def main():
+def run():
+    """Measure and return the result dict (importable by bench.py: a
+    subprocess would deadlock on the single-chip relay grant the parent
+    already holds)."""
     import jax
 
     from mxnet_tpu import models
@@ -83,14 +86,24 @@ def main():
     peak = float(os.environ.get("TBENCH_PEAK_FLOPS", "197e12")) * n_dev
     mfu = flops_token * B * S / dt / peak
 
-    print(json.dumps({
+    result = {
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_dev, 1),
         "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d S=%d B=%d, %s, %s head)"
                 % (mfu, L, D, S, B, np.dtype(dtype).name,
                    "fused" if fused else "dense"),
         "vs_baseline": None,
-    }))
+        "mfu": round(mfu, 4),
+    }
+    # release the model state before the caller reuses the chip
+    del trainer, dev_batch
+    return result
+
+
+def main():
+    result = run()
+    result.pop("mfu", None)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
