@@ -435,17 +435,44 @@ class DistKVStore(KVStore):
                               server=sid)
         self.barrier()
 
+    def _rpc_shards(self, reqs):
+        """Issue one RPC per server concurrently (each server has its own
+        socket+lock; BSP pushes block until all workers arrive, so serial
+        round-trips would double the critical path at 2 servers)."""
+        if len(reqs) == 1:
+            sid, msg = reqs[0]
+            return [self._rpc(msg, server=sid)]
+        out = [None] * len(reqs)
+        errs = []
+
+        def call(i, sid, msg):
+            try:
+                out[i] = self._rpc(msg, server=sid)
+            except Exception as e:  # re-raised on the caller thread
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i, sid, msg))
+                   for i, (sid, msg) in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return out
+
     def push(self, key, value, priority=0):
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
         for k, vlist in zip(keys, vals):
             merged = np.asarray(self._merge(vlist))
+            reqs = []
             for sid, sl in self._route(k, merged.size):
                 shard = merged if sl is None \
                     else merged.reshape(-1)[sl[0]:sl[1]]
-                self._rpc({"op": "push", "key": k,
-                           "value": np.ascontiguousarray(shard)},
-                          server=sid)
+                reqs.append((sid, {"op": "push", "key": k,
+                                   "value": np.ascontiguousarray(shard)}))
+            self._rpc_shards(reqs)
 
     def pull(self, key, out=None, priority=0):
         if out is None:
@@ -464,10 +491,10 @@ class DistKVStore(KVStore):
                 val = self._rpc({"op": "pull", "key": k},
                                 server=route[0][0])["value"]
             else:
-                parts = [self._rpc({"op": "pull", "key": k},
-                                   server=sid)["value"]
-                         for sid, _ in route]
-                val = np.concatenate([p.reshape(-1) for p in parts])
+                replies = self._rpc_shards(
+                    [(sid, {"op": "pull", "key": k}) for sid, _ in route])
+                val = np.concatenate(
+                    [r["value"].reshape(-1) for r in replies])
                 val = val.reshape(olist[0].shape)
             src = array(val)
             for o in olist:
