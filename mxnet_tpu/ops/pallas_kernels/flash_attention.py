@@ -509,8 +509,14 @@ def _flash_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, res, grads):
+    import os
+
     q = res[0]
-    if _HAS_PALLAS and _use_pallas(q):
+    # MXNET_FLASH_BWD=jnp forces the scan fallback (escape hatch while the
+    # Pallas backward burns in on hardware)
+    use_pallas = (_HAS_PALLAS and _use_pallas(q)
+                  and os.environ.get("MXNET_FLASH_BWD", "pallas") != "jnp")
+    if use_pallas:
         return _flash_bwd_pallas(scale, causal, block_q, block_k, res,
                                  grads)
     return _flash_bwd(scale, causal, block_k, res, grads)
