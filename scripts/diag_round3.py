@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Round-3 on-chip diagnostic battery (run when the TPU relay is up).
+
+Stages (each prints one line; select with DIAG_STAGES=csv):
+  attnbwd   Pallas flash-attention backward vs jnp fallback parity
+  headscan  fused vs dense LM head isolated inside a lax.scan loop —
+            reproduces (or clears) the run_steps regression without the
+            12-layer body
+  unroll    full-model run_steps fused/dense x scan unroll 1/2
+  breakdown per-source HBM bytes of the fused vs dense multi-step program
+
+Usage: python scripts/diag_round3.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _timeit(fn, reps=5):
+    import jax
+
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.time() - t0) / reps
+
+
+def stage_attnbwd():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    for causal, sq, skv in ((True, 1024, 1024), (False, 512, 384)):
+        b, h, d = 2, 4, 64
+        q = jnp.asarray(rng.randn(b, h, sq, d) * 0.5, jnp.bfloat16)
+        k = jnp.asarray(rng.randn(b, h, skv, d) * 0.5, jnp.bfloat16)
+        v = jnp.asarray(rng.randn(b, h, skv, d) * 0.5, jnp.bfloat16)
+        g = jnp.asarray(rng.randn(b, h, sq, d) * 0.5, jnp.bfloat16)
+        scale = 1.0 / np.sqrt(d)
+        out, lse = jax.jit(lambda: fa._flash_fwd_jnp(
+            q, k, v, 0, 0, scale, causal, 128))()
+        res = (q, k, v, out, lse, jnp.float32(0.0), jnp.float32(0.0))
+        grads = (g, jnp.zeros_like(lse))
+        p = jax.jit(lambda: fa._flash_bwd_pallas(
+            scale, causal, 128, 128, res, grads))()
+        j = jax.jit(lambda: fa._flash_bwd(scale, causal, 128, res,
+                                          grads))()
+        for name, a, bb in zip(("dq", "dk", "dv"), p[:3], j[:3]):
+            diff = float(np.abs(np.asarray(a, np.float32)
+                                - np.asarray(bb, np.float32)).max())
+            ref = float(np.abs(np.asarray(bb, np.float32)).max())
+            print("attnbwd causal=%s %s maxdiff %.4f (scale %.3f)"
+                  % (causal, name, diff, ref))
+            assert diff <= 0.05 * max(ref, 1.0), (name, diff, ref)
+        tp = _timeit(lambda: fa._flash_bwd_pallas(
+            scale, causal, 128, 128, res, grads), reps=10)
+        tj = _timeit(lambda: fa._flash_bwd(scale, causal, 128, res,
+                                           grads), reps=10)
+        print("attnbwd causal=%s: pallas %.2f ms vs jnp-scan %.2f ms"
+              % (causal, tp * 1e3, tj * 1e3))
+
+
+def _head_step_fn(fused, N, D, V, nsteps, unroll):
+    """A minimal trainer-like loop: ln -> head -> loss-grad -> sgd update
+    on (w, b) inside lax.scan, matching multi_step's structure."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.loss import _softmax_output
+    from mxnet_tpu.ops.pallas_kernels.fused_ce import fused_softmax_ce
+
+    def step(params, x, label):
+        w, b = params
+
+        def f(p):
+            wc = p[0].astype(jnp.bfloat16)
+            bc = p[1].astype(jnp.bfloat16)
+            if fused:
+                nll = fused_softmax_ce(x, wc, bc, label)
+                return (nll,)
+            logits = jax.lax.dot_general(
+                x, wc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+            logits = logits + bc
+            return (_softmax_output(logits, label, 1.0, -1.0, False,
+                                    False),)
+
+        outs, vjp = jax.vjp(f, params)
+        (grads,) = vjp(tuple(jnp.ones_like(o) for o in outs))
+        return (params[0] - 1e-4 * grads[0], params[1] - 1e-4 * grads[1])
+
+    def loop(params, x, label):
+        def body(p, _):
+            return step(p, x, label), ()
+
+        p, _ = jax.lax.scan(body, params, jnp.arange(nsteps),
+                            unroll=unroll)
+        return p
+
+    return jax.jit(loop, donate_argnums=(0,))
+
+
+def stage_headscan():
+    import jax
+    import jax.numpy as jnp
+
+    N, D, V = 32768, 768, 32768
+    nsteps = 8
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(N, D) * 0.5, jnp.bfloat16)
+    label = jnp.asarray(rng.randint(0, V, (N,)), jnp.float32)
+    for fused in (False, True):
+        for unroll in (1, 2):
+            params = (jnp.asarray(rng.randn(V, D) * 0.02, jnp.float32),
+                      jnp.zeros((V,), jnp.float32))
+            loop = _head_step_fn(fused, N, D, V, nsteps, unroll)
+            params = loop(params, x, label)  # compile+warm
+            t0 = time.time()
+            params = loop(params, x, label)
+            jax.block_until_ready(params)
+            dt = (time.time() - t0) / nsteps
+            print("headscan fused=%s unroll=%d: %.1f ms/step"
+                  % (fused, unroll, dt * 1e3))
+
+
+def _make_trainer(fused, unroll_env=None):
+    import jax
+
+    from mxnet_tpu import models
+    from mxnet_tpu.base import bfloat16
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    L, D, H, S, B, V = 12, 768, 12, 1024, 32, 32768
+    net = models.get_transformer_lm(vocab_size=V, seq_len=S, num_layers=L,
+                                    num_heads=H, num_embed=D,
+                                    fused_head=fused)
+    mesh = make_mesh(shape=(1,), axis_names=("data",))
+    tr = SPMDTrainer(net, mesh,
+                     data_shapes={"data": (B, S), "softmax_label": (B, S)},
+                     lr=1e-3, optimizer="adam", wd=0.0, dtype=bfloat16)
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randint(0, V, (B, S)).astype(np.int32),
+             "softmax_label": rng.randint(0, V, (B, S)).astype(np.float32)}
+    return tr, tr.shard_batch(batch), B * S
+
+
+def stage_unroll():
+    import jax
+
+    for fused in (False, True):
+        tr, dev, tokens = _make_trainer(fused)
+        ns = 8
+        tr.run_steps(dev, ns)
+        jax.block_until_ready(tr.params)
+        t0 = time.time()
+        tr.run_steps(dev, ns)
+        jax.block_until_ready(tr.params)
+        dt = (time.time() - t0) / ns
+        print("unroll2 fused=%s: %.0f ms/step %.1fk tok/s"
+              % (fused, dt * 1e3, tokens / dt / 1e3))
+        del tr, dev
+
+
+def stage_breakdown():
+    import jax
+
+    from mxnet_tpu import profiler
+
+    for fused in (False, True):
+        tr, dev, _ = _make_trainer(fused)
+        lowered = tr._step.lower(tr.params, tr.momenta, tr.aux, dev,
+                                 jax.random.PRNGKey(0),
+                                 jax.numpy.float32(1e-3))
+        comp = lowered.compile()
+        try:
+            bd = profiler.hlo_breakdown(comp.as_text(), top=40)
+            top = sorted(bd["by_src"].items(),
+                         key=lambda kv: -kv[1]["bytes"])[:6]
+            print("breakdown fused=%s (total %.1f GB):"
+                  % (fused, bd["total_bytes"] / 1e9))
+            for src, row in top:
+                print("  %-40s %7.2f GB" % (str(src)[:40],
+                                            row["bytes"] / 1e9))
+        except Exception as e:
+            print("breakdown fused=%s failed: %s" % (fused, e))
+        del tr, dev
+
+
+def main():
+    stages = os.environ.get(
+        "DIAG_STAGES", "attnbwd,headscan,unroll").split(",")
+    for s in stages:
+        s = s.strip()
+        if s:
+            print("=== stage %s ===" % s)
+            globals()["stage_" + s]()
+
+
+if __name__ == "__main__":
+    main()
