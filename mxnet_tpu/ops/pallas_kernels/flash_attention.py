@@ -152,6 +152,10 @@ def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
             jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq_p, 128), jnp.float32),
         ],
+        # every program is independent (the K loop is inside the kernel):
+        # let Mosaic parallelize/pipeline freely across the whole grid
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq_p * skv_p * d,
             bytes_accessed=(qp.size + kp.size + vp.size) * qp.dtype.itemsize,
@@ -374,6 +378,8 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
                                    lambda i, j, k_, qo, ko: (i, j, k_, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=6 * b * h * sq_p * skv_p * d,
             bytes_accessed=(qp.size * 2 + kp.size + vp.size)
@@ -412,6 +418,8 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
             jax.ShapeDtypeStruct((b, h, skv_p, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, skv_p, d), v.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=8 * b * h * sq_p * skv_p * d,
             bytes_accessed=(qp.size * 2 + kp.size + vp.size)
