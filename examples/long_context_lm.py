@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from mxnet_tpu.parallel import make_mesh, ring_attention  # noqa: E402
 from mxnet_tpu.parallel.mesh import shard_map  # noqa: E402
+from mxnet_tpu.ops.pallas_kernels.fused_ce import fused_softmax_ce  # noqa: E402
 from mxnet_tpu.ops.pallas_kernels.layer_norm import layer_norm  # noqa: E402
 
 
@@ -78,7 +79,7 @@ def model_local(params, tokens, heads, axis):
         x = x + att @ lp["proj"]
         h = layer_norm(x, lp["ln2_g"], lp["ln2_b"])
         x = x + jax.nn.relu(h @ lp["w1"]) @ lp["w2"]
-    return x @ params["out"]  # (b, s_loc, vocab)
+    return x  # (b, s_loc, e); the loss head runs on the caller's side
 
 
 def main():
@@ -92,6 +93,9 @@ def main():
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--dense-head", action="store_true",
+                    help="materialize the (tokens, vocab) logits instead "
+                         "of the fused flash-style CE head")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -110,14 +114,40 @@ def main():
         rng.randint(0, args.vocab, (args.batch_size, args.seq_len)))
     targets = (tokens + 1) % args.vocab
 
+    n_tok = args.batch_size * args.seq_len
+
     def loss_fn(params, tokens, targets):
-        fn = shard_map(
-            lambda p, t: model_local(p, t, args.heads, "seq"),
-            mesh=mesh, in_specs=(P(), P(None, "seq")),
-            out_specs=P(None, "seq"))
-        logits = fn(params, tokens)
-        logp = jax.nn.log_softmax(logits, -1)
-        return -jnp.take_along_axis(logp, targets[..., None], -1).mean()
+        # the head stays INSIDE the shard_map: each device scores only its
+        # own sequence shard.  With the fused head the (tokens, vocab)
+        # logits never exist anywhere — the per-token NLL comes from
+        # online-softmax tiles, which is what lets the context scale
+        # (logits would grow with S while activations stay sharded).
+        def local(p, t, y):
+            x = model_local(p, t, args.heads, "seq")
+            if args.dense_head:
+                logits = x @ p["out"]
+                logp = jax.nn.log_softmax(logits, -1)
+                nll = -jnp.take_along_axis(logp, y[..., None], -1)[..., 0]
+            else:
+                # loss-head contract: the gradient ignores the incoming
+                # cotangent and applies grad_scale, so 1/n_tok reproduces
+                # the dense head's mean-CE gradients exactly
+                e = x.shape[-1]
+                w_head = p["out"]
+                if hasattr(jax.lax, "pvary"):
+                    # replicated param into a custom-VJP op: mark it
+                    # device-varying so the cotangent types match (the
+                    # shard_map transpose psums dW back to replicated)
+                    w_head = jax.lax.pvary(w_head, ("seq",))
+                nll = fused_softmax_ce(
+                    x.reshape(-1, e), w_head.T, None, y.reshape(-1),
+                    grad_scale=1.0 / n_tok).reshape(x.shape[:2])
+            return nll
+
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(P(), P(None, "seq"), P(None, "seq")),
+                       out_specs=P(None, "seq"))
+        return fn(params, tokens, targets).mean()
 
     @jax.jit
     def step(params, m, v, t, tokens, targets):

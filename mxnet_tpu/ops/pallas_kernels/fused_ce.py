@@ -436,7 +436,16 @@ def _fused_ce_bwd_rule(grad_scale, ignore_label, use_ignore, block_n,
     else:
         dx, dw, db = _bwd_jnp(x, w, b, lbl, lse, grad_scale, ignore_label,
                               use_ignore, block_v)
-    return dx, dw, db.astype(b.dtype), jnp.zeros_like(label)
+    if jnp.issubdtype(label.dtype, jnp.integer):
+        # integer primals take a float0 cotangent under jax.grad/vjp
+        import numpy as _np
+
+        from jax import dtypes as _dtypes
+
+        dlabel = _np.zeros(label.shape, _dtypes.float0)
+    else:
+        dlabel = jnp.zeros_like(label)
+    return dx, dw, db.astype(b.dtype), dlabel
 
 
 _fused_ce.defvjp(_fused_ce_fwd_rule, _fused_ce_bwd_rule)
@@ -461,7 +470,9 @@ def fused_softmax_ce(x, weight, bias, label, *, grad_scale=1.0,
     if x.ndim != 2 or weight.ndim != 2:
         raise ValueError("fused_softmax_ce expects 2-D x and weight")
     if bias is None:
-        bias = jnp.zeros((weight.shape[0],), weight.dtype)
+        # derive from weight (not a fresh constant) so its varying-manual-
+        # axes type matches under shard_map
+        bias = weight[:, 0] * 0
     return _fused_ce(x, weight, bias, label, float(grad_scale),
                      float(ignore_label), bool(use_ignore), int(block_n),
                      int(block_v))

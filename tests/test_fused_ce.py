@@ -272,3 +272,64 @@ def test_fused_head_dp_grads_match_single_device():
     for k in p1:
         np.testing.assert_allclose(p8[k], p1[k], rtol=1e-4, atol=1e-5,
                                    err_msg=k)
+
+
+def test_bias_none_and_int_labels_under_grad():
+    """bias=None derives a zero bias from the weight (vma-type inheritance
+    under shard_map depends on this — a fresh jnp.zeros would not carry
+    varying axes) and integer labels take a float0 cotangent."""
+    x, w, b, label = _make(n=12, d=8, v=17)
+    xj, wj = jnp.asarray(x), jnp.asarray(w)
+    li = jnp.asarray(label, jnp.int32)
+
+    nll_none = fused_softmax_ce(xj, wj, None, li, block_v=8)
+    nll_zero = fused_softmax_ce(xj, wj, jnp.zeros((17,), jnp.float32), li,
+                                block_v=8)
+    np.testing.assert_allclose(np.asarray(nll_none), np.asarray(nll_zero),
+                               rtol=1e-6)
+
+    # int labels under jax.grad must not raise (float0 cotangent)
+    g = jax.grad(lambda x_: jnp.sum(
+        fused_softmax_ce(x_, wj, None, li, block_v=8)))(xj)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_fused_ce_inside_shard_map():
+    """The long-context configuration: tokens sharded over a mesh axis,
+    fused head inside shard_map with a pvaried replicated weight; dW must
+    psum back to the replicated gradient of the unsharded computation."""
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.mesh import shard_map
+
+    n, d, v = 32, 8, 19
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, d).astype(np.float32) * 0.5)
+    w = jnp.asarray(rng.randn(v, d).astype(np.float32) * 0.3)
+    label = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
+    mesh = make_mesh(shape=(8,), axis_names=("seq",))
+
+    def sharded_loss(x_, w_):
+        def local(xs, wr, ys):
+            if hasattr(jax.lax, "pvary"):
+                wr = jax.lax.pvary(wr, ("seq",))
+            return fused_softmax_ce(xs, wr, None, ys,
+                                    grad_scale=1.0 / n, block_v=8)
+
+        fn = shard_map(local, mesh=mesh,
+                       in_specs=(P("seq"), P(), P("seq")),
+                       out_specs=P("seq"))
+        return fn(x_, w_, label).mean()
+
+    def plain_loss(x_, w_):
+        return fused_softmax_ce(x_, w_, None, label,
+                                grad_scale=1.0 / n, block_v=8).mean()
+
+    ls, (dxs, dws) = jax.value_and_grad(sharded_loss, argnums=(0, 1))(x, w)
+    lp, (dxp, dwp) = jax.value_and_grad(plain_loss, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(float(ls), float(lp), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dxs), np.asarray(dxp),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dws), np.asarray(dwp),
+                               rtol=1e-5, atol=1e-6)
