@@ -136,6 +136,14 @@ def _node_cost(op_name, params, in_shapes, out_shapes, dsize):
         flops = 2 * out_elems * (cin // groups) * max(_prod(k), 1)
     elif op_name == "FullyConnected":
         flops = 2 * _prod(outs[0]) * _prod(ins[0][1:])
+    elif op_name == "FusedSoftmaxCE":
+        # one logit-tile matmul pass forward (N x D x V MACs) + softmax
+        # math; the logits themselves never hit HBM, so bytes stay the
+        # input/output default (inputs + the (N,) nll)
+        n = ins[0][0]
+        d = _prod(ins[0][1:])
+        v = ins[1][0]
+        flops = 2 * n * d * v + 5 * n * v
     elif op_name == "BatchNorm":
         flops = 10 * in_elems
     elif op_name in ("SoftmaxOutput", "softmax_cross_entropy", "Softmax",
