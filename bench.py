@@ -178,19 +178,35 @@ def main():
 
 def _transformer_metrics():
     """Small-steps transformer-LM training throughput (tokens/s/chip +
-    MFU) via tools/benchmark_transformer.py's accounting, in-process."""
+    MFU) via tools/benchmark_transformer.py's accounting, in-process.
+    Measures the dense head and (unless BENCH_TRANSFORMER_FUSED=0) the
+    FusedSoftmaxCE head, so the round records the comparison."""
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(here, "tools"))
     import benchmark_transformer
 
     os.environ.setdefault("TBENCH_STEPS", "10")
     os.environ.setdefault("TBENCH_REPS", "2")
-    data = benchmark_transformer.run()
-    return {
-        "transformer_lm_tokens_per_sec_per_chip": data["value"],
-        "transformer_lm_mfu": data.get("mfu"),
-        "transformer_lm_config": data["unit"],
-    }
+    out = {}
+    configs = [("", "0")]
+    if os.environ.get("BENCH_TRANSFORMER_FUSED", "1") not in ("0", "false"):
+        configs.append(("fused_", "1"))
+    for prefix, fused in configs:
+        os.environ["TBENCH_FUSED_HEAD"] = fused
+        try:
+            data = benchmark_transformer.run()
+        except Exception as e:
+            if not prefix:
+                raise  # dense failure propagates to the retry logic
+            out["transformer_lm_fused_error"] = str(e)[:200]
+            break
+        out.update({
+            "transformer_lm_%stokens_per_sec_per_chip" % prefix:
+                data["value"],
+            "transformer_lm_%smfu" % prefix: data.get("mfu"),
+            "transformer_lm_%sconfig" % prefix: data["unit"],
+        })
+    return out
 
 
 def _io_pipeline_ips(n=384):
