@@ -273,9 +273,13 @@ class SPMDTrainer:
                 aux_out = dict(zip(self.aux_names, new_aux))
                 return (new_params, new_momenta, aux_out), ()
 
+            # unroll=2 measured best for the ResNet bench
+            # (docs/mfu_roofline.md); MXNET_MULTISTEP_UNROLL overrides for
+            # workloads where the doubled loop body hurts scheduling
+            unroll = int(os.environ.get("MXNET_MULTISTEP_UNROLL", "2"))
             (params, momenta, aux), _ = jax.lax.scan(
                 body, (params, momenta, aux), jnp.arange(nsteps),
-                unroll=2)
+                unroll=max(unroll, 1))
             return params, momenta, aux
 
         self._multi_step = jax.jit(multi_step, donate_argnums=(0, 1, 2),
