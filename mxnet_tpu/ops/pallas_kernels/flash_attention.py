@@ -37,12 +37,20 @@ from jax import lax
 _NEG_INF = -1e30
 
 
-def _use_pallas(q):
+def _use_pallas(q, kv_len=None):
     if jax.default_backend() != "tpu":
         return False
     # Pallas path wants the blocked dims tile-aligned; the wrapper pads S,
     # but tiny head_dim is better served by XLA.
-    return q.shape[-1] >= 32
+    if q.shape[-1] < 32:
+        return False
+    # the kernels hold one head's full K/V (and Q in the dk/dv pass) in
+    # VMEM with double-buffering; beyond ~12 MB of streamed operands the
+    # blockwise jnp path must take over (single-chip ultra-long context —
+    # ring attention shards S across devices long before this triggers)
+    s = kv_len if kv_len is not None else q.shape[2]
+    itemsize = jnp.dtype(q.dtype).itemsize
+    return 4 * s * q.shape[-1] * itemsize <= 12 * 1024 * 1024
 
 
 try:  # pallas is TPU-only in some builds; import lazily and gate on backend
@@ -503,7 +511,7 @@ def _flash_bwd(scale, causal, block_k, res, grads):
 def _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
     qo = jnp.asarray(q_off, jnp.int32)
     ko = jnp.asarray(k_off, jnp.int32)
-    if _HAS_PALLAS and _use_pallas(q):
+    if _HAS_PALLAS and _use_pallas(q, kv_len=k.shape[2]):
         return _flash_fwd_pallas(q, k, v, qo, ko, scale, causal,
                                  block_q, block_k)
     return _flash_fwd_jnp(q, k, v, qo, ko, scale, causal, block_k)
@@ -522,7 +530,8 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, grads):
     q = res[0]
     # MXNET_FLASH_BWD=jnp forces the scan fallback (escape hatch while the
     # Pallas backward burns in on hardware)
-    use_pallas = (_HAS_PALLAS and _use_pallas(q)
+    use_pallas = (_HAS_PALLAS
+                  and _use_pallas(q, kv_len=res[1].shape[2])
                   and os.environ.get("MXNET_FLASH_BWD", "pallas") != "jnp")
     if use_pallas:
         return _flash_bwd_pallas(scale, causal, block_q, block_k, res,
