@@ -60,7 +60,11 @@ def _use_pallas(x, w):
     n, d = x.shape
     v = w.shape[0]
     # tiling wants MXU-aligned dims; tiny heads are better served by XLA
-    return d % 128 == 0 and n >= 256 and v >= 1024
+    if d % 128 != 0 or n < 256 or v < 1024:
+        return False
+    # the forward kernel's online-softmax state is 3 x n x f32 in VMEM
+    # scratch: cap so it never crowds out the working blocks
+    return 3 * n * 4 <= 8 * 1024 * 1024
 
 
 # ---------------------------------------------------------------------------
