@@ -80,7 +80,10 @@ def main():
         # standard floor-mode ResNet geometry (56/28/14/7 stages): the
         # reference's ceil-mode default inflates every stage to 57/29/15/8,
         # ~17% wasted FLOPs + HBM traffic on TPU-hostile shapes
-        pooling_convention=os.environ.get("BENCH_POOLCONV", "valid"))
+        pooling_convention=os.environ.get("BENCH_POOLCONV", "valid"),
+        # BENCH_GHOST_BN=32: per-sub-batch BN statistics (the roofline
+        # ceiling-breaker experiment; changes numerics, off by default)
+        ghost_batch=int(os.environ.get("BENCH_GHOST_BN", "0")))
     # use the largest device count that divides the batch (a 4-image debug
     # batch on the 8-device CPU mesh must not fault)
     n_avail = len(jax.devices())

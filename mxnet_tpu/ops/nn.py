@@ -537,6 +537,13 @@ class BatchNorm(OpDef):
         "momentum": Param(float, default=0.9),
         "fix_gamma": Param(bool, default=True),
         "use_global_stats": Param(bool, default=False),
+        # ghost batch norm (TPU extension, no reference analogue):
+        # statistics over sub-batches of this size instead of the full
+        # batch.  Shrinks the stat-reduction working set so XLA can keep
+        # per-ghost tiles resident — the candidate ceiling-breaker for the
+        # HBM-bound conv-net step (docs/mfu_roofline.md) — at the cost of
+        # slightly noisier statistics (a known regularizer).
+        "ghost_batch": Param(int, default=0),
     }
 
     def list_arguments(self, params):
@@ -565,13 +572,40 @@ class BatchNorm(OpDef):
         bshape = (1, -1) + (1,) * (x.ndim - 2)
         if params["fix_gamma"]:
             gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+        gb = int(params["ghost_batch"] or 0)
+        eps = jnp.asarray(params["eps"], x.dtype)
+        xhat = None  # normalized activations; affine applied once below
         if octx.is_train and not params["use_global_stats"]:
+            if gb > 0 and x.shape[0] > gb and x.shape[0] % gb != 0:
+                raise MXNetError(
+                    "BatchNorm ghost_batch=%d does not divide batch %d — "
+                    "the experiment would silently run full-batch BN"
+                    % (gb, x.shape[0]))
             # batch statistics and the EMA always accumulate in f32: under
             # bf16 compute, bf16 variance loses ~8 mantissa bits and EMA
             # deltas below 2^-8 vanish entirely
             x32 = x.astype(jnp.float32)
-            mean = jnp.mean(x32, axis=axes)
-            var = jnp.var(x32, axis=axes)
+            if gb > 0 and x.shape[0] > gb:
+                # per-ghost-group statistics and normalization; the EMA
+                # tracks the full-batch moments (mean of group means;
+                # group-var mean plus the between-group mean variance, so
+                # eval numerics stay calibrated to the whole batch)
+                g = x.shape[0] // gb
+                xg = x32.reshape((g, gb) + x.shape[1:])
+                gaxes = tuple(i for i in range(xg.ndim) if i != 2)[1:]
+                gmean = jnp.mean(xg, axis=gaxes)        # (g, C)
+                gvar = jnp.var(xg, axis=gaxes)          # (g, C)
+                mean = jnp.mean(gmean, axis=0)
+                var = jnp.mean(gvar, axis=0) + jnp.var(gmean, axis=0)
+                gshape = (g, 1, -1) + (1,) * (x.ndim - 2)
+                inv_g = jax.lax.rsqrt(
+                    gvar.astype(x.dtype).reshape(gshape) + eps)
+                xhat = ((xg.astype(x.dtype)
+                         - gmean.astype(x.dtype).reshape(gshape))
+                        * inv_g).reshape(x.shape)
+            else:
+                mean = jnp.mean(x32, axis=axes)
+                var = jnp.var(x32, axis=axes)
             m = params["momentum"]
             new_mean = (moving_mean.astype(jnp.float32) * m
                         + mean * (1 - m)).astype(moving_mean.dtype)
@@ -584,10 +618,10 @@ class BatchNorm(OpDef):
             aux_updates = [None, None]
         # normalize in the compute dtype (stats cast down at the use site)
         mean_c = mean.astype(x.dtype)
-        inv = jax.lax.rsqrt(var.astype(x.dtype).reshape(bshape)
-                            + jnp.asarray(params["eps"], x.dtype))
-        out = (x - mean_c.reshape(bshape)) * inv \
-            * gamma.astype(x.dtype).reshape(bshape) \
+        if xhat is None:
+            inv = jax.lax.rsqrt(var.astype(x.dtype).reshape(bshape) + eps)
+            xhat = (x - mean_c.reshape(bshape)) * inv
+        out = xhat * gamma.astype(x.dtype).reshape(bshape) \
             + beta.astype(x.dtype).reshape(bshape)
         return [out, mean_c, var.astype(x.dtype)], aux_updates
 

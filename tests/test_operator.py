@@ -451,3 +451,82 @@ def test_pooling_convention_valid_vs_full():
         mx.sym.Pooling(data=data, kernel=(2, 2),
                        pooling_convention="bogus").infer_shape(
             data=(1, 1, 8, 8))
+
+
+def test_batchnorm_ghost_batch():
+    """ghost_batch normalizes per sub-batch; EMA tracks full-batch moments
+    (law of total variance over the groups)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import OpCtx, get
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1
+    op = get("BatchNorm")
+    params = op.parse_params({"fix_gamma": False, "eps": 1e-5,
+                              "momentum": 0.0, "ghost_batch": 4})
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    aux = [np.zeros(3, np.float32), np.ones(3, np.float32)]
+    outs, aux_up = op.apply(OpCtx(is_train=True), params,
+                            [jnp.asarray(x), jnp.asarray(gamma),
+                             jnp.asarray(beta)],
+                            [jnp.asarray(a) for a in aux])
+    out = np.asarray(outs[0])
+    # each ghost group is independently standardized
+    for g in range(2):
+        grp = out[g * 4:(g + 1) * 4]
+        np.testing.assert_allclose(grp.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+        np.testing.assert_allclose(grp.std(axis=(0, 2, 3)), 1.0, atol=1e-3)
+    # momentum=0: EMA jumps straight to the full-batch moments
+    np.testing.assert_allclose(np.asarray(aux_up[0]),
+                               x.mean(axis=(0, 2, 3)), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(aux_up[1]),
+                               x.var(axis=(0, 2, 3)), rtol=1e-4, atol=1e-4)
+
+    # ghost_batch >= batch (or 0) falls back to plain BN
+    params0 = op.parse_params({"fix_gamma": False, "eps": 1e-5,
+                               "momentum": 0.0, "ghost_batch": 0})
+    outs0, _ = op.apply(OpCtx(is_train=True), params0,
+                        [jnp.asarray(x), jnp.asarray(gamma),
+                         jnp.asarray(beta)],
+                        [jnp.asarray(a) for a in aux])
+    params8 = op.parse_params({"fix_gamma": False, "eps": 1e-5,
+                               "momentum": 0.0, "ghost_batch": 8})
+    outs8, _ = op.apply(OpCtx(is_train=True), params8,
+                        [jnp.asarray(x), jnp.asarray(gamma),
+                         jnp.asarray(beta)],
+                        [jnp.asarray(a) for a in aux])
+    np.testing.assert_allclose(np.asarray(outs8[0]), np.asarray(outs0[0]),
+                               rtol=1e-6)
+
+
+def test_resnet_ghost_batch_trains():
+    """get_resnet(ghost_batch=...) binds and takes a training step."""
+    from mxnet_tpu import models
+
+    net = models.get_resnet(num_classes=4, num_layers=18,
+                            image_shape=(3, 32, 32), ghost_batch=2)
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=(4, 3, 32, 32))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.05
+    exe.arg_dict["data"][:] = rng.randn(4, 3, 32, 32).astype(np.float32)
+    exe.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 3], np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["stem_conv_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_batchnorm_ghost_batch_indivisible_rejected():
+    from mxnet_tpu.ops.registry import OpCtx, get
+    import jax.numpy as jnp
+
+    op = get("BatchNorm")
+    params = op.parse_params({"ghost_batch": 5})
+    with pytest.raises(mx.base.MXNetError):
+        op.apply(OpCtx(is_train=True), params,
+                 [jnp.zeros((8, 3, 2, 2)), jnp.ones(3), jnp.zeros(3)],
+                 [jnp.zeros(3), jnp.ones(3)])
