@@ -44,5 +44,8 @@ check_val /tmp/nightly_dist.log 0.98 "mnist lenet dist_sync"
 env PYTHONPATH= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     BENCH_BATCH=8 BENCH_IMAGE=64 BENCH_STEPS=2 BENCH_REPS=1 \
+    TBENCH_LAYERS=1 TBENCH_EMBED=64 TBENCH_HEADS=2 TBENCH_SEQ=64 \
+    TBENCH_BATCH=8 TBENCH_VOCAB=128 TBENCH_STEPS=2 TBENCH_REPS=1 \
+    TBENCH_DTYPE=float32 \
     python bench.py
 echo "nightly: all gates passed"
