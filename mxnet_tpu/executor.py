@@ -76,11 +76,11 @@ def _mirror_segments(order):
     run, count = [], 0
     for node in order:
         if node.is_variable:
-            # variables carry no compute; flush so bound args stay plain
-            if run:
-                segments.append((run, True))
-                run, count = [], 0
-            segments.append(([node], False))
+            # variables bind args straight from the caller — they carry no
+            # compute and no op in the graph depends on being *inside* a
+            # segment with them, so they must NOT cut op runs (each weight
+            # variable precedes its op in topo order; flushing here would
+            # cap every segment at ~1 op and nullify the memory trade)
             continue
         forced_boundary = boundary_attr(node)
         if forced_boundary:
@@ -163,9 +163,6 @@ def _build_graph_fn(symbol: Symbol):
             in_keys = []
             local = set()
             for node in nodes:
-                if node.is_variable:
-                    local.add((id(node), 0))
-                    continue
                 for s, i in node.inputs:
                     k = (id(s), i)
                     if k not in local and k not in in_keys:
@@ -187,13 +184,12 @@ def _build_graph_fn(symbol: Symbol):
         ]
 
         def _seg_fn(arg_arrays, aux_arrays, rng, is_train):
-            env = {}
+            # variables bind upfront: no op runs before its inputs exist
+            # in env, and variables never depend on ops
+            env = {(id(node), 0): arg_arrays[arg_index[node.name]]
+                   for node in order if node.is_variable}
             new_aux = list(aux_arrays)
             for nodes, remat, in_keys, out_keys in segment_plans:
-                if nodes[0].is_variable:
-                    node = nodes[0]
-                    env[(id(node), 0)] = arg_arrays[arg_index[node.name]]
-                    continue
                 aux_ranges = [aux_slots[id(n)] for n in nodes
                               if id(n) in aux_slots]
                 if not remat or not is_train:

@@ -146,11 +146,16 @@ def test_force_mirroring_attr_segments(monkeypatch):
     segs = _mirror_segments(_topo_order(net._heads))
     by_node = {}
     for nodes, remat in segs:
+        assert not any(n.is_variable for n in nodes)
         for n in nodes:
-            if not n.is_variable:
-                by_node[n.name] = remat
+            by_node[n.name] = remat
     assert by_node["fc2"] is False        # pinned boundary
     assert by_node["fc1"] and by_node["t1"]
+    # step=2 must actually produce 2-op segments: weight VARIABLES in the
+    # topo order must not cut the runs (that would cap segments at ~1 op
+    # and nullify the remat memory trade)
+    sizes = [len(nodes) for nodes, remat in segs if remat]
+    assert max(sizes) == 2, sizes
 
     out_ref, grads_ref = None, None
     _with_env(monkeypatch)
