@@ -106,6 +106,12 @@ def _load():
     lib.mxtpu_loader_reset.argtypes = [H]
     lib.mxtpu_loader_close.argtypes = [H]
 
+    try:  # per-batch decode-failure count (absent in older builds)
+        lib.mxtpu_loader_last_failed.restype = ctypes.c_int
+        lib.mxtpu_loader_last_failed.argtypes = [H]
+    except AttributeError:
+        pass
+
     try:  # u8 JPEG fast path (absent in older builds of the .so)
         lib.mxtpu_loader_open_u8.restype = H
         lib.mxtpu_loader_open_u8.argtypes = lib.mxtpu_loader_open.argtypes

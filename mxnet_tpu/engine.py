@@ -83,6 +83,20 @@ class Var:
         return ready
 
 
+# Vars held by the engine op executing on the CURRENT thread.  Lets a
+# sync point (NDArray._sync_host) detect "I am inside the op that owns
+# this var" and skip the wait — the reference never hits this because its
+# engine fns receive raw TBlobs, not NDArrays; ours run arbitrary Python
+# that may touch the arrays they are producing (e.g. the kvstore pull op
+# writing its out arrays).
+_tls = threading.local()
+
+
+def current_op_holds(var):
+    held = getattr(_tls, "held", None)
+    return held is not None and id(var) in held
+
+
 class _Opr:
     __slots__ = ("fn", "const_vars", "mutable_vars", "priority", "wait", "name")
 
@@ -207,11 +221,15 @@ class Engine:
                 if self._shutdown and not self._ready:
                     return
                 _, _, op = heapq.heappop(self._ready)
+            _tls.held = {id(v) for v in op.const_vars}
+            _tls.held.update(id(v) for v in op.mutable_vars)
             try:
                 op.fn()
             except Exception as e:  # surfaced at next sync point
                 with self._lock:
                     self._exceptions.append(e)
+            finally:
+                _tls.held = None
             self._complete(op)
 
     def _complete(self, op):
@@ -313,14 +331,18 @@ class NativeEngine:
         def _trampoline(arg):
             token = int(arg)
             with self._lock:
-                fn = self._callbacks.pop(token, None)
-            if fn is None:
+                entry = self._callbacks.pop(token, None)
+            if entry is None:
                 return
+            fn, held = entry
+            _tls.held = held  # same contract as Engine._worker
             try:
                 fn()
             except Exception as e:  # surfaced at next sync point
                 with self._lock:
                     self._exceptions.append(e)
+            finally:
+                _tls.held = None
 
         self._c_trampoline = _native._FN_T(_trampoline)  # keep alive
 
@@ -338,8 +360,10 @@ class NativeEngine:
         if any(id(v) in mset for v in const_vars):
             raise MXNetError("const_vars and mutable_vars overlap")
         token = next(self._tokens)
+        held = {id(v) for v in const_vars}
+        held.update(id(v) for v in mutable_vars)
         with self._lock:
-            self._callbacks[token] = fn
+            self._callbacks[token] = (fn, held)
         H = ctypes.c_int64
         cv = (H * max(1, len(const_vars)))(*[v.handle for v in const_vars])
         mv = (H * max(1, len(mutable_vars)))(*[v.handle for v in mutable_vars])

@@ -422,7 +422,7 @@ class Executor:
     def _gather(self, arrays):
         out = []
         for nd in arrays:
-            arr = nd.data if isinstance(nd, NDArray) else jnp.asarray(arr)
+            arr = nd.data if isinstance(nd, NDArray) else jnp.asarray(nd)
             if self._device is not None and getattr(arr, "device", None) != self._device:
                 arr = jax.device_put(arr, self._device)
             out.append(arr)

@@ -16,6 +16,7 @@ training shards input files the same way.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 import threading
@@ -465,6 +466,11 @@ class ImageRecordIter(DataIter):
         self._data_name = data_name
         self._label_name = label_name
         kind = self._payload_kind()
+        # decode failures (zero-filled samples) observed so far; surfaced
+        # from the native loader's per-batch count so mixed/corrupt .rec
+        # files don't silently train on zeros
+        self.decode_failures = 0
+        self._warned_decode_fail = False
         if use_native is None:
             use_native = _native.available() and kind in ("npy", "jpeg")
         self._native = bool(use_native) and _native.available()
@@ -509,27 +515,44 @@ class ImageRecordIter(DataIter):
                 else self._resync(raw_begin, fsize)
             self._f.seek(self._begin)
 
-    def _payload_kind(self):
-        """Sniff the first record's payload kind ('npy' / 'jpeg' /
-        'other').  The C++ loader handles .npy and JPEG; anything else
-        (PNG) must take the Python/PIL path rather than silently
-        zero-filling samples."""
+    def _payload_kind(self, sample=8):
+        """Sniff the payload kind ('npy' / 'jpeg' / 'other') of the first
+        few records — not just the first, so a mixed-payload .rec (JPEG
+        head, PNG tail) is caught up front.  The C++ loader handles .npy
+        and JPEG (in float mode, per record); anything else (PNG) must
+        take the Python/PIL path rather than silently zero-filling
+        samples.  A mixed jpeg/npy file routes to the native float path
+        ('npy'), which dispatches per record; any 'other' forces Python.
+        Deeper mixing is caught at runtime by the loader's per-batch
+        decode-failure count (`mxtpu_loader_last_failed`)."""
+        kinds = set()
         try:
             with open(self._path, "rb") as f:
-                head = f.read(8)
-                if len(head) < 8:
-                    return "other"
-                magic, lrec = struct.unpack("<II", head)
-                if magic != 0xCED7230A:
-                    return "other"
-                payload = f.read(min(lrec & ((1 << 29) - 1), 32))
+                for _ in range(sample):
+                    head = f.read(8)
+                    if len(head) < 8:
+                        break
+                    magic, lrec = struct.unpack("<II", head)
+                    if magic != 0xCED7230A:
+                        return "other"
+                    ln = lrec & ((1 << 29) - 1)
+                    payload = f.read(min(ln, 32))
+                    body = payload[24:24 + 6]
+                    if body[:6] == b"\x93NUMPY":
+                        kinds.add("npy")
+                    elif body[:3] == b"\xff\xd8\xff":
+                        kinds.add("jpeg")
+                    else:
+                        return "other"
+                    skip = ln - len(payload)
+                    skip += (4 - ln % 4) % 4
+                    f.seek(skip, 1)
         except OSError:
             return "other"
-        body = payload[24:24 + 6]
-        if body[:6] == b"\x93NUMPY":
-            return "npy"
-        if body[:3] == b"\xff\xd8\xff":
+        if kinds == {"jpeg"}:
             return "jpeg"
+        if kinds:
+            return "npy"  # npy, or mixed npy+jpeg: native float path
         return "other"
 
     @property
@@ -586,6 +609,18 @@ class ImageRecordIter(DataIter):
             n = nextfn(self._handle, self._data_ptr, self._label_ptr)
             if n <= 0:
                 raise StopIteration
+            if hasattr(self._lib, "mxtpu_loader_last_failed"):
+                failed = self._lib.mxtpu_loader_last_failed(self._handle)
+                if failed > 0:
+                    from . import _native
+                    self.decode_failures += failed
+                    if not self._warned_decode_fail:
+                        self._warned_decode_fail = True
+                        logging.warning(
+                            "ImageRecordIter: %d sample(s) in this batch "
+                            "failed to decode and were zero-filled (%s); "
+                            "cumulative count in .decode_failures",
+                            failed, _native.last_error())
             out = (self._finish_hwc_u8(self._data_buf) if self._native_u8
                    else self._finish(self._data_buf))
             return DataBatch(

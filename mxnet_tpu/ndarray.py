@@ -51,12 +51,22 @@ def _to_jax(value, dtype=None):
 class NDArray:
     """A multi-dimensional, device-resident array with async semantics."""
 
-    __slots__ = ("_data", "_parent", "_index", "_writable", "__weakref__")
+    __slots__ = ("_data", "_parent", "_index", "_writable", "_hvar",
+                 "__weakref__")
 
     def __init__(self, data, ctx=None, _parent=None, _index=None, writable=True):
         self._parent = _parent
         self._index = _index
         self._writable = writable
+        # pending-host-write mark: a `(engine var, generation token)` tuple
+        # set while an async host op (e.g. a kvstore pull,
+        # `kvstore_dist.h:137-164`'s engine-routed ZPull) has a pending
+        # write into this array; reads wait on the var (the reference's
+        # per-NDArray var dependency, created lazily instead of always).
+        # The fresh token per mark lets a reader clear exactly the mark it
+        # waited on — the var itself is one-per-key and would alias newer
+        # pending ops.
+        self._hvar = None
         if _parent is not None:
             self._data = None
         else:
@@ -69,9 +79,33 @@ class NDArray:
         engine.track_array(self)
 
     # -- core buffer access ----------------------------------------------
+    def _root(self):
+        nd = self
+        while nd._parent is not None:
+            nd = nd._parent
+        return nd
+
+    def _sync_host(self):
+        """Wait for pending host-engine writes into this array (async
+        kvstore pull); the var also orders us after the key's pushes.
+        A read from INSIDE the op that holds the var (the pull op touching
+        its own out array) must not wait on itself.  The clear compares
+        the whole (var, token) mark: a newer pending op re-marks with a
+        fresh token, so finishing an older wait never erases its mark."""
+        mark = self._hvar
+        if mark is not None:
+            var = mark[0]
+            if engine.current_op_holds(var):
+                return
+            engine.get().wait_for_var(var)
+            if self._hvar is mark:
+                self._hvar = None
+
     @property
     def data(self) -> jax.Array:
         """The underlying jax.Array (reads through views lazily)."""
+        if self._hvar is not None:
+            self._sync_host()
         if self._parent is not None:
             return self._parent.data[self._index]
         return self._data
@@ -79,6 +113,8 @@ class NDArray:
     def _set_data(self, value):
         if not self._writable:
             raise MXNetError("NDArray is not writable")
+        if self._hvar is not None:
+            self._sync_host()
         if self._parent is not None:
             self._parent._set_data(self._parent.data.at[self._index].set(value))
         else:

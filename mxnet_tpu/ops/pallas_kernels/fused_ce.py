@@ -132,9 +132,18 @@ def _fwd_pallas(x, w, b, label, grad_scale, ignore_label, use_ignore,
         _fwd_kernel, block_v=block_v, vocab=v, n_valid=n, block_n=block_n,
         grad_scale=grad_scale, ignore_label=ignore_label,
         use_ignore=use_ignore)
+    # INVARIANT: the nll/lse out blocks map to (0, i) independent of j, so
+    # the buffer is flushed to HBM once per j sweep and earlier sweeps
+    # write garbage that the FINAL j = num_j-1 sweep (where _fin runs)
+    # overwrites.  Correct only because grid dim 0 (j) executes
+    # sequentially — marked 'arbitrary' below to pin that assumption; the
+    # redundant flushes cost O(num_j * n) bytes, negligible next to the
+    # num_j x-tile re-reads.
     nll, lse = pl.pallas_call(
         kernel,
         grid=(num_j, num_i),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda j, i: (i, 0)),
             pl.BlockSpec((block_v, d), lambda j, i: (j, 0)),

@@ -189,6 +189,21 @@ class SGLD(Optimizer):
         weight._set_data(w - lr / 2 * (g + wd * w) + noise)
 
 
+def stochastic_round_bf16(x, key):
+    """Stochastically round float32 ``x`` to bfloat16.
+
+    With beta2=0.999 the per-step relative change of Adam's second-moment
+    EMA (~1e-3) sits below bf16's ~2^-8 ulp, so round-to-nearest makes the
+    increments vanish and the EMA stalls near steady state.  Adding 16
+    uniform random bits below the bf16 mantissa before truncating makes the
+    rounding unbiased: increments land with probability proportional to
+    their size, so the EMA is preserved in expectation."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    rnd = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    hi = (bits + rnd) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(hi, jnp.float32).astype(jnp.bfloat16)
+
+
 @Optimizer.register
 class Adam(Optimizer):
     """Adam (`optimizer.py` Adam; Kingma & Ba).
@@ -196,7 +211,9 @@ class Adam(Optimizer):
     ``v_dtype`` stores the second moment in a reduced precision
     ('bfloat16') to halve the optimizer-table HBM traffic on big
     embedding/head weights — a TPU extension with no reference analogue.
-    The moment math always runs in float32; only the stored table rounds.
+    The moment math always runs in float32; only the stored table rounds,
+    with stochastic rounding (``stochastic_round_bf16``) so the EMA does
+    not stall once updates drop below the bf16 ulp.
     """
 
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
@@ -223,7 +240,10 @@ class Adam(Optimizer):
         v = (self.beta2 * var.data.astype(jnp.float32)
              + (1 - self.beta2) * jnp.square(g))
         mean._set_data(m)
-        var._set_data(v.astype(self.v_dtype))
+        if self.v_dtype == jnp.bfloat16:
+            var._set_data(stochastic_round_bf16(v, _random.next_key()))
+        else:
+            var._set_data(v.astype(self.v_dtype))
         coef1 = 1 - self.beta1 ** t
         coef2 = 1 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1

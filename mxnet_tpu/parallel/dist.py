@@ -13,6 +13,7 @@ reference's multi-process nightly tests (`tests/nightly/dist_sync_kvstore.py`).
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
@@ -24,6 +25,7 @@ import zlib
 import numpy as np
 
 from ..base import MXNetError
+from .. import engine as _hengine
 from ..kvstore import KVStore
 from ..ndarray import NDArray, array
 
@@ -109,7 +111,8 @@ class ParameterServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(num_workers * 2)
+        # pooled worker connections: more than a couple per rank is normal
+        self._sock.listen(128)
         self._monitor = threading.Thread(target=self._watchdog, daemon=True)
         self._monitor.start()
 
@@ -312,6 +315,41 @@ class ParameterServer:
                 return
 
 
+class _ConnPool:
+    """Per-server TCP connection pool.  Engine-routed RPCs run
+    concurrently, and a BSP push blocks until the whole round arrives —
+    each in-flight RPC owns a connection for its round-trip, growing the
+    pool on demand (the role of ps-lite's multiplexed van channels,
+    `ps/internal/van.h`, done with blocking sockets)."""
+
+    def __init__(self, addr):
+        self.addr = addr
+        self._free = []
+        self._lock = threading.Lock()
+
+    def dial(self):
+        return socket.create_connection(self.addr, timeout=120)
+
+    def acquire(self):
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return self.dial()
+
+    def release(self, sock):
+        with self._lock:
+            self._free.append(sock)
+
+    def close_all(self):
+        with self._lock:
+            socks, self._free = self._free, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
 class DistKVStore(KVStore):
     """Worker-side distributed store (`kvstore_dist.h`): local merge then
     push/pull to the server(s); rank 0 inits (`kvstore_dist.h:49-60`).
@@ -332,19 +370,28 @@ class DistKVStore(KVStore):
         # connections until each is up (`ps::Postoffice` handshakes similarly)
         deadline = time.time() + float(
             os.environ.get("MXNET_KVSTORE_CONNECT_TIMEOUT", "120"))
-        self._socks = []
-        for addr in self._addrs:
+        self._pools = [_ConnPool(addr) for addr in self._addrs]
+        for pool in self._pools:
             while True:
                 try:
-                    self._socks.append(
-                        socket.create_connection(addr, timeout=120))
+                    pool.release(pool.dial())
                     break
                 except (ConnectionRefusedError, OSError):
                     if time.time() > deadline:
                         raise MXNetError(
-                            "cannot reach parameter server at %s:%d" % addr)
+                            "cannot reach parameter server at %s:%d"
+                            % pool.addr)
                     time.sleep(0.2)
-        self._sock_locks = [threading.Lock() for _ in self._socks]
+        # Engine-routed async push/pull (`kvstore_dist.h:76-95`): RPCs run
+        # as host-engine ops keyed by a per-key var, so pushes issued
+        # during/after backward overlap network time with compute, and
+        # priority (-key index from `model.py`) makes early-layer keys
+        # sync first.  Per-key FIFO comes from the var's write queue;
+        # reads of pulled arrays wait via NDArray._hvar.
+        self._engine = _hengine.get()
+        self._key_vars = {}
+        self._async_rpc = os.environ.get(
+            "MXNET_KVSTORE_ASYNC_PUSH", "1") == "1"
         if "async" in kv_type:
             for sid in range(self.num_servers):
                 self._rpc({"op": "set_sync", "sync": False}, server=sid)
@@ -405,10 +452,25 @@ class DistKVStore(KVStore):
                     pass
 
     def _rpc(self, msg, server=0):
+        """One request/reply on a pooled per-server connection.  A BSP push
+        can block server-side until every rank's push arrives; checking a
+        connection OUT for the whole round-trip (instead of locking one
+        shared socket) means concurrent engine-routed RPCs to the same
+        server never wait on each other's acks — with async per-rank key
+        order, a shared-socket lock deadlocks ranks against each other."""
         msg.setdefault("rank", self.rank)
-        with self._sock_locks[server]:
-            _send_msg(self._socks[server], msg)
-            reply = _recv_msg(self._socks[server])
+        pool = self._pools[server]
+        sock = pool.acquire()
+        try:
+            _send_msg(sock, msg)
+            reply = _recv_msg(sock)
+        except BaseException:
+            try:
+                sock.close()  # connection state unknown: don't reuse
+            except OSError:
+                pass
+            raise
+        pool.release(sock)
         if isinstance(reply, dict) and "error" in reply:
             raise MXNetError(reply["error"])
         return reply
@@ -458,23 +520,117 @@ class DistKVStore(KVStore):
         for t in threads:
             t.join()
         if errs:
+            ok_sids = [reqs[i][0] for i in range(len(reqs))
+                       if out[i] is not None]
+            bad_sids = [reqs[i][0] for i in range(len(reqs))
+                        if out[i] is None]
+            mutating = any(m.get("op") in ("push", "init")
+                           for _, m in reqs)
+            if ok_sids and mutating:
+                # Partial PUSH failure: the servers in ok_sids already
+                # accepted their shard and sit mid-BSP-round waiting for
+                # peers.  Leave LOUDLY (no goodbye): silence trips their
+                # watchdog, which fail-fast-releases every blocked
+                # BSP/barrier waiter instead of letting peer ranks hang.
+                # (A partial PULL is read-only — no server blocks on it —
+                # so it just raises and stays retryable.)
+                self._abort(
+                    "partial shard RPC: servers %s accepted, %s failed: %s"
+                    % (ok_sids, bad_sids, errs[0]))
+                raise MXNetError(
+                    "partial shard RPC (servers %s accepted, %s failed); "
+                    "rank %d aborted so the server watchdog releases "
+                    "blocked peers: %s"
+                    % (ok_sids, bad_sids, self.rank, errs[0])) from errs[0]
             raise errs[0]
         return out
 
+    def _abort(self, reason):
+        """Fail this rank loudly after an unrecoverable mid-round error:
+        stop heartbeating WITHOUT deregistering (`goodbye` would make the
+        servers forget us and peers would block forever on our missing
+        shard), close the sockets, and let the server watchdog declare the
+        rank dead — its fail-fast path releases all blocked BSP waiters
+        (the recovery contract of `_watchdog`)."""
+        logging.error("DistKVStore rank %d aborting: %s", self.rank, reason)
+        hb = getattr(self, "_hb_stop", None)
+        if hb is not None:
+            hb.set()
+        for pool in self._pools:
+            pool.close_all()
+
+    def _key_var(self, k):
+        v = self._key_vars.get(k)
+        if v is None:
+            v = self._engine.new_variable()
+            self._key_vars[k] = v
+        return v
+
+    def _drain(self):
+        """Wait for all queued push/pull engine ops (ordering fence before
+        barrier / optimizer install / shutdown)."""
+        for v in list(self._key_vars.values()):
+            self._engine.wait_for_var(v)
+
+    def _push_one(self, k, merged):
+        merged = np.asarray(merged)  # device->host read, off-caller-thread
+        reqs = []
+        for sid, sl in self._route(k, merged.size):
+            shard = merged if sl is None \
+                else merged.reshape(-1)[sl[0]:sl[1]]
+            reqs.append((sid, {"op": "push", "key": k,
+                               "value": np.ascontiguousarray(shard)}))
+        self._rpc_shards(reqs)
+
     def push(self, key, value, priority=0):
+        """Async: the RPC (device->host grad read + socket round-trip) runs
+        as a host-engine op so it overlaps the still-running backward, with
+        per-key priority — the reference pushed inside an engine op the
+        same way (`kvstore_dist.h:76-95`, priority from `model.py:96-98`)."""
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
         for k, vlist in zip(keys, vals):
-            merged = np.asarray(self._merge(vlist))
-            reqs = []
-            for sid, sl in self._route(k, merged.size):
-                shard = merged if sl is None \
-                    else merged.reshape(-1)[sl[0]:sl[1]]
-                reqs.append((sid, {"op": "push", "key": k,
-                                   "value": np.ascontiguousarray(shard)}))
-            self._rpc_shards(reqs)
+            # Merge NOW, on the caller thread: jax arrays are immutable, so
+            # snapshotting the (lazily computed) merged value here makes a
+            # later caller write to the grad NDArray invisible to the
+            # queued op — the functional equivalent of the reference's
+            # const-var dep on the grads (`kvstore_dist.h:76-95`).  The
+            # blocking device->host read still happens on the engine
+            # thread.
+            merged = self._merge(vlist)
+            if not self._async_rpc:
+                self._push_one(k, merged)
+                continue
+            self._engine.push(
+                lambda k=k, merged=merged: self._push_one(k, merged),
+                mutable_vars=[self._key_var(k)], priority=priority,
+                name="kv_push_%s" % (k,))
+
+    def _pull_one(self, k, olist):
+        size = int(np.prod(olist[0].shape)) if olist[0].shape else 1
+        route = self._route(k, size)
+        if len(route) == 1:
+            val = self._rpc({"op": "pull", "key": k},
+                            server=route[0][0])["value"]
+        else:
+            replies = self._rpc_shards(
+                [(sid, {"op": "pull", "key": k}) for sid, _ in route])
+            val = np.concatenate(
+                [r["value"].reshape(-1) for r in replies])
+            val = val.reshape(olist[0].shape)
+        src = array(val)
+        for o in olist:
+            # NOT cleared here: _key_var caches ONE var per key, so a
+            # newer queued pull re-marks with the same object and an
+            # identity check could clear ITS pending mark (stale read).
+            # The reader clears after waiting (NDArray._sync_host); our
+            # own writes skip the wait via engine.current_op_holds.
+            src.copyto(o)
 
     def pull(self, key, out=None, priority=0):
+        """Async like push: ordered after the key's pushes by the shared
+        key var; readers of ``out`` synchronize through NDArray._hvar
+        (the reference's per-NDArray var dep, `kvstore_dist.h:137-164`)."""
         if out is None:
             raise MXNetError("pull requires out=")
         keys, _ = self._keylist(key)
@@ -485,22 +641,20 @@ class DistKVStore(KVStore):
         else:
             outs = [[o] if isinstance(o, NDArray) else list(o) for o in out]
         for k, olist in zip(keys, outs):
-            size = int(np.prod(olist[0].shape)) if olist[0].shape else 1
-            route = self._route(k, size)
-            if len(route) == 1:
-                val = self._rpc({"op": "pull", "key": k},
-                                server=route[0][0])["value"]
-            else:
-                replies = self._rpc_shards(
-                    [(sid, {"op": "pull", "key": k}) for sid, _ in route])
-                val = np.concatenate(
-                    [r["value"].reshape(-1) for r in replies])
-                val = val.reshape(olist[0].shape)
-            src = array(val)
+            if not self._async_rpc:
+                self._pull_one(k, olist)
+                continue
+            var = self._key_var(k)
+            mark = (var, object())  # fresh token per mark (see _sync_host)
             for o in olist:
-                src.copyto(o)
+                o._root()._hvar = mark
+            self._engine.push(
+                lambda k=k, olist=olist: self._pull_one(k, olist),
+                mutable_vars=[var], priority=priority,
+                name="kv_pull_%s" % (k,))
 
     def set_optimizer(self, optimizer):
+        self._drain()
         if self.rank == 0:
             blob = pickle.dumps(optimizer)
             for sid in range(self.num_servers):
@@ -509,10 +663,13 @@ class DistKVStore(KVStore):
         self.barrier()
 
     def barrier(self):
+        # all queued async pushes/pulls must land before the barrier rpc
+        self._drain()
         # one barrier authority (server 0), like the reference's scheduler
         self._rpc({"op": "barrier"}, server=0)
 
     def stop_server(self):
+        self._drain()
         if self.rank == 0:
             for sid in range(self.num_servers):
                 self._rpc({"op": "stop"}, server=sid)
@@ -522,6 +679,10 @@ class DistKVStore(KVStore):
         """Deliberately leave the job: stop heartbeating, tell the servers
         to deregister this rank (so our silence doesn't trip the watchdog
         for the ranks still running), and drop the connections."""
+        try:
+            self._drain()
+        except Exception:  # noqa: BLE001 - failed queued RPCs surface as
+            pass  # raw socket errors too; none may block a clean leave
         hb = getattr(self, "_hb_stop", None)
         if hb is not None:
             hb.set()
@@ -531,11 +692,8 @@ class DistKVStore(KVStore):
                 self._rpc({"op": "goodbye"}, server=sid)
             except (OSError, MXNetError):
                 pass  # server already gone
-        for s in self._socks:
-            try:
-                s.close()
-            except OSError:
-                pass
+        for pool in self._pools:
+            pool.close_all()
 
 
 def run_server():

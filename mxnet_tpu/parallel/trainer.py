@@ -28,6 +28,7 @@ import os
 from ..base import MXNetError
 from ..executor import _build_graph_fn, _mirror_policy
 from ..ndarray import NDArray
+from ..optimizer import stochastic_round_bf16
 from .. import random as _random
 
 
@@ -73,21 +74,33 @@ def _adam_update(params, grads, state, lr, wd, rescale, b1, b2, eps,
     gradient, bias-corrected lr).  state: {"_t": count, k: (m, v)}.
 
     ``v_dtype`` (e.g. bfloat16) stores the second-moment table in reduced
-    precision — the moment math stays float32, only the stored v rounds —
-    halving the biggest optimizer-state HBM stream (the embedding/head
-    tables read+written every step)."""
+    precision — the moment math stays float32, only the stored v rounds
+    (stochastically, see `optimizer.stochastic_round_bf16`: RTNE would
+    stall the EMA once updates drop below the bf16 ulp) — halving the
+    biggest optimizer-state HBM stream (the embedding/head tables
+    read+written every step)."""
     t = state["_t"] + 1
     coef1 = 1 - b1 ** t
     coef2 = 1 - b2 ** t
     lr_t = lr * jnp.sqrt(coef2) / coef1
+    sr_bf16 = v_dtype is not None and jnp.dtype(v_dtype) == jnp.bfloat16
+    if sr_bf16:
+        # key is a pure function of the step count: reproducible, and
+        # traced inside jit so no key threading through the step signature
+        step_key = jax.random.fold_in(jax.random.PRNGKey(0x51ca57), t)
     new_state = {"_t": t}
     new_p = {}
-    for k, p in params.items():
+    for i, (k, p) in enumerate(params.items()):
         g = _clip(grads[k] * rescale, clip) + wd * _wd_mult(k) * p
         m, v = state[k]
         m = b1 * m + (1 - b1) * g
         v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
-        new_state[k] = (m, v.astype(v_dtype) if v_dtype else v)
+        if sr_bf16:
+            v_store = stochastic_round_bf16(
+                v, jax.random.fold_in(step_key, i))
+        else:
+            v_store = v.astype(v_dtype) if v_dtype else v
+        new_state[k] = (m, v_store)
         new_p[k] = p - lr_t * m / (jnp.sqrt(v) + eps)
     return new_p, new_state
 

@@ -149,6 +149,7 @@ struct Batch {
   std::vector<uint8_t> data_u8; // HWC uint8 mode (JPEG fast path)
   std::vector<float> label;
   int n = 0;
+  int failed = 0;  // samples left zero-filled by a decode failure
   bool epoch_end = false;
 };
 
@@ -229,9 +230,10 @@ class Loader {
     cv_prod_.notify_one();
     if (b.epoch_end) {
       // keep returning 0 until reset
-      queue_.push_front(Batch{{}, {}, {}, 0, true});
+      queue_.push_front(Batch{{}, {}, {}, 0, 0, true});
       return 0;
     }
+    last_failed_ = b.failed;
     memcpy(data, b.data.data(), b.data.size() * sizeof(float));
     memcpy(label, b.label.data(), b.label.size() * sizeof(float));
     return b.n;
@@ -244,12 +246,18 @@ class Loader {
     queue_.pop_front();
     cv_prod_.notify_one();
     if (b.epoch_end) {
-      queue_.push_front(Batch{{}, {}, {}, 0, true});
+      queue_.push_front(Batch{{}, {}, {}, 0, 0, true});
       return 0;
     }
+    last_failed_ = b.failed;
     memcpy(data, b.data_u8.data(), b.data_u8.size());
     memcpy(label, b.label.data(), b.label.size() * sizeof(float));
     return b.n;
+  }
+
+  int LastFailed() {
+    std::unique_lock<std::mutex> lk(mu_);
+    return last_failed_;
   }
 
   void Reset() {
@@ -308,21 +316,23 @@ class Loader {
       }
     }
     std::unique_lock<std::mutex> lk(mu_);
-    queue_.push_back(Batch{{}, {}, {}, 0, true});
+    queue_.push_back(Batch{{}, {}, {}, 0, 0, true});
     cv_cons_.notify_one();
   }
 
   void DecodeBatch(const std::vector<std::vector<char>>& raw, Batch* b) {
     std::atomic<size_t> next{0};
+    std::atomic<int> failed{0};
     auto work = [&] {
       for (;;) {
         size_t i = next.fetch_add(1);
         if (i >= raw.size()) return;
-        DecodeOne(raw[i], b, (int)i);
+        if (!DecodeOne(raw[i], b, (int)i)) failed.fetch_add(1);
       }
     };
     if (n_threads_ <= 1 || raw.size() <= 1) {
       work();
+      b->failed = failed.load();
       return;
     }
     std::vector<std::thread> pool;
@@ -330,11 +340,12 @@ class Loader {
     for (int t = 0; t < nt - 1; ++t) pool.emplace_back(work);
     work();
     for (auto& t : pool) t.join();
+    b->failed = failed.load();
   }
 
-  void DecodeOne(const std::vector<char>& rec, Batch* b, int slot) {
+  bool DecodeOne(const std::vector<char>& rec, Batch* b, int slot) {
     // IRHeader 'IfQQ': u32 flag, f32 label, u64 id, u64 id2 (24 bytes)
-    if (rec.size() < 24) return;
+    if (rec.size() < 24) return false;
     float lbl;
     memcpy(&lbl, rec.data() + 4, 4);
     b->label[slot] = lbl;
@@ -356,7 +367,9 @@ class Loader {
     }
     if (!ok) {
       mxtpu_err() = err;  // sample left zero-filled
+      return false;
     }
+    return true;
   }
 
   mxtpu_handle reader_;
@@ -365,6 +378,7 @@ class Loader {
   int n_threads_;
   int prefetch_;
   bool u8_ = false;
+  int last_failed_ = 0;  // decode failures in the last batch Next() returned
 
   std::thread producer_;
   std::mutex mu_;
@@ -429,6 +443,12 @@ int mxtpu_loader_next_u8(mxtpu_handle h, uint8_t* data, float* label) {
   Loader* l = FindLoader(h);
   if (!l) { mxtpu_err() = "bad loader handle"; return -1; }
   return l->NextU8(data, label);
+}
+
+int mxtpu_loader_last_failed(mxtpu_handle h) {
+  Loader* l = FindLoader(h);
+  if (!l) { mxtpu_err() = "bad loader handle"; return -1; }
+  return l->LastFailed();
 }
 
 void mxtpu_loader_reset(mxtpu_handle h) {

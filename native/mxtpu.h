@@ -83,6 +83,10 @@ MXTPU_API mxtpu_handle mxtpu_loader_open_u8(const char* path,
                                             int n_threads, int prefetch);
 MXTPU_API int mxtpu_loader_next_u8(mxtpu_handle l, uint8_t* data,
                                    float* label);
+/* decode failures (samples left zero-filled) in the batch most recently
+ * returned by mxtpu_loader_next/_u8 — lets the caller detect mixed or
+ * corrupt payloads instead of silently training on zeros */
+MXTPU_API int mxtpu_loader_last_failed(mxtpu_handle l);
 MXTPU_API void mxtpu_loader_reset(mxtpu_handle l);
 MXTPU_API void mxtpu_loader_close(mxtpu_handle l);
 

@@ -143,3 +143,24 @@ def test_backward_mirror_env(monkeypatch):
     mirrored = run()
     for k in base:
         np.testing.assert_allclose(base[k], mirrored[k], rtol=1e-5, atol=1e-6)
+
+
+def test_bind_raw_numpy_args():
+    """Regression: binding raw numpy/jnp arrays (not NDArray) must work.
+
+    The old `_gather` referenced its loop temp before assignment for the
+    first non-NDArray arg (NameError) and silently reused the *previous*
+    iteration's array afterwards — a wrong-result path."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b * 2.0
+    a_np = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b_np = np.ones((2, 3), dtype=np.float32)
+    # first bound array is raw numpy → old code raised NameError here
+    exe = c.bind(mx.cpu(), {"a": a_np, "b": b_np})
+    out = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, a_np + 2.0, rtol=1e-6)
+    # mixed NDArray + raw: old code silently fed `a`'s data in for `b`
+    exe2 = c.bind(mx.cpu(), {"a": mx.nd.array(a_np), "b": b_np})
+    out2 = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out2, a_np + 2.0, rtol=1e-6)

@@ -266,3 +266,55 @@ def test_image_record_iter_u8_fast_path_matches_decode():
     expect = np.stack(imgs).transpose(0, 3, 1, 2).astype(np.float32)
     np.testing.assert_array_equal(got, expect)
     assert b.label[0].asnumpy().tolist() == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_payload_kind_mixed_sniff(tmp_path):
+    """_payload_kind samples several records: a mixed JPEG+PNG .rec must
+    NOT route to the native loader (which would zero-fill the PNGs)."""
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "mixed.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(3)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img,
+                                img_fmt=".jpg", quality=95))
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 1.0, 1, 0), img,
+                                img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=2)
+    assert not it._native  # PNG in the sample forces the Python/PIL path
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 8, 8)
+
+
+def test_native_loader_decode_failure_count(tmp_path):
+    """A corrupt record past the sniff window is zero-filled by the native
+    loader; the per-batch failure count must surface on the iterator."""
+    from mxnet_tpu import _native, recordio
+
+    if not _native.available():
+        pytest.skip("native lib not built")
+    path = str(tmp_path / "corrupt.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(5)
+    hdr = struct.Struct("<IfQQ")
+    for i in range(10):
+        img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".jpg",
+            quality=95))
+    # record 11: valid header, JPEG SOI magic, garbage body -> decode fails
+    rec.write(hdr.pack(0, 10.0, 10, 0) + b"\xff\xd8\xff" + b"\x00" * 64)
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                               batch_size=11, use_native=True)
+    assert it._native
+    b = next(it)
+    assert b.pad == 0
+    assert it.decode_failures == 1
+    # the corrupt sample (slot 10) is zero-filled, good ones are not
+    d = b.data[0].asnumpy()
+    assert float(np.abs(d[10]).sum()) == 0.0
+    assert float(np.abs(d[0]).sum()) > 0.0
