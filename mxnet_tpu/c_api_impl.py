@@ -1,0 +1,252 @@
+"""Python side of the general C ABI (`native/c_api.cc`).
+
+The reference's general C ABI (`/root/reference/src/c_api/c_api.cc:1-1507`,
+~100 ``MX*`` entry points) fronted a C++ runtime; here the runtime IS
+Python+XLA, so the C layer embeds CPython (same pattern as
+`native/predict_api.cc`) and calls the thin marshaling helpers in this
+module.  Scope is the serving-adjacent subset recorded in
+`docs/decisions.md` ADR-9: NDArray create/copy/save/load, registered-op
+invoke, symbol load/save/introspection/infer-shape, executor
+bind/forward/backward/outputs.  Graph *construction* from C (atomic-symbol
+creators, compose), KVStore and DataIter C surfaces stay Python-only —
+they exist for the aux language bindings SURVEY §2.12 scopes out.
+
+Everything here takes/returns only simple types (ints, bytes, str, lists,
+tuples and opaque objects the C side holds as PyObject*), keeping the C
+marshaling mechanical.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd_mod
+from . import random as random_mod
+from .base import MXNetError
+from .context import Context
+from .ndarray import NDArray
+from .ops.registry import get as registry_get, list_ops
+from .symbol import load as sym_load, loads as sym_loads
+
+_DTYPES = ["float32", "float64", "float16", "uint8", "int32"]  # reference
+# type codes (`mshadow/base.h` kFloat32..kInt32 order)
+
+
+def _marshal_dtype(nd):
+    """The numpy dtype this array is presented as across the C boundary.
+    bfloat16 has no reference type code, so it marshals as float32 —
+    GetDType, itemsize, and both SyncCopy directions all use this one
+    mapping so the C caller's (code, itemsize, bytes) view is coherent."""
+    dt = np.dtype(nd.dtype)
+    return np.dtype(np.float32) if dt.name == "bfloat16" else dt
+
+
+def _dtype_code(dt):
+    try:
+        return _DTYPES.index(np.dtype(dt).name)
+    except ValueError:
+        return -1
+
+
+def random_seed(seed):
+    random_mod.seed(int(seed))
+
+
+# -- NDArray ---------------------------------------------------------------
+
+def nd_create(shape, dev_type, dev_id, dtype_code):
+    ctx = Context(("cpu", "gpu", "tpu")[dev_type - 1] if dev_type in (1, 2, 3)
+                  else "cpu", dev_id)
+    dt = _DTYPES[dtype_code] if 0 <= dtype_code < len(_DTYPES) else "float32"
+    return nd_mod.zeros(tuple(int(s) for s in shape), ctx=ctx, dtype=dt)
+
+
+def nd_copy_from(nd, buf):
+    """buf: bytes of the marshal dtype, exactly nd.size elements."""
+    arr = np.frombuffer(buf, dtype=_marshal_dtype(nd))
+    if arr.size != nd.size:
+        raise MXNetError("SyncCopyFromCPU: expected %d elements, got %d"
+                         % (nd.size, arr.size))
+    nd[:] = arr.reshape(nd.shape)
+
+
+def nd_to_bytes(nd):
+    return np.ascontiguousarray(
+        nd.asnumpy().astype(_marshal_dtype(nd), copy=False)).tobytes()
+
+
+def nd_itemsize(nd):
+    return int(_marshal_dtype(nd).itemsize)
+
+
+def wait_all():
+    from . import engine
+    engine.wait_for_all()
+
+
+def nd_shape(nd):
+    return tuple(int(s) for s in nd.shape)
+
+
+def nd_dtype(nd):
+    return _dtype_code(_marshal_dtype(nd))
+
+
+def nd_save(fname, handles, names):
+    data = ({n: a for n, a in zip(names, handles)} if names
+            else list(handles))
+    nd_mod.save(fname, data)
+
+
+def nd_load(fname):
+    """Returns (list_of_ndarrays, list_of_names_or_empty)."""
+    out = nd_mod.load(fname)
+    if isinstance(out, dict):
+        names = list(out.keys())
+        return [out[n] for n in names], names
+    return list(out), []
+
+
+# -- registered-op invoke (`MXFuncInvoke` family) --------------------------
+
+def _describe(name):
+    """(num_use_vars, num_scalars, num_mutate_vars) when the op is
+    imperatively invokable with the reference FunctionRegistry's fixed
+    tensor+scalar calling convention; None otherwise (graph-only ops with
+    structured params/aux state, like Convolution — the reference's
+    registry also only held the simple NDArray functions)."""
+    op = registry_get(name)
+    if op.key_var_num_args or op.need_rng:
+        return None
+    scalars = [p for p, v in op.params.items()
+               if v.required and v.type is float]
+    other_req = [p for p, v in op.params.items()
+                 if v.required and v.type is not float]
+    if other_req:
+        return None
+    params = op.parse_params({p: 0.0 for p in scalars})
+    if op.list_aux(params):
+        return None
+    return (len(op.list_arguments(params)), len(scalars),
+            len(op.list_outputs(params)))
+
+
+def func_list():
+    """Stable name list of invokable ops; the C FunctionHandle is an
+    index into it."""
+    return [n for n in sorted(list_ops()) if _describe(n) is not None]
+
+
+def func_describe(name):
+    d = _describe(name)
+    if d is None:
+        raise MXNetError("op %r is not imperatively invokable" % name)
+    return d
+
+
+def _nd_fn(name):
+    from . import nd
+    fn = getattr(nd, name, None)
+    if fn is None or not callable(fn):
+        raise MXNetError("op %r has no mx.nd entry point" % name)
+    return fn
+
+
+def func_info(name):
+    fn = _nd_fn(name)
+    doc = (fn.__doc__ or "").strip()
+    return name, doc.split("\n")[0] if doc else ""
+
+
+def func_invoke(name, used_vars, scalars, mutate_vars):
+    """Invoke a registered op: ``mutate_vars[i][:] = op(*used_vars,
+    *scalars)`` (outputs copied into the caller's arrays, the reference's
+    mutate-var convention)."""
+    fn = _nd_fn(name)
+    out = fn(*used_vars, *[float(s) for s in scalars])
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    if len(mutate_vars) != len(outs):
+        raise MXNetError("%s returns %d outputs, %d mutate vars given"
+                         % (name, len(outs), len(mutate_vars)))
+    for dst, src in zip(mutate_vars, outs):
+        if isinstance(src, NDArray):
+            src.copyto(dst)
+        else:
+            dst[:] = src
+    return len(outs)
+
+
+# -- Symbol ----------------------------------------------------------------
+
+def symbol_from_file(fname):
+    return sym_load(fname)
+
+
+def symbol_from_json(json_str):
+    return sym_loads(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_save(sym, fname):
+    sym.save(fname)
+
+
+def symbol_name(sym):
+    return sym.name or ""
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_infer_shape(sym, names, shapes, partial):
+    """names: known-arg names; shapes: their shapes.  Returns
+    (arg_shapes, out_shapes, aux_shapes) with () for unknown (partial)."""
+    kwargs = {n: tuple(s) for n, s in zip(names, shapes)}
+    fn = sym.infer_shape_partial if partial else sym.infer_shape
+    arg, out, aux = fn(**kwargs)
+    clean = lambda ls: [tuple(s) if s is not None else () for s in ls]
+    return clean(arg), clean(out), clean(aux)
+
+
+# -- Executor --------------------------------------------------------------
+
+def executor_bind(sym, dev_type, dev_id, arg_handles, grad_handles,
+                  grad_req_codes, aux_handles):
+    """`MXExecutorBind` (`c_api.cc:965-1003`): positional arg/grad/aux
+    lists; grad_req codes 0=null 1=write 3=add."""
+    ctx = Context(("cpu", "gpu", "tpu")[dev_type - 1] if dev_type in (1, 2, 3)
+                  else "cpu", dev_id)
+    req_map = {0: "null", 1: "write", 2: "inplace", 3: "add"}
+    args = list(arg_handles)
+    grads = list(grad_handles) if grad_handles else None
+    reqs = [req_map.get(int(c), "write") for c in grad_req_codes] \
+        if grad_req_codes else "write"
+    aux = list(aux_handles) if aux_handles else None
+    return sym.bind(ctx, args, grads, reqs, aux)
+
+
+def executor_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+
+
+def executor_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+def executor_print(exe):
+    return exe.debug_str()

@@ -1,0 +1,828 @@
+/*
+ * General C ABI implementation (see c_api.h for the contract; reference
+ * surface: `src/c_api/c_api.cc:1-1507`).
+ *
+ * Same architecture as predict_api.cc: the runtime is Python+XLA, so this
+ * layer embeds CPython and marshals through `mxnet_tpu.c_api_impl` — every
+ * handle is an owned PyObject*, every entry point grabs the GIL (callable
+ * from any thread), and Python exceptions become the thread-local
+ * MXGetLastError string (the reference's API_BEGIN/API_END pattern,
+ * `src/c_api/c_api_error.h`).  Returned pointer payloads live in
+ * thread-local stores with the reference's MXAPIThreadLocalEntry
+ * lifetime: valid until the same thread's next MX* call.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+/* thread-local return-value stores (MXAPIThreadLocalEntry) */
+struct TLS {
+  std::vector<std::string> str_store;
+  std::vector<const char *> str_ptrs;
+  std::string ret_str;
+  /* three shape groups: in / out / aux */
+  std::vector<std::vector<mx_uint>> shape_store[3];
+  std::vector<const mx_uint *> shape_ptrs[3];
+  std::vector<mx_uint> shape_ndims[3];
+  std::vector<mx_uint> shape_buf;  /* MXNDArrayGetShape */
+  std::vector<void *> handles;
+  std::vector<const void *> func_handles;
+};
+thread_local TLS tls;
+
+PyObject *g_impl = nullptr;                 /* mxnet_tpu.c_api_impl */
+std::vector<std::string> g_func_names;      /* filled under the GIL */
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool ensure_python() {
+  static bool initialized = false;
+  static bool ok = false;
+  if (initialized) return ok;
+  initialized = true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  g_impl = PyImport_ImportModule("mxnet_tpu.c_api_impl");
+  if (g_impl == nullptr) {
+    set_error_from_python();
+    ok = false;
+  } else {
+    ok = true;
+  }
+  PyGILState_Release(st);
+  return ok;
+}
+
+/* call impl.fn(*args); returns NEW ref or nullptr with error set */
+PyObject *call_impl(const char *fn, PyObject *args) {
+  PyObject *f = PyObject_GetAttrString(g_impl, fn);
+  if (f == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+PyObject *uint_tuple(const mx_uint *v, mx_uint n) {
+  PyObject *t = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(v[i]));
+  return t;
+}
+
+/* handle list; NULL entries (or null_ok slots) become None */
+PyObject *handle_list(NDArrayHandle *arr, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *o = arr != nullptr && arr[i] != nullptr
+                      ? reinterpret_cast<PyObject *>(arr[i])
+                      : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+/* store a python list[str] into the TLS string store */
+bool store_str_list(PyObject *list, mx_uint *out_size,
+                    const char ***out_arr) {
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) {
+    set_error_from_python();
+    return false;
+  }
+  tls.str_store.clear();
+  tls.str_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(list, i);
+    const char *c = it != nullptr ? PyUnicode_AsUTF8(it) : nullptr;
+    if (c == nullptr) {
+      set_error_from_python();
+      Py_XDECREF(it);
+      return false;
+    }
+    tls.str_store.emplace_back(c);
+    Py_DECREF(it);
+  }
+  for (auto &s : tls.str_store) tls.str_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = tls.str_ptrs.data();
+  return true;
+}
+
+/* store a python list[tuple[int,...]] into TLS shape group `slot` */
+bool store_shape_group(PyObject *list, int slot, mx_uint *out_size,
+                       const mx_uint **out_ndim,
+                       const mx_uint ***out_data, bool *all_known) {
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) {
+    set_error_from_python();
+    return false;
+  }
+  auto &store = tls.shape_store[slot];
+  auto &ptrs = tls.shape_ptrs[slot];
+  auto &ndims = tls.shape_ndims[slot];
+  store.clear();
+  ptrs.clear();
+  ndims.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *shp = PySequence_GetItem(list, i);
+    if (shp == nullptr) {
+      set_error_from_python();
+      return false;
+    }
+    Py_ssize_t d = PySequence_Size(shp);
+    std::vector<mx_uint> dims;
+    for (Py_ssize_t j = 0; j < d; ++j) {
+      PyObject *v = PySequence_GetItem(shp, j);
+      dims.push_back(
+          static_cast<mx_uint>(v != nullptr ? PyLong_AsUnsignedLong(v) : 0));
+      Py_XDECREF(v);
+    }
+    Py_DECREF(shp);
+    if (d == 0 && all_known != nullptr) *all_known = false;
+    ndims.push_back(static_cast<mx_uint>(d));
+    store.push_back(std::move(dims));
+  }
+  for (auto &s : store) ptrs.push_back(s.data());
+  *out_size = static_cast<mx_uint>(n);
+  *out_ndim = ndims.data();
+  *out_data = ptrs.data();
+  return true;
+}
+
+int item_size_of(PyObject *nd) {
+  PyObject *args = PyTuple_Pack(1, nd);
+  PyObject *r = call_impl("nd_itemsize", args);
+  Py_DECREF(args);
+  if (r == nullptr) return -1;
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return static_cast<int>(v);
+}
+
+}  // namespace
+
+#define API_BEGIN()                        \
+  if (!ensure_python()) return -1;         \
+  PyGILState_STATE gil_ = PyGILState_Ensure(); \
+  int ret_ = 0;
+#define API_END()            \
+  PyGILState_Release(gil_);  \
+  return ret_;
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXRandomSeed(int seed) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(i)", seed);
+  PyObject *r = call_impl("random_seed", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+int MXNotifyShutdown(void) {
+  /* XLA owns device streams; nothing to tear down beyond python atexit */
+  return 0;
+}
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  (void)delay_alloc;  /* XLA buffers materialize lazily anyway */
+  API_BEGIN();
+  PyObject *shp = uint_tuple(shape, ndim);
+  PyObject *args = Py_BuildValue("(Niii)", shp, dev_type, dev_id, dtype);
+  PyObject *r = call_impl("nd_create", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    *out = r;  /* ownership to caller */
+  }
+  API_END();
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  API_BEGIN();
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  API_BEGIN();
+  PyObject *nd = reinterpret_cast<PyObject *>(handle);
+  int isz = item_size_of(nd);
+  if (isz <= 0) {
+    ret_ = -1;
+  } else {
+    PyObject *buf = PyBytes_FromStringAndSize(
+        static_cast<const char *>(data),
+        static_cast<Py_ssize_t>(size) * isz);
+    PyObject *args = PyTuple_Pack(2, nd, buf);
+    Py_DECREF(buf);
+    PyObject *r = call_impl("nd_copy_from", args);
+    Py_DECREF(args);
+    if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  API_BEGIN();
+  PyObject *nd = reinterpret_cast<PyObject *>(handle);
+  int isz = item_size_of(nd);
+  PyObject *args = isz > 0 ? PyTuple_Pack(1, nd) : nullptr;
+  PyObject *r = args != nullptr ? call_impl("nd_to_bytes", args) : nullptr;
+  Py_XDECREF(args);
+  if (r == nullptr || isz <= 0) {
+    ret_ = -1;
+  } else {
+    char *src = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(r, &src, &len) != 0 ||
+        len != static_cast<Py_ssize_t>(size) * isz) {
+      g_last_error = "SyncCopyToCPU: size mismatch";
+      ret_ = -1;
+    } else {
+      std::memcpy(data, src, static_cast<size_t>(len));
+    }
+  }
+  Py_XDECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject *r = PyObject_CallMethod(
+      reinterpret_cast<PyObject *>(handle), "wait_to_read", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    ret_ = -1;
+  } else {
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArrayWaitAll(void) {
+  API_BEGIN();
+  PyObject *args = PyTuple_New(0);
+  PyObject *r = call_impl("wait_all", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle));
+  PyObject *r = call_impl("nd_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    Py_ssize_t n = PyTuple_Size(r);
+    tls.shape_buf.clear();
+    for (Py_ssize_t i = 0; i < n; ++i)
+      tls.shape_buf.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i))));
+    Py_DECREF(r);
+    *out_dim = static_cast<mx_uint>(n);
+    *out_pdata = tls.shape_buf.data();
+  }
+  API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle));
+  PyObject *r = call_impl("nd_dtype", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    *out_dtype = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args_,
+                  const char **keys) {
+  API_BEGIN();
+  PyObject *hl = handle_list(args_, num_args);
+  PyObject *names;
+  if (keys != nullptr) {
+    names = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+  } else {
+    names = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *args = Py_BuildValue("(sNN)", fname, hl, names);
+  PyObject *r = call_impl("nd_save", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *r = call_impl("nd_load", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    PyObject *arrs = PyTuple_GET_ITEM(r, 0);
+    PyObject *names = PyTuple_GET_ITEM(r, 1);
+    Py_ssize_t n = PyList_Size(arrs);
+    tls.handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PyList_GET_ITEM(arrs, i);
+      Py_INCREF(it);  /* each handle is caller-owned */
+      tls.handles.push_back(it);
+    }
+    *out_size = static_cast<mx_uint>(n);
+    *out_arr = reinterpret_cast<NDArrayHandle *>(tls.handles.data());
+    if (!store_str_list(names, out_name_size, out_names)) ret_ = -1;
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+/* ---- registered-op invoke --------------------------------------------- */
+
+static bool ensure_func_names() {
+  if (!g_func_names.empty()) return true;
+  PyObject *args = PyTuple_New(0);
+  PyObject *r = call_impl("func_list", args);
+  Py_DECREF(args);
+  if (r == nullptr) return false;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_func_names.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  return true;
+}
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  API_BEGIN();
+  if (!ensure_func_names()) {
+    ret_ = -1;
+  } else {
+    tls.func_handles.clear();
+    for (size_t i = 0; i < g_func_names.size(); ++i)
+      tls.func_handles.push_back(
+          reinterpret_cast<const void *>(static_cast<uintptr_t>(i + 1)));
+    *out_size = static_cast<mx_uint>(g_func_names.size());
+    *out_array = tls.func_handles.data();
+  }
+  API_END();
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  API_BEGIN();
+  if (!ensure_func_names()) {
+    ret_ = -1;
+  } else {
+    *out = nullptr;
+    for (size_t i = 0; i < g_func_names.size(); ++i)
+      if (g_func_names[i] == name) {
+        *out = reinterpret_cast<const void *>(
+            static_cast<uintptr_t>(i + 1));
+        break;
+      }
+  }
+  API_END();
+}
+
+static const char *func_name_of(FunctionHandle fun) {
+  uintptr_t idx = reinterpret_cast<uintptr_t>(fun);
+  if (idx == 0 || idx > g_func_names.size()) return nullptr;
+  return g_func_names[idx - 1].c_str();
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions) {
+  API_BEGIN();
+  const char *fname = ensure_func_names() ? func_name_of(fun) : nullptr;
+  if (fname == nullptr) {
+    g_last_error = "invalid function handle";
+    ret_ = -1;
+  } else {
+    PyObject *args = Py_BuildValue("(s)", fname);
+    PyObject *r = call_impl("func_info", args);
+    Py_DECREF(args);
+    if (r == nullptr) {
+      ret_ = -1;
+    } else {
+      tls.str_store.clear();
+      tls.str_ptrs.clear();
+      tls.str_store.emplace_back(
+          PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0)));
+      tls.str_store.emplace_back(
+          PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1)));
+      Py_DECREF(r);
+      *name = tls.str_store[0].c_str();
+      *description = tls.str_store[1].c_str();
+      if (num_args != nullptr) *num_args = 0;
+      if (arg_names != nullptr) *arg_names = nullptr;
+      if (arg_type_infos != nullptr) *arg_type_infos = nullptr;
+      if (arg_descriptions != nullptr) *arg_descriptions = nullptr;
+    }
+  }
+  API_END();
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  API_BEGIN();
+  const char *fname = ensure_func_names() ? func_name_of(fun) : nullptr;
+  if (fname == nullptr) {
+    g_last_error = "invalid function handle";
+    ret_ = -1;
+  } else {
+    PyObject *args = Py_BuildValue("(s)", fname);
+    PyObject *r = call_impl("func_describe", args);
+    Py_DECREF(args);
+    if (r == nullptr) {
+      ret_ = -1;
+    } else {
+      *num_use_vars =
+          static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+      *num_scalars =
+          static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+      *num_mutate_vars =
+          static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(r, 2)));
+      *type_mask = 0;  /* kNDArrayArgBeforeScalar */
+      Py_DECREF(r);
+    }
+  }
+  API_END();
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  API_BEGIN();
+  const char *fname = ensure_func_names() ? func_name_of(fun) : nullptr;
+  mx_uint nu = 0, ns = 0, nm = 0;
+  int mask = 0;
+  /* PyGILState_Ensure nests, so the recursive describe call is safe */
+  if (fname == nullptr ||
+      MXFuncDescribe(fun, &nu, &ns, &nm, &mask) != 0) {
+    if (fname == nullptr) g_last_error = "invalid function handle";
+    PyGILState_Release(gil_);
+    return -1;
+  }
+  PyObject *uv = handle_list(use_vars, nu);
+  PyObject *sc = PyList_New(ns);
+  for (mx_uint i = 0; i < ns; ++i)
+    PyList_SET_ITEM(sc, i, PyFloat_FromDouble(scalar_args[i]));
+  PyObject *mv = handle_list(mutate_vars, nm);
+  PyObject *args = Py_BuildValue("(sNNN)", fname, uv, sc, mv);
+  PyObject *r = call_impl("func_invoke", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+static int sym_from(const char *impl_fn, const char *arg,
+                    SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", arg);
+  PyObject *r = call_impl(impl_fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else *out = r;
+  API_END();
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  return sym_from("symbol_from_file", fname, out);
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  return sym_from("symbol_from_json", json, out);
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Os)",
+                                 reinterpret_cast<PyObject *>(symbol),
+                                 fname);
+  PyObject *r = call_impl("symbol_save", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+static int str_getter(const char *impl_fn, void *handle,
+                      const char **out_str) {
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle));
+  PyObject *r = call_impl(impl_fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    const char *c = PyUnicode_AsUTF8(r);
+    if (c == nullptr) {
+      set_error_from_python();
+      ret_ = -1;
+    } else {
+      tls.ret_str = c;
+      *out_str = tls.ret_str.c_str();
+    }
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  return str_getter("symbol_to_json", symbol, out_json);
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  return MXNDArrayFree(symbol);  /* same owned-PyObject contract */
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  int rc = str_getter("symbol_name", symbol, out);
+  if (success != nullptr) *success = rc == 0 && **out != '\0';
+  return rc;
+}
+
+static int str_list_getter(const char *impl_fn, void *handle,
+                           mx_uint *out_size,
+                           const char ***out_str_array) {
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle));
+  PyObject *r = call_impl(impl_fn, args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    if (!store_str_list(r, out_size, out_str_array)) ret_ = -1;
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array) {
+  return str_list_getter("symbol_list_arguments", symbol, out_size,
+                         out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array) {
+  return str_list_getter("symbol_list_outputs", symbol, out_size,
+                         out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array) {
+  return str_list_getter("symbol_list_aux", symbol, out_size,
+                         out_str_array);
+}
+
+static int infer_shape_impl(SymbolHandle sym, mx_uint num_args,
+                            const char **keys, const mx_uint *arg_ind_ptr,
+                            const mx_uint *arg_shape_data,
+                            mx_uint *in_shape_size,
+                            const mx_uint **in_shape_ndim,
+                            const mx_uint ***in_shape_data,
+                            mx_uint *out_shape_size,
+                            const mx_uint **out_shape_ndim,
+                            const mx_uint ***out_shape_data,
+                            mx_uint *aux_shape_size,
+                            const mx_uint **aux_shape_ndim,
+                            const mx_uint ***aux_shape_data, int *complete,
+                            int partial) {
+  API_BEGIN();
+  PyObject *names = PyList_New(num_args);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyList_SET_ITEM(shapes, i, uint_tuple(arg_shape_data + lo, hi - lo));
+  }
+  PyObject *args = Py_BuildValue("(ONNi)",
+                                 reinterpret_cast<PyObject *>(sym), names,
+                                 shapes, partial);
+  PyObject *r = call_impl("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    bool known = true;
+    if (!store_shape_group(PyTuple_GET_ITEM(r, 0), 0, in_shape_size,
+                           in_shape_ndim, in_shape_data, &known) ||
+        !store_shape_group(PyTuple_GET_ITEM(r, 1), 1, out_shape_size,
+                           out_shape_ndim, out_shape_data, &known) ||
+        !store_shape_group(PyTuple_GET_ITEM(r, 2), 2, aux_shape_size,
+                           aux_shape_ndim, aux_shape_data, &known)) {
+      ret_ = -1;
+    } else if (complete != nullptr) {
+      *complete = known ? 1 : 0;
+    }
+    Py_DECREF(r);
+  }
+  API_END();
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete, 0);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys,
+                              const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete, 1);
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  API_BEGIN();
+  PyObject *args_l = handle_list(in_args, len);
+  PyObject *grads_l;
+  if (arg_grad_store != nullptr) {
+    grads_l = handle_list(arg_grad_store, len);
+  } else {
+    grads_l = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *reqs_l;
+  if (grad_req_type != nullptr) {
+    reqs_l = PyList_New(len);
+    for (mx_uint i = 0; i < len; ++i)
+      PyList_SET_ITEM(reqs_l, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  } else {
+    reqs_l = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *aux_l;
+  if (aux_states != nullptr && aux_states_len > 0) {
+    aux_l = handle_list(aux_states, aux_states_len);
+  } else {
+    aux_l = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *args = Py_BuildValue(
+      "(OiiNNNN)", reinterpret_cast<PyObject *>(symbol_handle), dev_type,
+      dev_id, args_l, grads_l, reqs_l, aux_l);
+  PyObject *r = call_impl("executor_bind", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else *out = r;
+  API_END();
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue(
+      "(Oi)", reinterpret_cast<PyObject *>(handle), is_train);
+  PyObject *r = call_impl("executor_forward", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  API_BEGIN();
+  PyObject *hg;
+  if (head_grads != nullptr && len > 0) {
+    hg = handle_list(head_grads, len);
+  } else {
+    hg = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *args = Py_BuildValue(
+      "(ON)", reinterpret_cast<PyObject *>(handle), hg);
+  PyObject *r = call_impl("executor_backward", args);
+  Py_DECREF(args);
+  if (r == nullptr) ret_ = -1; else Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  API_BEGIN();
+  PyObject *args = PyTuple_Pack(1, reinterpret_cast<PyObject *>(handle));
+  PyObject *r = call_impl("executor_outputs", args);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    ret_ = -1;
+  } else {
+    Py_ssize_t n = PyList_Size(r);
+    tls.handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PyList_GET_ITEM(r, i);
+      Py_INCREF(it);  /* caller-owned */
+      tls.handles.push_back(it);
+    }
+    Py_DECREF(r);
+    *out_size = static_cast<mx_uint>(n);
+    *out = reinterpret_cast<NDArrayHandle *>(tls.handles.data());
+  }
+  API_END();
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  return str_getter("executor_print", handle, out_str);
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  return MXNDArrayFree(handle);
+}
+
+}  /* extern "C" */

@@ -1,0 +1,164 @@
+/*
+ * General C ABI — the serving-adjacent subset of the reference's
+ * `src/c_api/c_api.cc` (~100 `MX*` entry points), re-fronted onto the
+ * Python+XLA runtime (ADR-9 in docs/decisions.md records the boundary:
+ * graph construction / KVStore / DataIter C surfaces are NOT rebuilt —
+ * they existed for the aux language bindings SURVEY §2.12 scopes out).
+ *
+ * Covered families (signatures follow the reference where they exist):
+ *   - error handling: MXGetLastError (thread-local, API_BEGIN/END style)
+ *   - globals: MXRandomSeed, MXNotifyShutdown, MXNDArrayWaitAll
+ *   - NDArray: create/free/copy/save/load/shape/dtype/wait
+ *   - registered-op invoke: MXListFunctions/MXGetFunction/MXFuncGetInfo/
+ *     MXFuncDescribe/MXFuncInvoke (the FunctionRegistry convention:
+ *     fixed-arity tensor args + float scalars + mutate outputs)
+ *   - Symbol: load (file/JSON), save, introspection, infer-shape
+ *   - Executor: bind/forward/backward/outputs/free/print
+ *
+ * All entry points return 0 on success, -1 on failure (then
+ * MXGetLastError() describes it).  Returned pointers (strings, shape
+ * arrays, handle arrays) live in thread-local storage and stay valid
+ * until the SAME thread's next MX* call — the reference's
+ * MXAPIThreadLocalEntry lifetime contract.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXTPU_API __attribute__((visibility("default")))
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+/* ---- error handling --------------------------------------------------- */
+MXTPU_API const char *MXGetLastError(void);
+
+/* ---- global state ----------------------------------------------------- */
+MXTPU_API int MXRandomSeed(int seed);
+MXTPU_API int MXNotifyShutdown(void);
+
+/* ---- NDArray ---------------------------------------------------------- */
+/* dev_type: 1=cpu 2=gpu(alias of tpu here) 3=tpu; dtype: 0=f32 1=f64
+ * 2=f16 3=u8 4=i32 (the reference's mshadow type codes) */
+MXTPU_API int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXTPU_API int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXTPU_API int MXNDArrayFree(NDArrayHandle handle);
+/* size is in ELEMENTS of the array dtype (reference contract) */
+MXTPU_API int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const void *data, size_t size);
+MXTPU_API int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXTPU_API int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXTPU_API int MXNDArrayWaitAll(void);
+MXTPU_API int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXTPU_API int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+/* keys may be NULL for a positional save (list format) */
+MXTPU_API int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+MXTPU_API int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr,
+                            mx_uint *out_name_size,
+                            const char ***out_names);
+
+/* ---- registered-op invoke --------------------------------------------- */
+MXTPU_API int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+MXTPU_API int MXGetFunction(const char *name, FunctionHandle *out);
+MXTPU_API int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                            const char **description, mx_uint *num_args,
+                            const char ***arg_names,
+                            const char ***arg_type_infos,
+                            const char ***arg_descriptions);
+MXTPU_API int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                             mx_uint *num_scalars,
+                             mx_uint *num_mutate_vars, int *type_mask);
+MXTPU_API int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                           mx_float *scalar_args,
+                           NDArrayHandle *mutate_vars);
+
+/* ---- Symbol ----------------------------------------------------------- */
+MXTPU_API int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+MXTPU_API int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXTPU_API int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+MXTPU_API int MXSymbolSaveToJSON(SymbolHandle symbol,
+                                 const char **out_json);
+MXTPU_API int MXSymbolFree(SymbolHandle symbol);
+MXTPU_API int MXSymbolGetName(SymbolHandle symbol, const char **out,
+                              int *success);
+MXTPU_API int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                    const char ***out_str_array);
+MXTPU_API int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out_str_array);
+MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
+                                          mx_uint *out_size,
+                                          const char ***out_str_array);
+/* CSR-packed known-arg shapes, the reference's InferShape marshaling:
+ * arg_ind_ptr has num_args+1 entries; arg_shape_data[arg_ind_ptr[i]:
+ * arg_ind_ptr[i+1]] is keys[i]'s shape. */
+MXTPU_API int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                                 const char **keys,
+                                 const mx_uint *arg_ind_ptr,
+                                 const mx_uint *arg_shape_data,
+                                 mx_uint *in_shape_size,
+                                 const mx_uint **in_shape_ndim,
+                                 const mx_uint ***in_shape_data,
+                                 mx_uint *out_shape_size,
+                                 const mx_uint **out_shape_ndim,
+                                 const mx_uint ***out_shape_data,
+                                 mx_uint *aux_shape_size,
+                                 const mx_uint **aux_shape_ndim,
+                                 const mx_uint ***aux_shape_data,
+                                 int *complete);
+MXTPU_API int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                                        const char **keys,
+                                        const mx_uint *arg_ind_ptr,
+                                        const mx_uint *arg_shape_data,
+                                        mx_uint *in_shape_size,
+                                        const mx_uint **in_shape_ndim,
+                                        const mx_uint ***in_shape_data,
+                                        mx_uint *out_shape_size,
+                                        const mx_uint **out_shape_ndim,
+                                        const mx_uint ***out_shape_data,
+                                        mx_uint *aux_shape_size,
+                                        const mx_uint **aux_shape_ndim,
+                                        const mx_uint ***aux_shape_data,
+                                        int *complete);
+
+/* ---- Executor --------------------------------------------------------- */
+/* grad_req codes: 0=null 1=write 3=add (reference kNullOp/kWriteTo/
+ * kAddTo).  arg_grad_store entries may be NULL (=> grad_req null). */
+MXTPU_API int MXExecutorBind(SymbolHandle symbol_handle, int dev_type,
+                             int dev_id, mx_uint len,
+                             NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states,
+                             ExecutorHandle *out);
+MXTPU_API int MXExecutorForward(ExecutorHandle handle, int is_train);
+/* head grads; len may be 0 with NULL for loss-head symbols */
+MXTPU_API int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXTPU_API int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXTPU_API int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+MXTPU_API int MXExecutorFree(ExecutorHandle handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

@@ -1,0 +1,308 @@
+"""General C ABI tests (native/c_api.cc — the serving-adjacent subset of
+the reference `src/c_api/c_api.cc`, ADR-9).
+
+Driven in-process via ctypes: the shim detects the already-running
+interpreter (same deployment trick as test_c_predict.py's artifact test).
+Each surface is checked against the in-process Python result.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+NATIVE = os.path.join(ROOT, "native")
+SHIM = os.path.join(NATIVE, "libmxtpu_capi.so")
+
+mx_uint = ctypes.c_uint
+Handle = ctypes.c_void_p
+
+
+def _lib():
+    if not os.path.exists(SHIM):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++")
+        import fcntl
+
+        with open(os.path.join(NATIVE, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(SHIM):
+                rc = subprocess.run(
+                    ["make", "-C", NATIVE, "libmxtpu_capi.so"],
+                    capture_output=True)
+                if rc.returncode != 0 or not os.path.exists(SHIM):
+                    pytest.skip("c_api shim not buildable here")
+    try:
+        lib = ctypes.CDLL(SHIM)
+    except OSError as e:
+        pytest.skip("c_api shim not loadable here: %s" % e)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _err(lib):
+    return (lib.MXGetLastError() or b"").decode()
+
+
+def _create_nd(lib, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    shape = (mx_uint * arr.ndim)(*arr.shape)
+    h = Handle()
+    assert lib.MXNDArrayCreate(shape, arr.ndim, 1, 0, 0,
+                               ctypes.byref(h)) == 0, _err(lib)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, arr.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(arr.size)) == 0, _err(lib)
+    return h
+
+
+def _read_nd(lib, h):
+    ndim = mx_uint()
+    pdata = ctypes.POINTER(mx_uint)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0, _err(lib)
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.zeros(shape, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(out.size)) == 0, _err(lib)
+    return out
+
+
+def test_ndarray_roundtrip_and_dtype():
+    lib = _lib()
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _create_nd(lib, x)
+    np.testing.assert_array_equal(_read_nd(lib, h), x)
+    dt = ctypes.c_int(-1)
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0  # kFloat32
+    assert lib.MXNDArrayWaitToRead(h) == 0
+    assert lib.MXNDArrayWaitAll() == 0
+    assert lib.MXNDArrayFree(h) == 0
+
+
+def test_ndarray_save_load(tmp_path):
+    lib = _lib()
+    fname = str(tmp_path / "weights.params").encode()
+    a = _create_nd(lib, np.full((2, 2), 3.0, np.float32))
+    b = _create_nd(lib, np.full((3,), 7.0, np.float32))
+    keys = (ctypes.c_char_p * 2)(b"arg:w", b"arg:b")
+    handles = (Handle * 2)(a, b)
+    assert lib.MXNDArraySave(fname, 2, handles, keys) == 0, _err(lib)
+
+    n = mx_uint()
+    arrs = ctypes.POINTER(Handle)()
+    nn = mx_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(n), ctypes.byref(arrs),
+                             ctypes.byref(nn),
+                             ctypes.byref(names)) == 0, _err(lib)
+    assert n.value == 2 and nn.value == 2
+    got = {names[i].decode(): _read_nd(lib, Handle(arrs[i]))
+           for i in range(2)}
+    np.testing.assert_array_equal(got["arg:w"], np.full((2, 2), 3.0))
+    np.testing.assert_array_equal(got["arg:b"], np.full((3,), 7.0))
+    for i in range(2):
+        lib.MXNDArrayFree(Handle(arrs[i]))
+    # python loader reads the same file (shared byte format)
+    back = mx.nd.load(fname.decode())
+    assert set(back) == {"arg:w", "arg:b"}
+
+
+def test_function_registry_invoke():
+    lib = _lib()
+    n = mx_uint()
+    funcs = ctypes.POINTER(Handle)()
+    assert lib.MXListFunctions(ctypes.byref(n), ctypes.byref(funcs)) == 0
+    assert n.value > 50
+
+    h = Handle()
+    assert lib.MXGetFunction(b"exp", ctypes.byref(h)) == 0
+    assert h.value is not None
+    nu, ns, nm = mx_uint(), mx_uint(), mx_uint()
+    mask = ctypes.c_int()
+    assert lib.MXFuncDescribe(h, ctypes.byref(nu), ctypes.byref(ns),
+                              ctypes.byref(nm), ctypes.byref(mask)) == 0
+    assert (nu.value, ns.value, nm.value) == (1, 0, 1)
+
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    assert lib.MXFuncGetInfo(h, ctypes.byref(name), ctypes.byref(desc),
+                             None, None, None, None) == 0, _err(lib)
+    assert name.value == b"exp"
+
+    x = np.array([[0.0, 1.0]], np.float32)
+    src = _create_nd(lib, x)
+    dst = _create_nd(lib, np.zeros_like(x))
+    use = (Handle * 1)(src)
+    mut = (Handle * 1)(dst)
+    assert lib.MXFuncInvoke(h, use, None, mut) == 0, _err(lib)
+    np.testing.assert_allclose(_read_nd(lib, dst), np.exp(x), rtol=1e-6)
+
+    # unknown function: NULL handle, invoke on it errors with a message
+    h2 = Handle()
+    assert lib.MXGetFunction(b"not_an_op", ctypes.byref(h2)) == 0
+    assert not h2.value
+    assert lib.MXFuncInvoke(h2, use, None, mut) == -1
+    assert "invalid function handle" in _err(lib)
+
+
+def _mlp_json():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+    return net, net.tojson()
+
+
+def test_symbol_load_introspect_infer(tmp_path):
+    lib = _lib()
+    net, js = _mlp_json()
+    sym = Handle()
+    assert lib.MXSymbolCreateFromJSON(js.encode(),
+                                      ctypes.byref(sym)) == 0, _err(lib)
+
+    n = mx_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(sym, ctypes.byref(n),
+                                     ctypes.byref(arr)) == 0
+    args = [arr[i].decode() for i in range(n.value)]
+    assert args == net.list_arguments()
+
+    assert lib.MXSymbolListOutputs(sym, ctypes.byref(n),
+                                   ctypes.byref(arr)) == 0
+    assert [arr[i].decode() for i in range(n.value)] == net.list_outputs()
+
+    # round-trip through file
+    f = str(tmp_path / "m-symbol.json")
+    assert lib.MXSymbolSaveToFile(sym, f.encode()) == 0
+    sym2 = Handle()
+    assert lib.MXSymbolCreateFromFile(f.encode(),
+                                      ctypes.byref(sym2)) == 0, _err(lib)
+    out_json = ctypes.c_char_p()
+    assert lib.MXSymbolSaveToJSON(sym2, ctypes.byref(out_json)) == 0
+    assert b"fc2" in out_json.value
+
+    # infer shape: CSR-packed known args (data only)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    ind_ptr = (mx_uint * 2)(0, 2)
+    shape_data = (mx_uint * 2)(5, 6)
+    isz, osz, asz = mx_uint(), mx_uint(), mx_uint()
+    indim = ctypes.POINTER(mx_uint)()
+    odim = ctypes.POINTER(mx_uint)()
+    adim = ctypes.POINTER(mx_uint)()
+    idata = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    odata = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    adata = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    complete = ctypes.c_int(-1)
+    assert lib.MXSymbolInferShape(
+        sym, 1, keys, ind_ptr, shape_data,
+        ctypes.byref(isz), ctypes.byref(indim), ctypes.byref(idata),
+        ctypes.byref(osz), ctypes.byref(odim), ctypes.byref(odata),
+        ctypes.byref(asz), ctypes.byref(adim), ctypes.byref(adata),
+        ctypes.byref(complete)) == 0, _err(lib)
+    assert complete.value == 1
+    ref_arg, ref_out, _ = net.infer_shape(data=(5, 6))
+    got_args = [tuple(idata[i][j] for j in range(indim[i]))
+                for i in range(isz.value)]
+    assert got_args == [tuple(s) for s in ref_arg]
+    got_outs = [tuple(odata[i][j] for j in range(odim[i]))
+                for i in range(osz.value)]
+    assert got_outs == [tuple(s) for s in ref_out]
+    lib.MXSymbolFree(sym)
+    lib.MXSymbolFree(sym2)
+
+
+def test_executor_bind_forward_backward():
+    lib = _lib()
+    net, js = _mlp_json()
+    sym = Handle()
+    assert lib.MXSymbolCreateFromJSON(js.encode(), ctypes.byref(sym)) == 0
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 6).astype(np.float32)
+    arg_shapes, _, _ = net.infer_shape(data=(3, 6))
+    names = net.list_arguments()
+    np_args = {n: (x if n == "data"
+                   else rng.randn(*s).astype(np.float32) * 0.3)
+               for n, s in zip(names, arg_shapes)}
+
+    arg_handles = (Handle * len(names))(
+        *[_create_nd(lib, np_args[n]) for n in names])
+    grad_handles = (Handle * len(names))(
+        *[_create_nd(lib, np.zeros(s, np.float32)) for s in arg_shapes])
+    reqs = (mx_uint * len(names))(*[1] * len(names))  # kWriteTo
+
+    exe = Handle()
+    assert lib.MXExecutorBind(sym, 1, 0, len(names), arg_handles,
+                              grad_handles, reqs, 0, None,
+                              ctypes.byref(exe)) == 0, _err(lib)
+    assert lib.MXExecutorForward(exe, 1) == 0, _err(lib)
+
+    osz = mx_uint()
+    outs = ctypes.POINTER(Handle)()
+    assert lib.MXExecutorOutputs(exe, ctypes.byref(osz),
+                                 ctypes.byref(outs)) == 0, _err(lib)
+    assert osz.value == 1
+    got = _read_nd(lib, Handle(outs[0]))
+
+    # python reference executor on the same values
+    ref_exe = net.bind(mx.cpu(),
+                       {n: mx.nd.array(np_args[n]) for n in names},
+                       {n: mx.nd.zeros(s)
+                        for n, s in zip(names, arg_shapes)})
+    ref_out = ref_exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(got, ref_out, rtol=1e-5, atol=1e-6)
+
+    head = _create_nd(lib, np.ones_like(ref_out))
+    hg = (Handle * 1)(head)
+    assert lib.MXExecutorBackward(exe, 1, hg) == 0, _err(lib)
+    ref_exe.backward([mx.nd.array(np.ones_like(ref_out))])
+    # grads written into the caller's handles
+    fc1_w = names.index("fc1_weight")
+    np.testing.assert_allclose(
+        _read_nd(lib, Handle(grad_handles[fc1_w])),
+        ref_exe.grad_arrays[fc1_w].asnumpy(), rtol=1e-5, atol=1e-6)
+
+    s = ctypes.c_char_p()
+    assert lib.MXExecutorPrint(exe, ctypes.byref(s)) == 0
+    assert b"fc1" in s.value
+    lib.MXExecutorFree(exe)
+    lib.MXSymbolFree(sym)
+
+
+def test_error_paths():
+    lib = _lib()
+    sym = Handle()
+    assert lib.MXSymbolCreateFromJSON(b"{not json",
+                                      ctypes.byref(sym)) == -1
+    assert _err(lib)
+    assert lib.MXSymbolCreateFromFile(b"/nonexistent.json",
+                                      ctypes.byref(sym)) == -1
+    assert lib.MXRandomSeed(7) == 0
+    assert lib.MXNotifyShutdown() == 0
+
+
+def test_bf16_array_marshals_as_float32():
+    """bfloat16 has no reference dtype code: the C view must be coherent —
+    dtype code 0 (f32), 4-byte itemsize, f32 payload both directions."""
+    from mxnet_tpu import c_api_impl as impl
+    from mxnet_tpu.base import bfloat16
+
+    lib = _lib()
+    nd = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)).astype(
+        bfloat16)
+    assert impl.nd_dtype(nd) == 0
+    assert impl.nd_itemsize(nd) == 4
+    buf = impl.nd_to_bytes(nd)
+    assert len(buf) == nd.size * 4
+    back = np.frombuffer(buf, np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(back, np.arange(6).reshape(2, 3))
+    impl.nd_copy_from(nd, np.full((2, 3), 2.5, np.float32).tobytes())
+    assert float(nd.asnumpy().astype(np.float32)[0, 0]) == 2.5
