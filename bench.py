@@ -70,6 +70,23 @@ def main():
     from mxnet_tpu import models
     from mxnet_tpu.parallel import SPMDTrainer, make_mesh
 
+    # On-chip Pallas kernel parity gate (VERDICT r3 #3): CI's CPU mesh
+    # only ever runs the jnp fallbacks, so kernel correctness is proven
+    # HERE, on the chip, before anything is timed.  Result lands in the
+    # JSON; divergence fails the whole bench run (exit 1) after printing.
+    pallas_parity = {"status": "skip: preflight errored"}
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        import pallas_preflight
+
+        pallas_parity = pallas_preflight.run(verbose=False)
+    except Exception as e:  # pragma: no cover
+        # the gate must not be silently disarmable: an import/driver error
+        # here fails the bench just like kernel divergence would
+        pallas_parity = {"status": "FAIL: preflight driver errored: %s"
+                         % str(e)[:160]}
+
     # batch 128 is the single-chip sweet spot on v5e (smaller working set
     # prefetches better; 256 = 28.5% MFU, 128 = 30.3%)
     batch = int(os.environ.get("BENCH_BATCH", "128"))
@@ -178,9 +195,14 @@ def main():
                     extra["transformer_error"] = str(e2)[:200]
             else:
                 extra["transformer_error"] = str(e)[:200]
+    extra["pallas_parity"] = pallas_parity
     if extra:
         result["extra"] = extra
     print(json.dumps(result))
+    if str(pallas_parity.get("status", "")).startswith("FAIL"):
+        print("pallas parity preflight FAILED: %s" % pallas_parity,
+              file=sys.stderr)
+        sys.exit(1)
 
 
 def _transformer_metrics():

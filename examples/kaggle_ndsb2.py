@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Kaggle NDSB-2 (cardiac MRI volume estimation) pipeline
+(reference `example/kaggle-ndsb2/`: Preprocessing.py dumps 30-frame SAX
+sequences to CSV, Train.py trains a frame-difference LeNet per target and
+writes the CDF submission).
+
+End-to-end competition workflow in one script, on synthetic cardiac-like
+data (no dataset egress): generate pulsing-ventricle frame sequences whose
+pulse amplitude encodes the volume label, CDF-encode systole/diastole
+labels (`encode_label`, Train.py), train the reference's frame-diff net —
+(x-128)/128 -> SliceChannel(30) -> 29 frame diffs -> Concat -> conv/BN/
+pool x2 -> Dropout -> FC -> LogisticRegressionOutput — with the CRPS
+metric via `mx.metric.np`, predict the validation set, accumulate
+per-case (`accumulate_result`), and write the monotonified CDF submission
+(`submission_helper`).
+
+The reference uses 600 CDF bins at 64x64; bins/size/epochs are arguments
+so the same pipeline runs as a smoke test.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_lenet(frames, bins):
+    """Frame-difference LeNet (`Train.py` get_lenet): consecutive-frame
+    deltas isolate wall motion; the head is a per-bin logistic CDF."""
+    source = mx.sym.Variable("data")
+    source = (source - 128) * (1.0 / 128)
+    split = mx.sym.SliceChannel(source, num_outputs=frames)
+    diffs = [split[i + 1] - split[i] for i in range(frames - 1)]
+    source = mx.sym.Concat(*diffs)
+    net = mx.sym.Convolution(source, kernel=(5, 5), num_filter=40)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=40)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flatten = mx.sym.Flatten(net)
+    flatten = mx.sym.Dropout(flatten)
+    fc1 = mx.sym.FullyConnected(data=flatten, num_hidden=bins)
+    return mx.sym.LogisticRegressionOutput(data=fc1, name="softmax")
+
+
+def CRPS(label, pred):
+    """Continuous Ranked Probability Score on monotonified CDFs
+    (`Train.py` CRPS)."""
+    pred = pred.copy()
+    for j in range(pred.shape[1] - 1):
+        pred[:, j + 1] = np.maximum(pred[:, j + 1], pred[:, j])
+    return np.sum(np.square(label - pred)) / label.size
+
+
+def encode_label(volumes, bins):
+    """volume -> CDF target: P(V < bin edge) as a 0/1 step
+    (`Train.py` encode_label)."""
+    return np.array([(x < np.arange(bins)) for x in volumes],
+                    dtype=np.uint8)
+
+
+def make_sequences(num_cases, frames, size, bins, seed):
+    """Synthetic SAX stand-in: a disk whose radius pulses once per cycle;
+    end-diastolic radius (hence pulse amplitude) encodes the volume."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:size, 0:size]
+    data = np.zeros((num_cases, frames, size, size), np.float32)
+    systole = rng.uniform(0.1, 0.9, num_cases)
+    diastole = np.clip(systole + rng.uniform(0.05, 0.1, num_cases), 0, 1)
+    for i in range(num_cases):
+        cy = size / 2 + rng.uniform(-2, 2)
+        cx = size / 2 + rng.uniform(-2, 2)
+        r_sys = (0.10 + 0.25 * systole[i]) * size
+        r_dia = (0.10 + 0.25 * diastole[i]) * size
+        for t in range(frames):
+            # contraction phase: radius swings diastole -> systole
+            phase = 0.5 - 0.5 * np.cos(2 * np.pi * t / frames)
+            r = r_dia + (r_sys - r_dia) * phase
+            disk = ((yy - cy) ** 2 + (xx - cx) ** 2) < r * r
+            img = 40.0 + 180.0 * disk + rng.normal(0, 4, (size, size))
+            data[i, t] = np.clip(img, 0, 255)
+    # labels in "ml", spread over the CDF bin range like the real targets
+    sys_ml = systole * (bins - 1)
+    dia_ml = diastole * (bins - 1)
+    return data, sys_ml, dia_ml
+
+
+def accumulate_result(case_ids, prob):
+    """Average per-case over slices (`Train.py` accumulate_result)."""
+    sum_result, cnt_result = {}, {}
+    for idx, row in zip(case_ids, prob):
+        if idx not in cnt_result:
+            cnt_result[idx] = 0.0
+            sum_result[idx] = np.zeros_like(row, np.float64)
+        cnt_result[idx] += 1
+        sum_result[idx] += row
+    return {k: sum_result[k] / cnt_result[k] for k in cnt_result}
+
+
+def submission_helper(pred):
+    """Monotonify a predicted CDF (`Train.py` submission_helper)."""
+    p = np.array(pred, np.float64)
+    for j in range(1, p.size):
+        p[j] = max(p[j], p[j - 1])
+    return p
+
+
+def train_target(name, data_csv, label_csv, frames, size, bins, args):
+    logging.info("NDSB2: training %s net", name)
+    data_train = mx.io.CSVIter(data_csv=data_csv,
+                               data_shape=(frames, size, size),
+                               label_csv=label_csv, label_shape=(bins,),
+                               batch_size=args.batch_size,
+                               label_name="softmax_label")
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=get_lenet(frames, bins),
+        num_epoch=args.num_epoch, learning_rate=args.lr, wd=0.00001,
+        momentum=0.9, initializer=mx.init.Xavier(factor_type="in"))
+    model.fit(X=data_train, eval_metric=mx.metric.np(CRPS))
+    return model
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-cases", type=int, default=96)
+    ap.add_argument("--frames", type=int, default=30)
+    ap.add_argument("--size", type=int, default=32)
+    ap.add_argument("--bins", type=int, default=60)
+    ap.add_argument("--num-epoch", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--out-dir", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="ndsb2_")
+    frames, size, bins = args.frames, args.size, args.bins
+
+    # -- Preprocessing.py: dump sequences + encoded labels to CSV --------
+    data, sys_ml, dia_ml = make_sequences(args.num_cases, frames, size,
+                                          bins, seed=0)
+    n_train = int(args.num_cases * 0.75)
+    paths = {k: os.path.join(out_dir, k + ".csv") for k in
+             ("train-data", "train-systole", "train-diastole",
+              "validate-data")}
+    np.savetxt(paths["train-data"],
+               data[:n_train].reshape(n_train, -1), delimiter=",", fmt="%g")
+    np.savetxt(paths["validate-data"],
+               data[n_train:].reshape(args.num_cases - n_train, -1),
+               delimiter=",", fmt="%g")
+    np.savetxt(paths["train-systole"],
+               encode_label(sys_ml[:n_train], bins), delimiter=",",
+               fmt="%g")
+    np.savetxt(paths["train-diastole"],
+               encode_label(dia_ml[:n_train], bins), delimiter=",",
+               fmt="%g")
+
+    # -- Train.py: one net per target ------------------------------------
+    systole_model = train_target("systole", paths["train-data"],
+                                 paths["train-systole"], frames, size,
+                                 bins, args)
+    diastole_model = train_target("diastole", paths["train-data"],
+                                  paths["train-diastole"], frames, size,
+                                  bins, args)
+
+    # -- predict + CRPS gate on held-out cases ---------------------------
+    val_iter = lambda: mx.io.CSVIter(  # noqa: E731
+        data_csv=paths["validate-data"], data_shape=(frames, size, size),
+        batch_size=1)
+    systole_prob = systole_model.predict(val_iter())
+    diastole_prob = diastole_model.predict(val_iter())
+    sys_true = encode_label(sys_ml[n_train:], bins)
+    dia_true = encode_label(dia_ml[n_train:], bins)
+    crps_sys = CRPS(sys_true, systole_prob)
+    crps_dia = CRPS(dia_true, diastole_prob)
+    print("NDSB2 validation CRPS systole %.4f diastole %.4f"
+          % (crps_sys, crps_dia))
+
+    # -- submission (Train.py cells 8-12) --------------------------------
+    case_ids = list(range(n_train, args.num_cases))
+    systole_result = accumulate_result(case_ids, systole_prob)
+    diastole_result = accumulate_result(case_ids, diastole_prob)
+    sub_path = os.path.join(out_dir, "submission.csv")
+    with open(sub_path, "w", newline="") as f:
+        fo = csv.writer(f, lineterminator="\n")
+        fo.writerow(["Id"] + ["P%d" % i for i in range(bins)])
+        for key in case_ids:
+            for target, result in (("Diastole", diastole_result),
+                                   ("Systole", systole_result)):
+                fo.writerow(["%d_%s" % (key, target)]
+                            + list(submission_helper(result[key])))
+    with open(sub_path) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 1 + 2 * len(case_ids)
+    # every CDF row must be monotone in [0, 1]
+    for row in rows[1:]:
+        p = np.array([float(v) for v in row[1:]])
+        assert (np.diff(p) >= -1e-9).all() and (0 <= p).all() \
+            and (p <= 1 + 1e-9).all()
+    print("NDSB2 submission written: %s rows=%d" % (sub_path, len(rows)))
+
+
+if __name__ == "__main__":
+    main()

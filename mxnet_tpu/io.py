@@ -181,7 +181,8 @@ class CSVIter(DataIter):
     fixed row shapes, part_index/num_parts sharding."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, part_index=0, num_parts=1):
+                 batch_size=1, round_batch=True, part_index=0, num_parts=1,
+                 label_name="label"):
         super().__init__()
         data = np.loadtxt(data_csv, delimiter=",", ndmin=2, dtype=np.float32)
         data = data.reshape((-1,) + check_shape(data_shape))
@@ -199,7 +200,7 @@ class CSVIter(DataIter):
         handle = "pad" if round_batch else "discard"
         self._inner = NDArrayIter(
             data, label, batch_size=batch_size, last_batch_handle=handle,
-            label_name="label",
+            label_name=label_name,
         )
         self.batch_size = batch_size
 
@@ -416,6 +417,11 @@ class ImageRecordIter(DataIter):
         from . import _native
         from . import recordio as _recordio
 
+        # remote URIs (s3://... via a registered fetch hook, file://)
+        # resolve to a local file first — the dmlc::InputSplit remote-read
+        # role (`iter_image_recordio.cc:105-126`), see
+        # recordio.register_fetch_hook
+        path_imgrec = _recordio.resolve_uri(path_imgrec)
         self.batch_size = batch_size
         self._data_shape = tuple(int(x) for x in check_shape(data_shape))
         # on-device augmentation (image.py): records may be stored larger

@@ -225,11 +225,15 @@ class CompositeEvalMetric(EvalMetric):
         return names, results
 
 
-def np_metric(name=None, allow_extra_outputs=False):
-    """Decorator creating a CustomMetric (`metric.py` np)."""
+def np_metric(f_or_name=None, name=None, allow_extra_outputs=False):
+    """CustomMetric factory (`metric.py` np): reference usage is direct —
+    ``mx.metric.np(CRPS)`` (`example/kaggle-ndsb2/Train.py`) — and the
+    decorator form ``@mx.metric.np(name=...)`` also works."""
+    if callable(f_or_name):
+        return CustomMetric(f_or_name, name, allow_extra_outputs)
 
     def wrapper(f):
-        return CustomMetric(f, name, allow_extra_outputs)
+        return CustomMetric(f, f_or_name or name, allow_extra_outputs)
 
     return wrapper
 

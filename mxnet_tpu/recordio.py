@@ -105,13 +105,65 @@ def pack_img(header, img, quality=95, img_fmt=".npy"):
     return pack(header, buf.getvalue())
 
 
+# -- pluggable remote reads -------------------------------------------------
+# The reference read s3:// and hdfs:// URIs through dmlc::InputSplit
+# (`/root/reference/src/io/iter_image_recordio.cc:105-126`, dmlc-core
+# filesystem providers).  Here the native loader and the python readers
+# want a LOCAL file, so remote schemes go through a fetch hook that
+# materializes (and may cache) the object locally — multi-host jobs
+# register whatever their storage fabric needs (gcsfuse path rewrite,
+# object-store download, ...).  `file://` is built in; plain paths pass
+# through untouched.
+
+_FETCH_HOOKS = {}
+
+
+def register_fetch_hook(scheme, fetcher):
+    """Register ``fetcher(uri) -> local_path`` for ``scheme://`` URIs.
+    Returns the previous hook (None if none) so callers can restore it."""
+    prev = _FETCH_HOOKS.get(scheme)
+    _FETCH_HOOKS[scheme] = fetcher
+    return prev
+
+
+def resolve_uri(uri):
+    """Map a data URI to a local filesystem path via the scheme hooks."""
+    if "://" not in uri:
+        return uri
+    scheme, rest = uri.split("://", 1)
+    if scheme == "file":
+        if rest.startswith("/"):  # file:///abs/path
+            return rest
+        # file://host/path (RFC 8089): only the local host makes sense
+        host, _, path = rest.partition("/")
+        if host not in ("", "localhost"):
+            raise MXNetError(
+                "file:// URIs with a remote authority (%r) are not "
+                "supported; register a fetch hook for remote reads" % host)
+        return "/" + path
+    hook = _FETCH_HOOKS.get(scheme)
+    if hook is None:
+        raise MXNetError(
+            "no fetch hook registered for %r URIs (register one with "
+            "mxnet_tpu.recordio.register_fetch_hook(%r, fetcher))"
+            % (scheme, scheme))
+    local = hook(uri)
+    if not isinstance(local, str) or not os.path.exists(local):
+        raise MXNetError(
+            "fetch hook for %r returned %r, which is not an existing "
+            "local path" % (scheme, local))
+    return local
+
+
 class MXRecordIO:
-    """Sequential reader/writer (`recordio.py` MXRecordIO)."""
+    """Sequential reader/writer (`recordio.py` MXRecordIO).  Read URIs go
+    through `resolve_uri` (the dmlc::InputSplit remote-read role)."""
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._local_path = None  # fetched-once resolution of a remote uri
         self.open()
 
     def open(self):
@@ -119,7 +171,11 @@ class MXRecordIO:
             self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            # resolve once: reset() must not re-invoke a (possibly
+            # downloading) fetch hook every epoch
+            if self._local_path is None:
+                self._local_path = resolve_uri(self.uri)
+            self.handle = open(self._local_path, "rb")
             self.writable = False
         else:
             raise MXNetError("invalid flag %r" % self.flag)

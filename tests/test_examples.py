@@ -162,3 +162,17 @@ def test_kaggle_ndsb1_example():
     acc = float(line.split()[3].rstrip(";"))
     assert acc >= 0.6, out[-1000:]
     assert "submission header: image,plankton_class_00" in out
+
+
+def test_kaggle_ndsb2_example():
+    """Cardiac-volume pipeline: CSV dump -> frame-diff LeNet per target ->
+    CRPS gate -> monotone CDF submission."""
+    out = run_example("kaggle_ndsb2.py", "--num-cases", "48", "--frames",
+                      "8", "--size", "16", "--bins", "24",
+                      "--num-epoch", "6")
+    line = [l for l in out.splitlines()
+            if l.startswith("NDSB2 validation CRPS")][-1]
+    crps_sys, crps_dia = float(line.split()[4]), float(line.split()[6])
+    # trivial always-0.5 CDF scores 0.25; the net must clearly beat it
+    assert crps_sys < 0.15 and crps_dia < 0.15, line
+    assert "submission written" in out and "rows=25" in out

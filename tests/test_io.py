@@ -318,3 +318,51 @@ def test_native_loader_decode_failure_count(tmp_path):
     d = b.data[0].asnumpy()
     assert float(np.abs(d[10]).sum()) == 0.0
     assert float(np.abs(d[0]).sum()) > 0.0
+
+
+def test_recordio_remote_fetch_hooks(tmp_path):
+    """Remote-read hooks (the dmlc::InputSplit role,
+    `iter_image_recordio.cc:105-126`): file:// built in, custom schemes
+    pluggable, unknown schemes raise with guidance."""
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(9)
+    for i in range(4):
+        img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".jpg",
+            quality=95))
+    rec.close()
+
+    # file:// through both the raw reader and the image iterator
+    r = recordio.MXRecordIO("file://" + path, "r")
+    assert r.read() is not None
+    r.close()
+    it = mx.io.ImageRecordIter(path_imgrec="file://" + path,
+                               data_shape=(3, 8, 8), batch_size=2)
+    assert next(it).data[0].shape == (2, 3, 8, 8)
+
+    # custom scheme: hook materializes the local file (e.g. object-store
+    # download); records each fetch so we can assert it ran
+    fetched = []
+
+    def fake_s3(uri):
+        fetched.append(uri)
+        return path
+
+    prev = recordio.register_fetch_hook("fakes3", fake_s3)
+    try:
+        it2 = mx.io.ImageRecordIter(path_imgrec="fakes3://bucket/imgs.rec",
+                                    data_shape=(3, 8, 8), batch_size=2)
+        assert next(it2).data[0].shape == (2, 3, 8, 8)
+        assert fetched == ["fakes3://bucket/imgs.rec"]
+    finally:
+        recordio._FETCH_HOOKS.pop("fakes3", None)
+        if prev is not None:
+            recordio.register_fetch_hook("fakes3", prev)
+
+    with pytest.raises(mx.base.MXNetError, match="no fetch hook"):
+        mx.io.ImageRecordIter(path_imgrec="s3://bucket/x.rec",
+                              data_shape=(3, 8, 8), batch_size=2)
