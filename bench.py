@@ -161,8 +161,13 @@ def main():
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips_chip, 2),
-        "unit": "images/sec/chip (mfu=%.3f, batch=%d, dtype=%s)"
-                % (mfu, batch, np.dtype(dtype).name),
+        # the pooling geometry is part of the measurement (ADR-5: bench
+        # uses floor-mode 56/28/14/7 stages; the zoo default stays the
+        # reference's ceil mode) — stated here so the headline is not
+        # mistaken for the default-geometry model
+        "unit": "images/sec/chip (mfu=%.3f, batch=%d, dtype=%s, pool=%s)"
+                % (mfu, batch, np.dtype(dtype).name,
+                   os.environ.get("BENCH_POOLCONV", "valid")),
         "vs_baseline": round(ips_chip / 42.5, 2),
     }
     extra = {}

@@ -112,6 +112,15 @@ def _load():
     except AttributeError:
         pass
 
+    try:  # native im2rec packer (absent in older builds)
+        lib.mxtpu_im2rec_pack.restype = ctypes.c_int64
+        lib.mxtpu_im2rec_pack.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64)]
+    except AttributeError:
+        pass
+
     try:  # u8 JPEG fast path (absent in older builds of the .so)
         lib.mxtpu_loader_open_u8.restype = H
         lib.mxtpu_loader_open_u8.argtypes = lib.mxtpu_loader_open.argtypes

@@ -32,22 +32,12 @@
 #include <thread>
 #include <vector>
 
-#include <jpeglib.h>
+#include "jpeg_err.h"
 
 namespace {
 
-/* libjpeg error handling: longjmp out instead of exit() */
-struct JpegErr {
-  jpeg_error_mgr pub;
-  jmp_buf jb;
-  char msg[JMSG_LENGTH_MAX];
-};
-
-void JpegErrExit(j_common_ptr cinfo) {
-  JpegErr* e = reinterpret_cast<JpegErr*>(cinfo->err);
-  (*cinfo->err->format_message)(cinfo, e->msg);
-  longjmp(e->jb, 1);
-}
+using JpegErr = MxtpuJpegErr;
+constexpr auto JpegErrExit = MxtpuJpegErrExit;
 
 bool IsJpeg(const unsigned char* p, uint64_t len) {
   return len >= 3 && p[0] == 0xFF && p[1] == 0xD8 && p[2] == 0xFF;

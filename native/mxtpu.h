@@ -90,6 +90,17 @@ MXTPU_API int mxtpu_loader_last_failed(mxtpu_handle l);
 MXTPU_API void mxtpu_loader_reset(mxtpu_handle l);
 MXTPU_API void mxtpu_loader_close(mxtpu_handle l);
 
+/* -- native im2rec packer (`tools/im2rec.cc`) ---------------------------
+ * Pack `index \t label \t relpath` list entries (JPEG inputs) into
+ * rec_path + .idx: decode, resize shorter side to `resize` (0 = keep),
+ * re-encode at `quality`, parallel across nthreads with deterministic
+ * output order.  Returns records written (-1 on fatal error); entries
+ * that fail to decode are skipped and counted into *out_failed. */
+MXTPU_API int64_t mxtpu_im2rec_pack(const char* list_path, const char* root,
+                                    const char* rec_path, int resize,
+                                    int quality, int nthreads,
+                                    int64_t* out_failed);
+
 /* -- native SGD (server-side updates, `src/optimizer/sgd-inl.h`) -------- */
 MXTPU_API mxtpu_handle mxtpu_sgd_create(float lr, float momentum, float wd,
                                         float rescale, float clip_gradient,

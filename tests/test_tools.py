@@ -106,3 +106,48 @@ def test_gen_op_docs(tmp_path):
     assert proc.returncode == 0, proc.stderr[-1000:]
     text = open(out).read()
     assert "## Convolution" in text and "num_filter" in text
+
+
+def test_im2rec_native_packer(tmp_path):
+    """C++ packer (`native/im2rec.cc`): decode -> shorter-side resize ->
+    re-encode, ordered output, .idx offsets; the pack must read back
+    through MXIndexedRecordIO and ImageRecordIter with matching labels."""
+    from PIL import Image
+
+    from mxnet_tpu import _native, recordio
+
+    if not (_native.available()
+            and hasattr(_native.LIB, "mxtpu_im2rec_pack")):
+        pytest.skip("native im2rec not built")
+    sys.path.insert(0, TOOLS)
+    import im2rec
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rng = np.random.RandomState(11)
+    rows = []
+    for i in range(7):
+        # varying sizes; shorter side resized to 16 must keep aspect
+        h, w = 20 + 2 * i, 28 + i
+        arr = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+        name = "im%d.jpg" % i
+        Image.fromarray(arr).save(str(root / name), quality=95)
+        rows.append("%d\t%f\t%s" % (i, float(10 + i), name))
+    lst = tmp_path / "all.lst"
+    lst.write_text("\n".join(rows) + "\n")
+    out = str(tmp_path / "pack.rec")
+
+    n = im2rec.pack_native(str(lst), str(root), out, resize=16, quality=92,
+                           nthreads=3)
+    assert n == 7
+    assert os.path.exists(str(tmp_path / "pack.idx"))
+
+    # read back: ordered labels, aspect-preserving resize, decodable JPEGs
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "pack.idx"), out, "r")
+    for i in range(7):
+        hdr, img = recordio.unpack_img(rec.read_idx(i))
+        assert hdr.label == float(10 + i)
+        assert min(img.shape[:2]) == 16  # shorter side
+        h, w = 20 + 2 * i, 28 + i
+        assert abs(img.shape[1] / img.shape[0] - w / h) < 0.15
+    rec.close()

@@ -57,6 +57,55 @@ def make_list(root, out):
                                                   out))
 
 
+def pack_native(listfile, root, out, resize=0, quality=95, nthreads=None,
+                shuffle=False):
+    """Pack via the C++ packer (`native/im2rec.cc`, the reference's
+    `tools/im2rec.cc` role): parallel JPEG decode -> resize -> re-encode.
+    JPEG inputs only; returns records written."""
+    import ctypes
+    import tempfile
+
+    from mxnet_tpu import _native
+
+    if not (_native.available()
+            and hasattr(_native.LIB, "mxtpu_im2rec_pack")):
+        raise SystemExit("--native needs native/libmxtpu.so (make -C native)")
+    # the C packer is libjpeg-only; refuse mixed lists up front instead of
+    # silently skipping entries (data loss) at pack time
+    rows = [l for l in open(listfile).read().splitlines() if l.strip()]
+    non_jpeg = [l.split("\t")[-1] for l in rows
+                if not l.split("\t")[-1].lower().endswith(
+                    (".jpg", ".jpeg"))]
+    if non_jpeg:
+        raise SystemExit(
+            "--native packs JPEG inputs only; %d non-JPEG entries (first: "
+            "%s) — use the Python packer" % (len(non_jpeg), non_jpeg[0]))
+    tmp_name = None
+    try:
+        if shuffle:
+            random.shuffle(rows)
+            tmp = tempfile.NamedTemporaryFile("w", suffix=".lst",
+                                              delete=False)
+            tmp.write("\n".join(rows) + "\n")
+            tmp.close()
+            tmp_name = listfile = tmp.name
+        failed = ctypes.c_int64(0)
+        n = _native.LIB.mxtpu_im2rec_pack(
+            listfile.encode(), root.encode(), out.encode(), int(resize),
+            int(quality), int(nthreads or os.cpu_count() or 1),
+            ctypes.byref(failed))
+    finally:
+        if tmp_name:
+            os.unlink(tmp_name)
+    if n < 0:
+        raise SystemExit("native pack failed: %s" % _native.last_error())
+    if failed.value:
+        print("WARNING: %d entries failed to decode and were skipped (%s)"
+              % (failed.value, _native.last_error()))
+    print("wrote %d records -> %s (native)" % (n, out))
+    return n
+
+
 def pack(listfile, root, out, shuffle=False):
     rows = []
     with open(listfile) as f:
@@ -85,6 +134,13 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--make-list", action="store_true")
     ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--native", action="store_true",
+                    help="use the C++ packer (JPEG inputs; parallel "
+                         "decode/resize/re-encode like tools/im2rec.cc)")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="scale shorter side to N px (native only)")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--num-thread", type=int, default=None)
     ap.add_argument("args", nargs="+")
     a = ap.parse_args()
     if a.make_list:
@@ -92,7 +148,12 @@ def main():
     else:
         if len(a.args) != 3:
             ap.error("need LISTFILE IMAGE_ROOT OUTPUT.rec")
-        pack(a.args[0], a.args[1], a.args[2], shuffle=a.shuffle)
+        if a.native:
+            pack_native(a.args[0], a.args[1], a.args[2], resize=a.resize,
+                        quality=a.quality, nthreads=a.num_thread,
+                        shuffle=a.shuffle)
+        else:
+            pack(a.args[0], a.args[1], a.args[2], shuffle=a.shuffle)
 
 
 if __name__ == "__main__":
