@@ -243,8 +243,11 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k_off = ko_ref[0]
     q = q_ref[0, 0].astype(jnp.float32)                   # (bq, D)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                   # (bq,)
-    delta = delta_ref[0, 0]
+    # lse/delta ride a trailing singleton axis: Mosaic requires the last
+    # two block dims be (8k, 128k) or equal to the array dims, which
+    # (block_q, 1) satisfies with no broadcast waste
+    lse = lse_ref[0, 0, :, 0]                             # (bq,)
+    delta = delta_ref[0, 0, :, 0]
     bq, d = q.shape
 
     num_kb = pl.cdiv(kv_len, block_k)
@@ -308,8 +311,8 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         q_rel = qi * block_q + jax.lax.broadcasted_iota(
@@ -357,6 +360,10 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
              - glse.astype(jnp.float32))
     lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
     deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q else delta
+    # trailing singleton axis so the (block, 1) tiles pass Mosaic's
+    # last-two-dims rule without a broadcast lane dim (see kernel note)
+    lsep = lsep[..., None]
+    deltap = deltap[..., None]
 
     qo = jnp.asarray([q_off], jnp.int32)
     ko = jnp.asarray([k_off], jnp.int32)
@@ -377,10 +384,10 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
                              lambda i, j, k_, qo, ko: (i, j, 0, 0)),
                 pl.BlockSpec((1, 1, block_q, d),
                              lambda i, j, k_, qo, ko: (i, j, k_, 0)),
-                pl.BlockSpec((1, 1, block_q),
-                             lambda i, j, k_, qo, ko: (i, j, k_)),
-                pl.BlockSpec((1, 1, block_q),
-                             lambda i, j, k_, qo, ko: (i, j, k_)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
             ],
             out_specs=pl.BlockSpec((1, 1, block_q, d),
                                    lambda i, j, k_, qo, ko: (i, j, k_, 0)),
@@ -410,10 +417,10 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
                              lambda i, j, k_, qo, ko: (i, j, k_, 0)),
                 pl.BlockSpec((1, 1, sq_p, d),
                              lambda i, j, k_, qo, ko: (i, j, 0, 0)),
-                pl.BlockSpec((1, 1, sq_p),
-                             lambda i, j, k_, qo, ko: (i, j, 0)),
-                pl.BlockSpec((1, 1, sq_p),
-                             lambda i, j, k_, qo, ko: (i, j, 0)),
+                pl.BlockSpec((1, 1, sq_p, 1),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, sq_p, 1),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, 1, block_k, d),

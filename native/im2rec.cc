@@ -13,6 +13,7 @@
  */
 #include "jpeg_err.h"
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -210,9 +211,16 @@ MXTPU_API int64_t mxtpu_im2rec_pack(const char* list_path, const char* root,
   mxtpu_handle wh = mxtpu_recio_writer_open(rec_path);
   if (!wh) return -1;
   std::string idx_path(rec_path);
+  // strip the extension only from the final path component: a dot in a
+  // directory name must not truncate the path ("/data/v1.2/train" ->
+  // "/data/v1.2/train.idx", not "/data/v1.idx")
+  size_t slash = idx_path.find_last_of('/');
   size_t dot = idx_path.rfind('.');
-  idx_path = (dot == std::string::npos ? idx_path : idx_path.substr(0, dot))
-             + ".idx";
+  if (dot != std::string::npos &&
+      (slash == std::string::npos || dot > slash)) {
+    idx_path = idx_path.substr(0, dot);
+  }
+  idx_path += ".idx";
   std::ofstream idx(idx_path);
 
   if (nthreads < 1) nthreads = 1;

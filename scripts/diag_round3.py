@@ -39,7 +39,13 @@ def stage_attnbwd():
     import jax
     import jax.numpy as jnp
 
-    from mxnet_tpu.ops.pallas_kernels import flash_attention as fa
+    # the package __init__ re-exports the flash_attention *function* under
+    # the same name as the submodule, shadowing attribute-lookup imports;
+    # go through sys.modules via importlib
+    import importlib
+
+    fa = importlib.import_module(
+        "mxnet_tpu.ops.pallas_kernels.flash_attention")
 
     rng = np.random.RandomState(0)
     for causal, sq, skv in ((True, 1024, 1024), (False, 512, 384)):

@@ -104,6 +104,31 @@ def main():
     from mxnet_tpu import _native
 
     if _native.has_u8_loader():
+        # raw C++ loader throughput, no JAX staging: the framework-owned
+        # decode rate (the iterator numbers below add device staging and,
+        # on a CPU backend, fight the decoder for the same cores)
+        import ctypes
+
+        lib = _native.LIB
+        hnd = lib.mxtpu_loader_open_u8(
+            jpg.encode(), 0, 1, batch, 3 * 256 * 256,
+            os.cpu_count() or 1, 4)
+        if hnd:
+            dbuf = np.empty((batch, 256, 256, 3), np.uint8)
+            lbuf = np.empty((batch,), np.float32)
+            t0 = time.time()
+            got = 0
+            while True:
+                m = lib.mxtpu_loader_next_u8(
+                    hnd,
+                    dbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    lbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                if m <= 0:
+                    break
+                got += m
+            out["jpeg_native_raw_decode"] = round(got / (time.time() - t0), 1)
+            lib.mxtpu_loader_close(hnd)
+
         it = mx.io.ImageRecordIter(
             path_imgrec=jpg, data_shape=(3, 256, 256), batch_size=batch,
             use_native=True, preprocess_threads=os.cpu_count() or 1)

@@ -117,7 +117,8 @@ def pack(listfile, root, out, shuffle=False):
     if shuffle:
         random.shuffle(rows)
     w = recordio.MXRecordIO(out, "w")
-    idx_w = open(out.rsplit(".", 1)[0] + ".idx", "w")
+    stem, ext = os.path.splitext(out)  # dot in a dir name must not truncate
+    idx_w = open((stem if ext else out) + ".idx", "w")
     for n, (i, label, rel) in enumerate(rows):
         img = load_image(os.path.join(root, rel))
         rec = recordio.pack_img((0, label, i, 0), img)
