@@ -9,7 +9,13 @@ attention above all (the S x S score matrix must never touch HBM).
 Every kernel has a pure-jnp blockwise fallback with identical math, used on
 non-TPU backends (the 8-device CPU test mesh) and as the reference in tests.
 """
+# module aliases first: the function re-exports below shadow the
+# submodule names on the package, so kernel-internal consumers (tests,
+# preflight, diagnostics) import these instead of importlib workarounds
+from . import flash_attention as flash_attention_mod
+from . import fused_ce as fused_ce_mod
 from .flash_attention import flash_attention
 from .fused_ce import fused_softmax_ce
 
-__all__ = ["flash_attention", "fused_softmax_ce"]
+__all__ = ["flash_attention", "fused_softmax_ce",
+           "flash_attention_mod", "fused_ce_mod"]

@@ -38,7 +38,7 @@ _NEG_INF = -1e30
 
 
 def _use_pallas(q, kv_len=None):
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and not _INTERPRET:
         return False
     # Pallas path wants the blocked dims tile-aligned; the wrapper pads S,
     # but tiny head_dim is better served by XLA.
@@ -59,6 +59,13 @@ try:  # pallas is TPU-only in some builds; import lazily and gate on backend
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
+
+# MXNET_PALLAS_INTERPRET=1 runs every pallas_call through the interpreter
+# so the CPU test mesh can execute the real kernel bodies (not just the
+# jnp fallbacks) — the CI answer to "a kernel regression ships green"
+import os as _os
+
+_INTERPRET = _os.environ.get("MXNET_PALLAS_INTERPRET", "0") == "1"
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +176,7 @@ def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
             bytes_accessed=(qp.size + kp.size + vp.size) * qp.dtype.itemsize,
             transcendentals=b * h * sq_p * skv_p,
         ),
+        interpret=_INTERPRET,
     )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
       qp, kp, vp)
     lse = lse[..., 0]  # drop the broadcast lane dim
@@ -401,6 +409,7 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
             * qp.dtype.itemsize,
             transcendentals=b * h * sq_p * skv_p,
         ),
+        interpret=_INTERPRET,
     )(qo, ko, qp, kp, vp, dop, lsep, deltap)
 
     dk, dv = pl.pallas_call(
@@ -441,6 +450,7 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
             * qp.dtype.itemsize,
             transcendentals=b * h * sq_p * skv_p,
         ),
+        interpret=_INTERPRET,
     )(qo, ko, qp, kp, vp, dop, lsep, deltap)
 
     if pad_q:
@@ -510,37 +520,434 @@ def _flash_bwd(scale, causal, block_k, res, grads):
 
 
 # ---------------------------------------------------------------------------
+# dS-layout kernels: operands shaped (b, h, D, S) so the minor dim is the
+# sequence (a multiple of 128) and the second-minor is head_dim (a multiple
+# of 8).  The original (b, h, S, D) kernels force dense {3,2,1,0} layouts
+# whose 64-wide minor dim pads every bf16 tile 2x on TPU (T(8,128) tiling):
+# at GPT-2-small shape that doubled every saved attention residual and
+# every layout copy around the custom calls (96 MB temps for 48 MB
+# tensors, measured OOM at batch 32).  In dS form the same buffers tile
+# exactly; the boundary transposes fold into the model's own head
+# split/merge transposes.  Math is the same online-softmax recurrence;
+# scores stay (bq, bk) — only the operand orientation changes.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_ds(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_sc, l_sc, acc_sc, *,
+                   scale, causal, block_q, block_k, kv_len):
+    # Grid (b, h, nq, nk); the K axis is the innermost sequential grid
+    # dim, so Mosaic pipelines the (D, block_k) K/V block DMAs while the
+    # online-softmax scratch (m, l, acc) carries across it.  (The first
+    # version looped over K inside the kernel with lane-dim dynamic
+    # slices — 3.5x slower than the hsd kernel; measured in /tmp/ab.log.)
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    # causal: skip blocks whose every key is after this block's last query
+    run = True
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        first_k = k_off + kb * block_k
+        run = first_k <= last_q
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # (D, bq)
+        k = k_ref[0, 0].astype(jnp.float32)               # (D, bk)
+        v = v_ref[0, 0].astype(jnp.float32)
+        bq = q.shape[1]
+        s = jax.lax.dot_general(
+            q, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_rel < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = m_sc[0]
+        l = l_sc[0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        m_sc[0] = m_new
+        l_sc[0] = l * corr + jnp.sum(p, axis=-1)
+        acc_sc[...] = acc_sc[...] * corr[None, :] + jax.lax.dot_general(
+            v, p, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (D, bq)
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        l = l_sc[0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_sc[...] / l_safe[None, :]).astype(o_ref.dtype)
+        # lse block (1, 1, 1, block_q): singleton second-minor passes the
+        # Mosaic tile rule with no broadcast lanes
+        lse_ref[0, 0] = (m_sc[0] + jnp.log(l_safe))[None, :]
+
+
+def _flash_fwd_pallas_ds(q, k, v, q_off, k_off, scale, causal,
+                         block_q, block_k):
+    """q/k/v: (b, h, D, S[q|kv]).  Returns o (b, h, D, Sq), lse (b,h,Sq)."""
+    b, h, d, sq = q.shape
+    skv = k.shape[3]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pad_q))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pad_k))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad_k))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    kernel = functools.partial(
+        _fwd_kernel_ds, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=skv)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, sq_p // block_q, skv_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, d, block_q),
+                         lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+            pl.BlockSpec((1, 1, d, block_k),
+                         lambda i, j, k_, kb, qo, ko: (i, j, 0, kb)),
+            pl.BlockSpec((1, 1, d, block_k),
+                         lambda i, j, k_, kb, qo, ko: (i, j, 0, kb)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, d, block_q),
+                         lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block_q), jnp.float32),
+            pltpu.VMEM((1, block_q), jnp.float32),
+            pltpu.VMEM((d, block_q), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, d, sq_p), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, sq_p), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * sq_p * skv_p * d,
+            bytes_accessed=(qp.size + kp.size + vp.size) * qp.dtype.itemsize,
+            transcendentals=b * h * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
+      qp, kp, vp)
+    lse = lse[:, :, 0]
+    if pad_q:
+        out, lse = out[..., :sq], lse[..., :sq]
+    return out, lse
+
+
+def _bwd_dq_kernel_ds(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dq_sc, *, scale, causal, block_q,
+                      block_k, kv_len, q_len):
+    # grid (b, h, nq, nk): K innermost/sequential, dq accumulates in
+    # scratch (same streaming structure as _fwd_kernel_ds)
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    run = True
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        run = k_off + kb * block_k <= last_q
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)               # (D, bq)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0]                            # (bq,)
+        delta = delta_ref[0, 0, 0]
+        k = k_ref[0, 0].astype(jnp.float32)               # (D, bk)
+        v = v_ref[0, 0].astype(jnp.float32)
+        bq = q.shape[1]
+        s = jax.lax.dot_general(q, k, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_rel = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_off + q_rel >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale               # (bq, bk)
+        dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
+            k.astype(k_ref.dtype), ds.astype(k_ref.dtype),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_ds(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale,
+                       causal, block_q, block_k, kv_len, q_len):
+    # grid (b, h, nk, nq): Q innermost/sequential, dk/dv accumulate in
+    # scratch while Q/dO/lse/delta blocks stream
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    run = True
+    if causal:
+        # skip q blocks whose last query precedes this K block's first key
+        run = q_off + (qi + 1) * block_q - 1 >= k_off + ki * block_k
+
+    @pl.when(run)
+    def _update():
+        k = k_ref[0, 0].astype(jnp.float32)               # (D, bk)
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)               # (D, bq)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0]
+        delta = delta_ref[0, 0, 0]
+        bk = k.shape[1]
+        s = jax.lax.dot_general(q, k, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_rel = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        k_rel = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 1)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_off + q_rel >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # (bq, bk)
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
+            do.astype(do_ref.dtype), p.astype(do_ref.dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
+            q.astype(q_ref.dtype), ds.astype(q_ref.dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas_ds(scale, causal, block_q, block_k, res, grads):
+    """res carries dS-layout tensors: (q, k, v, o) as (b, h, D, S)."""
+    q, k, v, o, lse, q_off, k_off = res
+    g, glse = grads                       # g: (b, h, Sq, D) — API layout
+    b, h, d, sq = q.shape
+    skv = k.shape[3]
+    g = g.swapaxes(2, 3)                  # -> (b, h, D, Sq), unpadded copy
+    block_q = min(block_q, max(sq, 128))
+    block_k = min(block_k, max(skv, 128))
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pad_q))) if pad_q else q
+    dop = jnp.pad(g, ((0, 0), (0, 0), (0, 0), (0, pad_q))) if pad_q else g
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pad_k))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad_k))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    delta = (jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=2)
+             - glse.astype(jnp.float32))  # (b, h, Sq)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q else delta
+    lsep = lsep[:, :, None, :]            # (b, h, 1, Sq_p)
+    deltap = deltap[:, :, None, :]
+
+    qo = jnp.asarray([q_off], jnp.int32)
+    ko = jnp.asarray([k_off], jnp.int32)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=skv, q_len=sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_ds, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, sq_p // block_q, skv_p // block_k),
+            in_specs=[
+                pl.BlockSpec((1, 1, d, block_q),
+                             lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+                pl.BlockSpec((1, 1, d, block_k),
+                             lambda i, j, k_, kb, qo, ko: (i, j, 0, kb)),
+                pl.BlockSpec((1, 1, d, block_k),
+                             lambda i, j, k_, kb, qo, ko: (i, j, 0, kb)),
+                pl.BlockSpec((1, 1, d, block_q),
+                             lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j, k_, kb, qo, ko: (i, j, 0, k_)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, d, block_q),
+                                   lambda i, j, k_, kb, qo, ko:
+                                   (i, j, 0, k_)),
+            scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d, sq_p), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * b * h * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * h * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_ds, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, skv_p // block_k, sq_p // block_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, d, block_q),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, qb)),
+                pl.BlockSpec((1, 1, d, block_k),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, k_)),
+                pl.BlockSpec((1, 1, d, block_k),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, k_)),
+                pl.BlockSpec((1, 1, d, block_q),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, qb)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, qb)),
+                pl.BlockSpec((1, 1, 1, block_q),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, qb)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, d, block_k),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, k_)),
+                pl.BlockSpec((1, 1, d, block_k),
+                             lambda i, j, k_, qb, qo, ko: (i, j, 0, k_)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((d, block_k), jnp.float32),
+                pltpu.VMEM((d, block_k), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, d, skv_p), k.dtype),
+            jax.ShapeDtypeStruct((b, h, d, skv_p), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * b * h * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * h * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    if pad_q:
+        dq = dq[..., :sq]
+    if pad_k:
+        dk, dv = dk[..., :skv], dv[..., :skv]
+    # back to the API layout (unpadded copies; XLA folds them into the
+    # model's own head-merge transposes)
+    dq = dq.swapaxes(2, 3)
+    dk = dk.swapaxes(2, 3)
+    dv = dv.swapaxes(2, 3)
+    zero_off = (jnp.asarray(q_off, jnp.float32) * 0,
+                jnp.asarray(k_off, jnp.float32) * 0)
+    return (dq, dk, dv) + zero_off
+
+
+# ---------------------------------------------------------------------------
 # Public entry
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k, impl):
     qo = jnp.asarray(q_off, jnp.int32)
     ko = jnp.asarray(k_off, jnp.int32)
-    if _HAS_PALLAS and _use_pallas(q, kv_len=k.shape[2]):
+    if impl == "pallas_ds":
+        o_ds, lse = _flash_fwd_pallas_ds(
+            q.swapaxes(2, 3), k.swapaxes(2, 3), v.swapaxes(2, 3),
+            qo, ko, scale, causal, block_q, block_k)
+        return o_ds.swapaxes(2, 3), lse
+    if impl == "pallas_hsd":
         return _flash_fwd_pallas(q, k, v, qo, ko, scale, causal,
                                  block_q, block_k)
     return _flash_fwd_jnp(q, k, v, qo, ko, scale, causal, block_k)
 
 
-def _flash_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q, block_k):
-    out, lse = _flash(q, k, v, q_off, k_off, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
+                    impl):
     qo = jnp.asarray(q_off, jnp.int32)
     ko = jnp.asarray(k_off, jnp.int32)
+    if impl == "pallas_ds":
+        # residuals live in the unpadded dS layout: the API-layout q/k/v
+        # die after the boundary swap, so the saved activations cost half
+        # the HBM of the padded (.., S, 64) form
+        q_ds, k_ds, v_ds = (t.swapaxes(2, 3) for t in (q, k, v))
+        o_ds, lse = _flash_fwd_pallas_ds(q_ds, k_ds, v_ds, qo, ko, scale,
+                                         causal, block_q, block_k)
+        return ((o_ds.swapaxes(2, 3), lse),
+                (q_ds, k_ds, v_ds, o_ds, lse, qo, ko))
+    out, lse = _flash(q, k, v, q_off, k_off, scale, causal, block_q,
+                      block_k, impl)
     return (out, lse), (q, k, v, out, lse, qo, ko)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, grads):
+def _flash_bwd_rule(scale, causal, block_q, block_k, impl, res, grads):
     import os
 
-    q = res[0]
     # MXNET_FLASH_BWD=jnp forces the scan fallback (escape hatch while the
     # Pallas backward burns in on hardware)
-    use_pallas = (_HAS_PALLAS
-                  and _use_pallas(q, kv_len=res[1].shape[2])
-                  and os.environ.get("MXNET_FLASH_BWD", "pallas") != "jnp")
-    if use_pallas:
+    force_jnp = os.environ.get("MXNET_FLASH_BWD", "pallas") == "jnp"
+    if impl == "pallas_ds":
+        if not force_jnp:
+            return _flash_bwd_pallas_ds(scale, causal, block_q, block_k,
+                                        res, grads)
+        q, k, v, o, lse, qo, ko = res
+        res = (q.swapaxes(2, 3), k.swapaxes(2, 3), v.swapaxes(2, 3),
+               o.swapaxes(2, 3), lse, qo, ko)
+        return _flash_bwd(scale, causal, block_k, res, grads)
+    if impl == "pallas_hsd" and not force_jnp:
         return _flash_bwd_pallas(scale, causal, block_q, block_k, res,
                                  grads)
     return _flash_bwd(scale, causal, block_k, res, grads)
@@ -549,9 +956,27 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, res, grads):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _pick_impl(q, kv_len):
+    """Static kernel choice (trace-time).  Size gate from on-chip
+    measurement (scripts/diag_round3.py attnbwd): at S=1024 the Pallas
+    backward beats the jnp scan 10x, but below ~512x512 the kernel
+    launches + boundary copies cost more than the scan's few fused blocks
+    (0.5 ms jnp vs 3.6 ms pallas at 512x384).  MXNET_FLASH_LAYOUT=hsd
+    keeps the original (.., S, D)-layout kernels for A/B."""
+    import os
+
+    if not (_HAS_PALLAS and _use_pallas(q, kv_len=kv_len)):
+        return "jnp"
+    if q.shape[2] * kv_len < 512 * 512:
+        return "jnp"
+    if os.environ.get("MXNET_FLASH_LAYOUT", "ds") == "hsd":
+        return "pallas_hsd"
+    return "pallas_ds"
+
+
 def flash_attention(q, k, v, *, causal=False, scale=None,
                     q_offset=0.0, k_offset=0.0,
-                    block_q=128, block_k=128, with_lse=False):
+                    block_q=256, block_k=256, with_lse=False):
     """Fused attention over (batch, heads, seq, head_dim) arrays.
 
     ``scale`` defaults to 1/sqrt(head_dim).  ``q_offset``/``k_offset`` are
@@ -568,5 +993,6 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
     q_off = jnp.asarray(q_offset, jnp.float32)
     k_off = jnp.asarray(k_offset, jnp.float32)
     out, lse = _flash(q, k, v, q_off, k_off, float(scale), bool(causal),
-                      int(block_q), int(block_k))
+                      int(block_q), int(block_k),
+                      _pick_impl(q, k.shape[2]))
     return (out, lse) if with_lse else out

@@ -53,9 +53,16 @@ try:  # pallas is TPU-only in some builds
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
+# MXNET_PALLAS_INTERPRET=1: run kernels through the interpreter so CPU CI
+# executes the real kernel bodies (see flash_attention.py)
+import os as _os
+
+_INTERPRET = _os.environ.get("MXNET_PALLAS_INTERPRET", "0") == "1"
+
 
 def _use_pallas(x, w):
-    if not _HAS_PALLAS or jax.default_backend() != "tpu":
+    if not _HAS_PALLAS or (jax.default_backend() != "tpu"
+                            and not _INTERPRET):
         return False
     n, d = x.shape
     v = w.shape[0]
@@ -169,6 +176,7 @@ def _fwd_pallas(x, w, b, label, grad_scale, ignore_label, use_ignore,
                             + wp.size * wp.dtype.itemsize),
             transcendentals=np_ * vp_,
         ),
+        interpret=_INTERPRET,
     )(xp, wp, bp.reshape(1, -1), lblp.reshape(1, -1))
     return nll[0, :n], lse[0, :n]
 
@@ -289,6 +297,7 @@ def _bwd_pallas(x, w, b, label, lse, grad_scale, ignore_label, use_ignore,
                             + xp.size * xp.dtype.itemsize * 2),
             transcendentals=np_ * vp_,
         ),
+        interpret=_INTERPRET,
     )(xp, wp, bp, lblp, lsep)
 
     dw, db = pl.pallas_call(
@@ -319,6 +328,7 @@ def _bwd_pallas(x, w, b, label, lse, grad_scale, ignore_label, use_ignore,
                             + wp.size * wp.dtype.itemsize * 2),
             transcendentals=np_ * vp_,
         ),
+        interpret=_INTERPRET,
     )(xp, wp, bp, lblp, lsep)
 
     if pad_n:

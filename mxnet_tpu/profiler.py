@@ -72,6 +72,55 @@ def save_device_memory_profile(path, backend=None):
     jax.profiler.save_device_memory_profile(path, backend=backend)
 
 
+def device_sync(tree):
+    """Hard execution barrier for timing.
+
+    `jax.block_until_ready` resolves when the *enqueue* completes on
+    relay-backed platforms (the axon client's buffers report ready
+    immediately), so timing loops that use it measure dispatch, not the
+    device.  This fetches one scalar whose value depends on a leaf of
+    ``tree`` — the producing executable must finish and a host round-trip
+    must complete before it returns.  On in-process backends (cpu/tpu
+    direct) it degrades to a cheap 4-byte transfer.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype") and getattr(leaf, "size", 0):
+            np.asarray(_scalar_probe(leaf))
+            return
+    # no array leaves: nothing to wait on
+
+
+@jax.jit
+def _scalar_probe(t):
+    return jax.numpy.ravel(t)[:1]
+
+
+def timed_median(run, sync_tree_fn, reps=2, windows=3):
+    """Median per-call seconds of ``run()`` over ``windows`` fixed-size
+    windows, each closed by a `device_sync`.
+
+    Robust against one-off stalls (recompiles, relay hiccups): a polluted
+    window lands above the median and is discarded.  (Do NOT time by
+    differencing two window sizes to cancel the relay constant — a stall
+    landing in the small window silently deflates the result; that once
+    produced a fictitious 3.8x speedup.)  The constant dispatch+fetch
+    cost is NOT subtracted — size ``reps`` so each window's real work
+    dwarfs the ~0.75 s relay round-trip."""
+    times = []
+    for _ in range(windows):
+        times.append(_timed_window(run, sync_tree_fn, reps))
+    times.sort()
+    return times[len(times) // 2] / reps
+
+
+def _timed_window(run, sync_tree_fn, reps):
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run()
+    device_sync(sync_tree_fn())
+    return time.perf_counter() - t0
+
+
 class StepTimer:
     """Host-side per-step wall-clock stats: the `Speedometer` companion for
     loops that want numbers without a trace viewer.  `tic()` each step;

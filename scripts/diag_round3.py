@@ -24,28 +24,26 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def _timeit(fn, reps=5):
-    import jax
+    """Per-call seconds with the relay's constant fetch cost differenced
+    out (block_until_ready resolves at enqueue there — see
+    profiler.device_sync)."""
+    from mxnet_tpu import profiler
 
-    out = fn()
-    jax.block_until_ready(out)
-    t0 = time.time()
-    for _ in range(reps):
-        out = fn()
-    jax.block_until_ready(out)
-    return (time.time() - t0) / reps
+    holder = {"out": fn()}
+    profiler.device_sync(holder["out"])
+
+    def run():
+        holder["out"] = fn()
+
+    return profiler.timed_median(run, lambda: holder["out"],
+                                 reps=max(1, reps // 2), windows=3)
 
 
 def stage_attnbwd():
     import jax
     import jax.numpy as jnp
 
-    # the package __init__ re-exports the flash_attention *function* under
-    # the same name as the submodule, shadowing attribute-lookup imports;
-    # go through sys.modules via importlib
-    import importlib
-
-    fa = importlib.import_module(
-        "mxnet_tpu.ops.pallas_kernels.flash_attention")
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_mod as fa
 
     rng = np.random.RandomState(0)
     for causal, sq, skv in ((True, 1024, 1024), (False, 512, 384)):
@@ -70,10 +68,11 @@ def stage_attnbwd():
             print("attnbwd causal=%s %s maxdiff %.4f (scale %.3f)"
                   % (causal, name, diff, ref))
             assert diff <= 0.05 * max(ref, 1.0), (name, diff, ref)
-        tp = _timeit(lambda: fa._flash_bwd_pallas(
-            scale, causal, 128, 128, res, grads), reps=10)
-        tj = _timeit(lambda: fa._flash_bwd(scale, causal, 128, res,
-                                           grads), reps=10)
+        fp = jax.jit(lambda r, g: fa._flash_bwd_pallas(
+            scale, causal, 128, 128, r, g))
+        fj = jax.jit(lambda r, g: fa._flash_bwd(scale, causal, 128, r, g))
+        tp = _timeit(lambda: fp(res, grads), reps=10)
+        tj = _timeit(lambda: fj(res, grads), reps=10)
         print("attnbwd causal=%s: pallas %.2f ms vs jnp-scan %.2f ms"
               % (causal, tp * 1e3, tj * 1e3))
 
@@ -129,14 +128,19 @@ def stage_headscan():
     label = jnp.asarray(rng.randint(0, V, (N,)), jnp.float32)
     for fused in (False, True):
         for unroll in (1, 2):
+            from mxnet_tpu import profiler
+
             params = (jnp.asarray(rng.randn(V, D) * 0.02, jnp.float32),
                       jnp.zeros((V,), jnp.float32))
             loop = _head_step_fn(fused, N, D, V, nsteps, unroll)
-            params = loop(params, x, label)  # compile+warm
-            t0 = time.time()
-            params = loop(params, x, label)
-            jax.block_until_ready(params)
-            dt = (time.time() - t0) / nsteps
+            holder = {"p": loop(params, x, label)}  # compile+warm
+            profiler.device_sync(holder["p"])
+
+            def run():
+                holder["p"] = loop(holder["p"], x, label)
+
+            dt = profiler.timed_median(run, lambda: holder["p"],
+                                       reps=2, windows=3) / nsteps
             print("headscan fused=%s unroll=%d: %.1f ms/step"
                   % (fused, unroll, dt * 1e3))
 
@@ -165,15 +169,18 @@ def _make_trainer(fused, unroll_env=None):
 def stage_unroll():
     import jax
 
+    from mxnet_tpu import profiler
+
     for fused in (False, True):
         tr, dev, tokens = _make_trainer(fused)
         ns = 8
         tr.run_steps(dev, ns)
-        jax.block_until_ready(tr.params)
-        t0 = time.time()
-        tr.run_steps(dev, ns)
-        jax.block_until_ready(tr.params)
-        dt = (time.time() - t0) / ns
+        profiler.device_sync(tr.params)
+        tr.run_steps(dev, ns)  # absorb the first-donation relay stall
+        profiler.device_sync(tr.params)
+        dt = profiler.timed_median(
+            lambda: tr.run_steps(dev, ns), lambda: tr.params,
+            reps=2, windows=3) / ns
         print("unroll2 fused=%s: %.0f ms/step %.1fk tok/s"
               % (fused, dt * 1e3, tokens / dt / 1e3))
         del tr, dev
@@ -211,6 +218,8 @@ def stage_hbm():
     import jax
     import jax.numpy as jnp
 
+    from mxnet_tpu import profiler
+
     @jax.jit
     def saxpy(x, y):
         return x * 1.0001 + y  # reads 2N, writes N
@@ -218,29 +227,28 @@ def stage_hbm():
     for mb in (256, 1024, 4096):
         n = mb * 1024 * 1024 // 4
         x = jnp.ones((n,), jnp.float32)
-        y = jnp.ones((n,), jnp.float32)
-        out = saxpy(x, y)
-        jax.block_until_ready(out)
-        t0 = time.time()
-        reps = 10
-        for _ in range(reps):
-            out = saxpy(x, out)
-        jax.block_until_ready(out)
-        dt = (time.time() - t0) / reps
+        holder = {"out": saxpy(x, jnp.ones((n,), jnp.float32))}
+        profiler.device_sync(holder["out"])
+
+        def run():
+            holder["out"] = saxpy(x, holder["out"])
+
+        dt = profiler.timed_median(run, lambda: holder["out"],
+                                   reps=8, windows=3)
         gbs = 3 * n * 4 / dt / 1e9
         print("hbm stream %4d MB buffers: %.0f GB/s achieved" % (mb, gbs))
 
     # copy-only stream (2N traffic)
     n = 1024 * 1024 * 1024 // 4
-    x = jnp.ones((n,), jnp.float32)
     cp = jax.jit(lambda a: a + 0.0)
-    out = cp(x)
-    jax.block_until_ready(out)
-    t0 = time.time()
-    for _ in range(10):
-        out = cp(out)
-    jax.block_until_ready(out)
-    dt = (time.time() - t0) / 10
+    holder = {"out": cp(jnp.ones((n,), jnp.float32))}
+    profiler.device_sync(holder["out"])
+
+    def run():
+        holder["out"] = cp(holder["out"])
+
+    dt = profiler.timed_median(run, lambda: holder["out"], reps=8,
+                               windows=3)
     print("hbm copy 1 GB: %.0f GB/s achieved" % (2 * n * 4 / dt / 1e9))
 
 

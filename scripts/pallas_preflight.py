@@ -30,14 +30,8 @@ def run(verbose=True):
     import jax
     import jax.numpy as jnp
 
-    # bind the MODULES via importlib: the package __init__ re-exports
-    # same-named functions, which shadow the submodules under both
-    # from-import and dotted import-as
-    import importlib
-
-    fa = importlib.import_module(
-        "mxnet_tpu.ops.pallas_kernels.flash_attention")
-    fc = importlib.import_module("mxnet_tpu.ops.pallas_kernels.fused_ce")
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_mod as fa
+    from mxnet_tpu.ops.pallas_kernels import fused_ce_mod as fc
 
     if jax.default_backend() != "tpu":
         return {"status": "skip: backend is %s" % jax.default_backend()}
@@ -98,6 +92,22 @@ def _run_checks(jax, jnp, fa, fc, verbose):
         check("flash_bwd_%s_dq" % tag, dq_p, dq_j, 3e-2)
         check("flash_bwd_%s_dk" % tag, dk_p, dk_j, 3e-2)
         check("flash_bwd_%s_dv" % tag, dv_p, dv_j, 3e-2)
+
+        # dS-layout kernels (the unpadded-tile default path)
+        o_d, lse_d = jax.jit(
+            lambda q, k, v, c=causal: fa._flash_fwd_pallas_ds(
+                q.swapaxes(2, 3), k.swapaxes(2, 3), v.swapaxes(2, 3),
+                zero, zero, scale, c, 128, 128))(q, k, v)
+        check("flash_fwd_ds_%s_out" % tag, o_d.swapaxes(2, 3), o_j, 2e-2)
+        check("flash_fwd_ds_%s_lse" % tag, lse_d, lse_j, 1e-3)
+        res_ds = (q.swapaxes(2, 3), k.swapaxes(2, 3), v.swapaxes(2, 3),
+                  o_j.swapaxes(2, 3), lse_j, zero, zero)
+        dq_d, dk_d, dv_d = jax.jit(
+            lambda res, grads, c=causal: fa._flash_bwd_pallas_ds(
+                scale, c, 128, 128, res, grads)[:3])(res_ds, grads)
+        check("flash_bwd_ds_%s_dq" % tag, dq_d, dq_j, 3e-2)
+        check("flash_bwd_ds_%s_dk" % tag, dk_d, dk_j, 3e-2)
+        check("flash_bwd_ds_%s_dv" % tag, dv_d, dv_j, 3e-2)
 
     # ---- fused softmax-CE: fwd + bwd ----------------------------------
     N, Dm, V = 512, 128, 4096

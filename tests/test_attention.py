@@ -273,12 +273,7 @@ def test_layer_norm_3d_and_symbol_path():
 def test_flash_backward_pallas_matches_jnp_on_tpu():
     """Pallas dq + dk/dv kernels vs the jnp scan fallback, on-chip, causal
     and non-causal, with ragged (padded) sequence lengths."""
-    # the package __init__ re-exports the flash_attention *function*,
-    # shadowing the submodule under from-import; bind via sys.modules
-    import importlib
-
-    fa = importlib.import_module(
-        "mxnet_tpu.ops.pallas_kernels.flash_attention")
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_mod as fa
 
     rng = np.random.RandomState(0)
     for causal, sq, skv in ((True, 640, 640), (False, 512, 384)):

@@ -69,14 +69,23 @@ def run():
         "data": rng.randint(0, V, (B, S)).astype(np.int32),
         "softmax_label": rng.randint(0, V, (B, S)).astype(np.float32),
     }
+    from mxnet_tpu import profiler
+
     dev_batch = trainer.shard_batch(batch)
-    trainer.run_steps(dev_batch, steps)  # compile + warm
-    jax.block_until_ready(trainer.params)
-    t0 = time.time()
-    for _ in range(reps):
-        trainer.run_steps(dev_batch, steps)
-    jax.block_until_ready(trainer.params)
-    dt = (time.time() - t0) / (steps * reps)
+    # two warm calls: the first compiles; the second absorbs the one-time
+    # relay/layout re-stabilization seen on the first donated-buffer
+    # round-trip (a second full compile-length stall on the axon relay)
+    trainer.run_steps(dev_batch, steps)
+    profiler.device_sync(trainer.params)
+    trainer.run_steps(dev_batch, steps)
+    profiler.device_sync(trainer.params)
+    # median-of-windows timing: robust to one-off relay stalls (a stall in
+    # a delta window once produced a fictitious 3.8x speedup); the ~0.75 s
+    # relay fetch is amortized over steps-per-window, not subtracted
+    dt = profiler.timed_median(
+        lambda: trainer.run_steps(dev_batch, steps),
+        lambda: trainer.params, reps=max(1, reps // 2),
+        windows=3) / steps
 
     tokens_per_sec = B * S / dt
     # active params: matmul-participating weights (incl. the tied-size
