@@ -934,11 +934,9 @@ def _flash_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, impl, res, grads):
-    import os
-
     # MXNET_FLASH_BWD=jnp forces the scan fallback (escape hatch while the
     # Pallas backward burns in on hardware)
-    force_jnp = os.environ.get("MXNET_FLASH_BWD", "pallas") == "jnp"
+    force_jnp = _os.environ.get("MXNET_FLASH_BWD", "pallas") == "jnp"
     if impl == "pallas_ds":
         if not force_jnp:
             return _flash_bwd_pallas_ds(scale, causal, block_q, block_k,
@@ -961,17 +959,23 @@ def _pick_impl(q, kv_len):
     measurement (scripts/diag_round3.py attnbwd): at S=1024 the Pallas
     backward beats the jnp scan 10x, but below ~512x512 the kernel
     launches + boundary copies cost more than the scan's few fused blocks
-    (0.5 ms jnp vs 3.6 ms pallas at 512x384).  MXNET_FLASH_LAYOUT=hsd
-    keeps the original (.., S, D)-layout kernels for A/B."""
-    import os
-
+    (0.5 ms jnp vs 3.6 ms pallas at 512x384).  MXNET_FLASH_LAYOUT=ds
+    opts into the dS-layout kernels for A/B / capacity."""
+    forced = _os.environ.get("MXNET_FLASH_IMPL")
+    if forced in ("jnp", "pallas_ds", "pallas_hsd"):
+        return forced
     if not (_HAS_PALLAS and _use_pallas(q, kv_len=kv_len)):
         return "jnp"
     if q.shape[2] * kv_len < 512 * 512:
         return "jnp"
-    if os.environ.get("MXNET_FLASH_LAYOUT", "ds") == "hsd":
-        return "pallas_hsd"
-    return "pallas_ds"
+    # hsd default from the round-4 in-model A/B at GPT-2-small shape
+    # (median windows, B=32 S=1024 d=64): hsd 77.6k tok/s > all-jnp 73.8k
+    # > grid-ds 49.4k.  The dS kernels win in isolation but their
+    # boundary (b,h,S,d)<->(b,h,d,S) transposes do not fold away inside
+    # the compiled step; keep them selectable for capacity-bound runs.
+    if _os.environ.get("MXNET_FLASH_LAYOUT", "hsd") == "ds":
+        return "pallas_ds"
+    return "pallas_hsd"
 
 
 def flash_attention(q, k, v, *, causal=False, scale=None,

@@ -85,13 +85,17 @@ def test_flash_bwd_kernels_match_jnp(interpret, causal, sq, skv):
         assert _maxerr(a, b_) < 1e-4, ("ds", name)
 
 
-def test_flash_public_api_grad_via_interpret(interpret):
-    """End-to-end: _pick_impl routes to pallas_ds under interpret, and the
-    custom_vjp grad through the kernels matches the jnp impl."""
+def test_flash_public_api_grad_via_interpret(interpret, monkeypatch):
+    """End-to-end: _pick_impl routes to a Pallas impl under interpret
+    (hsd by default, ds via MXNET_FLASH_LAYOUT), and the custom_vjp grad
+    through the kernels matches the jnp impl."""
     rng = np.random.RandomState(2)
     q = jnp.asarray(rng.randn(1, 2, 640, 64) * 0.5, jnp.float32)
     k = jnp.asarray(rng.randn(1, 2, 640, 64) * 0.5, jnp.float32)
     v = jnp.asarray(rng.randn(1, 2, 640, 64) * 0.5, jnp.float32)
+    monkeypatch.delenv("MXNET_FLASH_LAYOUT", raising=False)
+    assert fa._pick_impl(q, 640) == "pallas_hsd"
+    monkeypatch.setenv("MXNET_FLASH_LAYOUT", "ds")
     assert fa._pick_impl(q, 640) == "pallas_ds"
 
     def loss(q, k, v):
