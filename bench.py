@@ -57,8 +57,12 @@ def main():
             "metric": "resnet50_train_images_per_sec_per_chip",
             "value": None,
             "unit": "UNMEASURED: jax device init unreachable (TPU relay "
-                    "down) — see BENCH_r02.json for the last measured "
-                    "2441 img/s/chip",
+                    "down) — last on-chip measurements (round 4, "
+                    "docs/mfu_roofline.md): ResNet-50 2356-2362 img/s/chip "
+                    "(29.3-29.4% MFU); transformer-LM 76.6-77.6k "
+                    "tok/s/chip 27.9-28.3% MFU (GPT-2 parity shape) and "
+                    "114-116.4k tok/s 41.5-42.4% MFU (head_dim-128 TPU "
+                    "geometry) across runs; Pallas parity preflight: pass",
             "vs_baseline": None,
             "unmeasured": True,
         }))
@@ -243,8 +247,12 @@ def _run_with_oom_retry(fn, tries=3, wait=20):
         except Exception as e:
             if "RESOURCE_EXHAUSTED" not in str(e) or attempt == tries - 1:
                 raise
-            gc.collect()
-            _time.sleep(wait * (attempt + 1))
+        # back off OUTSIDE the except block: the exception's traceback
+        # frames pin the failed attempt's device buffers, so collecting
+        # and sleeping inside it would wait while the OOM-causing HBM is
+        # still held
+        gc.collect()
+        _time.sleep(wait * (attempt + 1))
 
 
 def _transformer_metrics():
@@ -264,6 +272,7 @@ def _transformer_metrics():
 
     os.environ.setdefault("TBENCH_STEPS", "10")
     os.environ.setdefault("TBENCH_REPS", "2")
+    base_vdtype = os.environ.get("TBENCH_ADAM_V_DTYPE")
     os.environ.setdefault("TBENCH_ADAM_V_DTYPE", "bfloat16")
     out = {}
     base_heads = os.environ.get("TBENCH_HEADS")
@@ -273,8 +282,9 @@ def _transformer_metrics():
     # meaningful when the embed divides into 128-wide heads and the
     # result differs from the parity config
     geom_heads = embed // 128
+    parity_heads = base_heads or str(benchmark_transformer.DEFAULT_HEADS)
     if geom_heads >= 1 and embed % 128 == 0 and \
-            str(geom_heads) != (base_heads or "12"):
+            str(geom_heads) != parity_heads:
         configs.append(("tpu_geom_", "0", str(geom_heads)))
     if os.environ.get("BENCH_TRANSFORMER_FUSED", "0") not in ("0", "false"):
         configs.append(("fused_", "1", base_heads))
@@ -301,7 +311,8 @@ def _transformer_metrics():
             })
     finally:
         for name, old in (("TBENCH_HEADS", base_heads),
-                          ("TBENCH_FUSED_HEAD", base_fused)):
+                          ("TBENCH_FUSED_HEAD", base_fused),
+                          ("TBENCH_ADAM_V_DTYPE", base_vdtype)):
             if old is None:
                 os.environ.pop(name, None)
             else:

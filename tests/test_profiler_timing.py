@@ -1,0 +1,95 @@
+"""Timing helpers built for the relay-backed chip (round 4).
+
+`device_sync` must be a real execution barrier everywhere (on the relay,
+`block_until_ready` resolves at enqueue); `timed_median` must reject a
+one-off stall window (a stall in a differenced window once fabricated a
+3.8x speedup — docs/mfu_roofline.md).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import profiler
+
+
+def test_device_sync_handles_arbitrary_pytrees():
+    x = jnp.ones((8, 8))
+    profiler.device_sync(x)
+    profiler.device_sync({"a": [x, None], "b": 3})
+    profiler.device_sync((None, "s"))  # no array leaves: no-op
+    profiler.device_sync(jnp.ones(()))  # 0-d leaf has size 1
+
+
+def test_device_sync_forces_value_dependency():
+    # the probe's value depends on the producing computation: a wrong
+    # implementation (e.g. syncing a constant) would not raise on NaNs
+    # nor wait; here we just assert the probe reads through a jit chain
+    f = jax.jit(lambda a: a * 2.0)
+    out = f(jnp.full((4, 4), 21.0))
+    profiler.device_sync(out)
+    assert float(out[0, 0]) == 42.0
+
+
+def test_timed_median_rejects_one_off_stall(monkeypatch):
+    calls = {"n": 0}
+
+    def run():
+        calls["n"] += 1
+
+    # fake a stall in the FIRST window by patching the clock: windows
+    # measure [10s, 1s, 1s] -> median must be ~1s/rep, not the mean
+    times = iter([0.0, 10.0,      # window 0: stall
+                  10.0, 11.0,     # window 1
+                  11.0, 12.0])    # window 2
+
+    monkeypatch.setattr(time, "perf_counter", lambda: next(times))
+    monkeypatch.setattr(profiler, "device_sync", lambda tree: None)
+    dt = profiler.timed_median(run, lambda: None, reps=1, windows=3)
+    assert dt == pytest.approx(1.0)
+    assert calls["n"] == 3
+
+
+def test_timed_median_divides_by_reps(monkeypatch):
+    times = iter([0.0, 4.0, 0.0, 4.0, 0.0, 4.0])
+    monkeypatch.setattr(time, "perf_counter", lambda: next(times))
+    monkeypatch.setattr(profiler, "device_sync", lambda tree: None)
+    dt = profiler.timed_median(lambda: None, lambda: None, reps=2,
+                               windows=3)
+    assert dt == pytest.approx(2.0)
+
+
+def test_bench_oom_retry_recovers_and_reraises():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("RESOURCE_EXHAUSTED: hbm")
+        return "ok"
+
+    assert bench._run_with_oom_retry(flaky, tries=3, wait=0) == "ok"
+    assert state["n"] == 3
+
+    def hard_fail():
+        raise RuntimeError("RESOURCE_EXHAUSTED: hbm")
+
+    with pytest.raises(RuntimeError):
+        bench._run_with_oom_retry(hard_fail, tries=2, wait=0)
+
+    def other_error():
+        raise ValueError("not a memory problem")
+
+    with pytest.raises(ValueError):  # non-OOM errors propagate at once
+        bench._run_with_oom_retry(other_error, tries=3, wait=0)

@@ -27,6 +27,9 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+DEFAULT_HEADS = 12  # GPT-2-small parity; bench.py reads this for dedupe
+
+
 def run():
     """Measure and return the result dict (importable by bench.py: a
     subprocess would deadlock on the single-chip relay grant the parent
@@ -38,7 +41,7 @@ def run():
 
     L = int(os.environ.get("TBENCH_LAYERS", "12"))
     D = int(os.environ.get("TBENCH_EMBED", "768"))
-    H = int(os.environ.get("TBENCH_HEADS", "12"))
+    H = int(os.environ.get("TBENCH_HEADS", str(DEFAULT_HEADS)))
     S = int(os.environ.get("TBENCH_SEQ", "1024"))
     B = int(os.environ.get("TBENCH_BATCH", "32"))
     V = int(os.environ.get("TBENCH_VOCAB", "32768"))
