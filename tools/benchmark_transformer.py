@@ -47,9 +47,10 @@ def run():
     V = int(os.environ.get("TBENCH_VOCAB", "32768"))
     steps = int(os.environ.get("TBENCH_STEPS", "15"))
     reps = int(os.environ.get("TBENCH_REPS", "3"))
-    # fused head: measured faster per-step on single-dispatch but slower
-    # under the scan-fused run_steps path (see docs/mfu_roofline.md round-3
-    # notes) — default stays dense until that interaction is resolved
+    # fused head: measures ~= dense at this shape (the head is compute-
+    # bound, so the logits traffic the fused kernel saves hides under the
+    # matmuls — round-4 A/B in docs/mfu_roofline.md); its value is the
+    # HBM it frees at larger batches, so dense stays the timed default
     fused = os.environ.get("TBENCH_FUSED_HEAD", "0").lower() in (
         "1", "true", "yes")
     dtype = os.environ.get("TBENCH_DTYPE", "bfloat16")
