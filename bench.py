@@ -31,13 +31,15 @@ import time
 import numpy as np
 
 
-def _device_probe(timeout=240):
+def _device_probe(timeout=None):
     """Fail fast when the TPU relay is wedged: a hung backend init would
     otherwise stall the whole benchmark run with no record.  Probes in a
     child process (the hang is inside a blocking C call and cannot be
     timed out in-process)."""
     import subprocess
 
+    if timeout is None:
+        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -48,21 +50,38 @@ def _device_probe(timeout=240):
         return False
 
 
+def _bench_store():
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import bench_store
+
+    return bench_store
+
+
 def main():
     if os.environ.get("BENCH_SKIP_PROBE", "0") != "1" and not _device_probe():
-        # value/vs_baseline are null, NOT 0.0: a numeric zero would read as
-        # a real throughput regression to any consumer that doesn't parse
-        # the unit string (round-3 advisor finding)
+        # The relay is down at capture time.  Replay the newest measured
+        # artifact from bench_results/ (written by every successful bench
+        # run this round) — real numbers with their original measured_at
+        # stamp beat the null-with-prose records that voided the round-3/4
+        # scoreboards.  Only if no artifact exists does the record fall
+        # back to null (never 0.0: a numeric zero would read as a real
+        # throughput regression — round-3 advisor finding).
+        stored = _bench_store().latest()
+        if stored is not None:
+            stored["replayed"] = True
+            stored.setdefault("note", "TPU relay down at capture; replaying "
+                              "the newest stored measured artifact — "
+                              "measured_at says when (artifacts persist "
+                              "across rounds; compare with the capture "
+                              "date)")
+            print(json.dumps(stored))
+            return
         print(json.dumps({
             "metric": "resnet50_train_images_per_sec_per_chip",
             "value": None,
             "unit": "UNMEASURED: jax device init unreachable (TPU relay "
-                    "down) — last on-chip measurements (round 4, "
-                    "docs/mfu_roofline.md): ResNet-50 2356-2362 img/s/chip "
-                    "(29.3-29.4% MFU); transformer-LM 76.6-77.6k "
-                    "tok/s/chip 27.9-28.3% MFU (GPT-2 parity shape) and "
-                    "114-116.4k tok/s 41.5-42.4% MFU (head_dim-128 TPU "
-                    "geometry) across runs; Pallas parity preflight: pass",
+                    "down) and no bench_results/ artifact to replay",
             "vs_baseline": None,
             "unmeasured": True,
         }))
@@ -227,6 +246,25 @@ def main():
     extra["pallas_parity"] = pallas_parity
     if extra:
         result["extra"] = extra
+    # persist the measurement so a later capture with the relay down can
+    # replay it (round-4 verdict task 2) — but only a real chip number:
+    # never a run whose kernel-parity gate failed (this run exits 1; a
+    # replay would launder divergent-kernel numbers into a passing
+    # record), and never a CPU-mesh smoke run (tests/nightly.sh drives
+    # bench.py on the CPU backend with tiny shapes — replaying its img/s
+    # as the scoreboard headline would read as a massive regression).
+    # BENCH_RECORD=1/0 overrides for debugging.  A disk error must not
+    # cost the live run its stdout record.
+    should_record = jax.default_backend() == "tpu" \
+        and not str(pallas_parity.get("status", "")).startswith("FAIL")
+    forced_record = os.environ.get("BENCH_RECORD")
+    if forced_record is not None:
+        should_record = forced_record == "1"
+    if should_record:
+        try:
+            _bench_store().record(result)
+        except Exception as e:  # pragma: no cover
+            print("bench_store.record failed: %s" % e, file=sys.stderr)
     print(json.dumps(result))
     if str(pallas_parity.get("status", "")).startswith("FAIL"):
         print("pallas parity preflight FAILED: %s" % pallas_parity,
@@ -272,8 +310,9 @@ def _transformer_metrics():
 
     os.environ.setdefault("TBENCH_STEPS", "10")
     os.environ.setdefault("TBENCH_REPS", "2")
-    base_vdtype = os.environ.get("TBENCH_ADAM_V_DTYPE")
-    os.environ.setdefault("TBENCH_ADAM_V_DTYPE", "bfloat16")
+    # Adam-v dtype: benchmark_transformer.py owns the default (bfloat16)
+    # and discloses it in the unit string — bench.py no longer overrides
+    # it, so standalone and in-bench runs measure the same config
     out = {}
     base_heads = os.environ.get("TBENCH_HEADS")
     embed = int(os.environ.get("TBENCH_EMBED", "768"))
@@ -311,8 +350,7 @@ def _transformer_metrics():
             })
     finally:
         for name, old in (("TBENCH_HEADS", base_heads),
-                          ("TBENCH_FUSED_HEAD", base_fused),
-                          ("TBENCH_ADAM_V_DTYPE", base_vdtype)):
+                          ("TBENCH_FUSED_HEAD", base_fused)):
             if old is None:
                 os.environ.pop(name, None)
             else:

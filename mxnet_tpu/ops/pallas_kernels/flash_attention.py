@@ -963,6 +963,25 @@ def _pick_impl(q, kv_len):
     opts into the dS-layout kernels for A/B / capacity."""
     forced = _os.environ.get("MXNET_FLASH_IMPL")
     if forced in ("jnp", "pallas_ds", "pallas_hsd"):
+        if forced != "jnp":
+            # A pin bypasses the gates below; fail/warn readably instead of
+            # erroring deep inside Mosaic on a non-TPU backend or an
+            # over-VMEM-cap shape (round-4 advisor finding).
+            if not _HAS_PALLAS:
+                raise RuntimeError(
+                    "MXNET_FLASH_IMPL=%s but jax.experimental.pallas is "
+                    "unavailable in this build — unset the pin or use "
+                    "MXNET_FLASH_IMPL=jnp" % forced)
+            if not _use_pallas(q, kv_len=kv_len):
+                import warnings
+
+                warnings.warn(
+                    "MXNET_FLASH_IMPL=%s pinned, but the auto-router would "
+                    "reject this shape/backend (backend=%s, head_dim=%d, "
+                    "kv_len=%d: non-TPU, head_dim<32, or K/V stream over "
+                    "the ~12MB VMEM cap) — the pinned kernel may fail to "
+                    "lower or spill" % (forced, jax.default_backend(),
+                                        q.shape[-1], kv_len))
         return forced
     if not (_HAS_PALLAS and _use_pallas(q, kv_len=kv_len)):
         return "jnp"

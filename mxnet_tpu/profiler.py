@@ -82,6 +82,12 @@ def device_sync(tree):
     ``tree`` — the producing executable must finish and a host round-trip
     must complete before it returns.  On in-process backends (cpu/tpu
     direct) it degrades to a cheap 4-byte transfer.
+
+    Assumption: all leaves of ``tree`` were produced by the SAME executable
+    (one jitted step's output pytree) — only the first array leaf is
+    probed, so leaves from a different computation (or an uncoupled
+    device) may still be in flight when this returns.  Pass one tree per
+    timed computation; call once per executable otherwise.
     """
     for leaf in jax.tree_util.tree_leaves(tree):
         if hasattr(leaf, "dtype") and getattr(leaf, "size", 0):

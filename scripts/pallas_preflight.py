@@ -93,7 +93,8 @@ def _run_checks(jax, jnp, fa, fc, verbose):
         check("flash_bwd_%s_dk" % tag, dk_p, dk_j, 3e-2)
         check("flash_bwd_%s_dv" % tag, dv_p, dv_j, 3e-2)
 
-        # dS-layout kernels (the unpadded-tile default path)
+        # the opt-in dS-layout kernels (MXNET_FLASH_LAYOUT=ds; hsd is the
+        # ADR-10 default — dS trades speed for unpadded-tile capacity)
         o_d, lse_d = jax.jit(
             lambda q, k, v, c=causal: fa._flash_fwd_pallas_ds(
                 q.swapaxes(2, 3), k.swapaxes(2, 3), v.swapaxes(2, 3),

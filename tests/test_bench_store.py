@@ -1,0 +1,86 @@
+"""bench_results persistence: measured numbers must survive the relay.
+
+Rounds 3/4 lost their scoreboard because the driver's `bench.py` capture
+happened while the axon relay was down — the real measurements existed
+only as prose.  `tools/bench_store.py` persists every measurement as a
+replayable artifact; `bench.py` replays the newest one when the device
+probe fails.  (Round-4 verdict task 2.)
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_store  # noqa: E402
+
+
+def test_record_latest_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert bench_store.latest(results_dir=d) is None
+    p = bench_store.record({"metric": "m", "value": 1.5, "unit": "u",
+                            "vs_baseline": 2.0}, results_dir=d)
+    assert os.path.exists(p)
+    got = bench_store.latest(results_dir=d)
+    assert got["value"] == 1.5
+    assert got["measured_at"]  # stamped
+    assert got["replayed_from"] == os.path.basename(p)
+
+
+def test_latest_returns_newest_and_respects_kind(tmp_path):
+    d = str(tmp_path)
+    bench_store.record({"value": 1}, results_dir=d)
+    p2 = bench_store.record({"value": 2}, results_dir=d)
+    bench_store.record({"value": 99}, kind="io", results_dir=d)
+    got = bench_store.latest(results_dir=d)
+    assert got["value"] == 2
+    assert got["replayed_from"] == os.path.basename(p2)
+    assert bench_store.latest(kind="io", results_dir=d)["value"] == 99
+
+
+def test_caller_supplied_measured_at_is_kept(tmp_path):
+    d = str(tmp_path)
+    bench_store.record({"value": 3, "measured_at": "20260730T000000Z"},
+                       results_dir=d)
+    assert bench_store.latest(results_dir=d)["measured_at"] == \
+        "20260730T000000Z"
+
+
+def test_latest_skips_torn_artifact(tmp_path):
+    d = str(tmp_path)
+    bench_store.record({"value": 7}, results_dir=d)
+    # a torn/truncated file sorting newest must not crash or win
+    with open(os.path.join(d, "bench_99999999T999999Z_zz.json"), "w") as f:
+        f.write('{"value": ')
+    assert bench_store.latest(results_dir=d)["value"] == 7
+
+
+def test_bench_replays_artifact_when_probe_fails(tmp_path):
+    """bench.py with an unreachable device platform must emit the stored
+    artifact (real numbers + measured_at + replayed flag), not null."""
+    d = str(tmp_path)
+    bench_store.record(
+        {"metric": "resnet50_train_images_per_sec_per_chip",
+         "value": 2361.8, "unit": "images/sec/chip (mfu=0.294, ...)",
+         "vs_baseline": 55.57,
+         "extra": {"pallas_parity": {"status": "pass"}}}, results_dir=d)
+    env = dict(os.environ)
+    env.update({"MXNET_BENCH_RESULTS_DIR": d,
+                # an unloadable platform + a short probe timeout simulate
+                # the relay-down capture scenario (the axon sitecustomize
+                # hangs device init even for bogus platforms, so the probe
+                # exits by timeout, exactly like a wedged relay)
+                "JAX_PLATFORMS": "no_such_platform",
+                "BENCH_PROBE_TIMEOUT": "10"})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=110, env=env)
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 2361.8
+    assert rec["vs_baseline"] == 55.57
+    assert rec["replayed"] is True
+    assert rec["measured_at"]
+    assert rec["extra"]["pallas_parity"]["status"] == "pass"

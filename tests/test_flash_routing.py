@@ -1,0 +1,102 @@
+"""`_pick_impl` static routing, unit-tested on the CPU mesh.
+
+Round-4 verdict weak #6: the flash-attention kernel *bodies* run in CI via
+the interpreter (tests/test_pallas_interpret.py), but the routing that
+decides which body runs (size gate at 512x512 score tiles, VMEM cap,
+head_dim floor, env pins) was only exercised on-chip by the preflight — a
+routing regression would ship green and only fail at bench time.  These
+tests pin the decision table down where CI can see it.
+
+The TPU-backend decisions are tested by monkeypatching
+`jax.default_backend` — routing is pure trace-time logic over shapes and
+env, so no kernel ever launches here.
+"""
+import importlib
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+fa = importlib.import_module(
+    "mxnet_tpu.ops.pallas_kernels.flash_attention")
+
+
+def q_of(s, d, dtype=jnp.bfloat16):
+    return jnp.zeros((1, 2, s, d), dtype)
+
+
+@pytest.fixture
+def tpu_backend(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fa, "_HAS_PALLAS", True)
+
+
+def test_cpu_backend_routes_to_jnp(monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert fa._pick_impl(q_of(1024, 64), 1024) == "jnp"
+
+
+def test_default_is_hsd_on_tpu(tpu_backend, monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    monkeypatch.delenv("MXNET_FLASH_LAYOUT", raising=False)
+    assert fa._pick_impl(q_of(1024, 64), 1024) == "pallas_hsd"
+
+
+def test_layout_env_opts_into_ds(tpu_backend, monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    monkeypatch.setenv("MXNET_FLASH_LAYOUT", "ds")
+    assert fa._pick_impl(q_of(1024, 64), 1024) == "pallas_ds"
+
+
+@pytest.mark.parametrize("sq,skv,expect", [
+    (512, 511, "jnp"),          # just under the 512x512 score-tile gate
+    (512, 512, "pallas_hsd"),   # at the boundary the kernel wins
+    (256, 512, "jnp"),          # 256*512 < 512*512
+    (1024, 1024, "pallas_hsd"),
+])
+def test_size_gate_boundary(tpu_backend, monkeypatch, sq, skv, expect):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    monkeypatch.delenv("MXNET_FLASH_LAYOUT", raising=False)
+    assert fa._pick_impl(q_of(sq, 64), skv) == expect
+
+
+def test_tiny_head_dim_routes_to_jnp(tpu_backend, monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    assert fa._pick_impl(q_of(1024, 16), 1024) == "jnp"
+
+
+def test_vmem_cap_routes_to_jnp(tpu_backend, monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    # bf16 d=128: 4 * S * 128 * 2 bytes of streamed K/V+Q/dO; the ~12 MB
+    # cap trips above S=12288
+    assert fa._pick_impl(q_of(12288, 128), 12288) == "pallas_hsd"
+    assert fa._pick_impl(q_of(16384, 128), 16384) == "jnp"
+
+
+def test_pin_jnp_always_wins(tpu_backend, monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_IMPL", "jnp")
+    assert fa._pick_impl(q_of(4096, 128), 4096) == "jnp"
+
+
+def test_pin_pallas_respected_on_ok_shape(tpu_backend, monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_IMPL", "pallas_ds")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # no spurious warning on a good pin
+        assert fa._pick_impl(q_of(1024, 64), 1024) == "pallas_ds"
+
+
+def test_pin_without_pallas_is_a_readable_error(monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_IMPL", "pallas_hsd")
+    monkeypatch.setattr(fa, "_HAS_PALLAS", False)
+    with pytest.raises(RuntimeError, match="MXNET_FLASH_IMPL"):
+        fa._pick_impl(q_of(1024, 64), 1024)
+
+
+def test_pin_on_rejected_shape_warns_but_honors_pin(tpu_backend,
+                                                    monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_IMPL", "pallas_hsd")
+    with pytest.warns(UserWarning, match="auto-router would reject"):
+        # over the VMEM cap: the pin stands but the user is told
+        assert fa._pick_impl(q_of(16384, 128), 16384) == "pallas_hsd"

@@ -63,11 +63,16 @@ def run():
     n_dev = len(jax.devices())
     n_dev = next(k for k in range(n_dev, 0, -1) if B % k == 0)
     mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
+    # bf16 Adam second moments are the benchmark default (stochastic
+    # rounding, tests/test_adam_vdtype.py) — halves the optimizer-table
+    # HBM stream; TBENCH_ADAM_V_DTYPE=float32 opts out.  Disclosed in the
+    # unit string so configs stay comparable across rounds.
+    adam_v = os.environ.get("TBENCH_ADAM_V_DTYPE", "bfloat16") or None
     trainer = SPMDTrainer(
         net, mesh,
         data_shapes={"data": (B, S), "softmax_label": (B, S)},
         lr=1e-3, optimizer="adam", wd=0.0, dtype=dtype,
-        adam_v_dtype=os.environ.get("TBENCH_ADAM_V_DTYPE") or None)
+        adam_v_dtype=adam_v)
     rng = np.random.RandomState(0)
     batch = {
         "data": rng.randint(0, V, (B, S)).astype(np.int32),
@@ -102,9 +107,10 @@ def run():
     result = {
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_dev, 1),
-        "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d S=%d B=%d, %s, %s head)"
-                % (mfu, L, D, S, B, np.dtype(dtype).name,
-                   "fused" if fused else "dense"),
+        "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d H=%d S=%d B=%d, %s, "
+                "%s head, adam_v=%s)"
+                % (mfu, L, D, H, S, B, np.dtype(dtype).name,
+                   "fused" if fused else "dense", adam_v or "float32"),
         "vs_baseline": None,
         "mfu": round(mfu, 4),
     }
