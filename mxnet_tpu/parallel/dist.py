@@ -460,16 +460,46 @@ class DistKVStore(KVStore):
         order, a shared-socket lock deadlocks ranks against each other."""
         msg.setdefault("rank", self.rank)
         pool = self._pools[server]
-        sock = pool.acquire()
+        try:
+            sock = pool.acquire()
+        except OSError as e:
+            # a dead/unreachable server must surface as MXNetError — the
+            # documented failure contract callers catch (a raw
+            # ConnectionRefusedError would blow through `except
+            # MXNetError` handlers and kill the rank with a bare
+            # traceback instead of its abort path)
+            raise MXNetError(
+                "cannot reach parameter server %d at %s:%d for %r: %s"
+                % (server, pool.addr[0], pool.addr[1],
+                   msg.get("op"), e)) from e
         try:
             _send_msg(sock, msg)
             reply = _recv_msg(sock)
-        except BaseException:
+        except OSError as e:
             try:
                 sock.close()  # connection state unknown: don't reuse
             except OSError:
                 pass
+            raise MXNetError(
+                "RPC %r to parameter server %d at %s:%d failed mid-"
+                "round-trip (server died?): %s"
+                % (msg.get("op"), server, pool.addr[0], pool.addr[1],
+                   e)) from e
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
             raise
+        if reply is None:  # clean EOF: the server closed on us
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise MXNetError(
+                "parameter server %d at %s:%d closed the connection "
+                "during RPC %r (server shut down?)"
+                % (server, pool.addr[0], pool.addr[1], msg.get("op")))
         pool.release(sock)
         if isinstance(reply, dict) and "error" in reply:
             raise MXNetError(reply["error"])

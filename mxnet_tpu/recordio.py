@@ -142,6 +142,8 @@ def resolve_uri(uri):
                 "supported; register a fetch hook for remote reads" % host)
         return "/" + path
     hook = _FETCH_HOOKS.get(scheme)
+    if hook is None and scheme in ("http", "https"):
+        hook = http_fetch  # built-in (overridable via register_fetch_hook)
     if hook is None:
         raise MXNetError(
             "no fetch hook registered for %r URIs (register one with "
@@ -153,6 +155,177 @@ def resolve_uri(uri):
             "fetch hook for %r returned %r, which is not an existing "
             "local path" % (scheme, local))
     return local
+
+
+def http_fetch(uri, cache_dir=None, chunk=1 << 20):
+    """Built-in ``http://``/``https://`` fetcher (the dmlc-core
+    filesystem-provider role for plain web storage; the reference's
+    s3/hdfs providers live at `dmlc-core/src/io/` behind
+    `iter_image_recordio.cc:105-126`).
+
+    Streams the object to ``<cache>/<sha1(uri)>-<basename>`` and returns
+    that local path.  A completed download is cached — identical URIs
+    resolve without touching the network again (delete the cache file or
+    set ``MXNET_FETCH_REFRESH=1`` to force a clean re-fetch, stale
+    partials included).  An interrupted download leaves ``<path>.part``;
+    the next fetch CLAIMS it with an atomic rename (so concurrent ranks
+    fetching the same URI can never interleave writes — the rename loser
+    just starts its own fresh download) and resumes via a Range request
+    when the server honors ranges (HTTP 206), restarting from scratch
+    otherwise.  Resume freshness: the partial's server validator
+    (ETag/Last-Modified, parked alongside as ``.part.meta``) is sent as
+    ``If-Range`` so a republished object comes back 200-whole instead of
+    splicing; a resumed download is additionally length-checked against
+    the Content-Range total (covers validator-less servers when the size
+    changed — a same-size republish on a validator-less server is not
+    detectable).  All network failures surface as ``MXNetError`` (the
+    module's fetch contract); a mid-stream failure re-parks the bytes as
+    ``.part`` for the next resume.  The final rename is atomic, so a
+    concurrent reader can never observe a torn file at the returned
+    path.
+    """
+    import hashlib
+
+    cache_dir = cache_dir or os.environ.get(
+        "MXNET_FETCH_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
+                     "fetch"))
+    os.makedirs(cache_dir, exist_ok=True)
+    base = os.path.basename(uri.split("?", 1)[0].rstrip("/")) or "object"
+    path = os.path.join(
+        cache_dir,
+        "%s-%s" % (hashlib.sha1(uri.encode()).hexdigest()[:16], base))
+    part = path + ".part"
+    refresh = os.environ.get("MXNET_FETCH_REFRESH", "0") == "1"
+    if refresh:
+        for stale in (path, part, part + ".meta"):
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
+    elif os.path.exists(path):
+        return path
+    # exclusive work file: claim an existing .part by atomic rename (only
+    # one process can win; losers fall through to a fresh download), else
+    # start fresh under a unique name
+    work = "%s.tmp-%d" % (path, os.getpid())
+    offset = 0
+    validator = None
+    try:
+        os.rename(part, work)
+        offset = os.path.getsize(work)
+        try:
+            with open(part + ".meta") as f:
+                validator = f.read().strip() or None
+            os.remove(part + ".meta")
+        except OSError:
+            pass
+    except OSError:
+        pass
+    try:
+        # Length-checked retry loop: urllib reports a mid-body connection
+        # loss as a quiet short read (read(amt) returns EOF), so
+        # truncation is only detectable against the server's stated
+        # total.  A short file resumes (Range from its end); an
+        # over-long file (stale partial spliced with a republished,
+        # smaller object) is discarded and re-fetched whole.
+        last = None
+        for _ in range(3):
+            total, validator = _http_stream(uri, work, offset, chunk,
+                                            validator)
+            size = os.path.getsize(work)
+            if total is None or size == total:
+                os.replace(work, path)
+                return path
+            last = (size, total)
+            if size > total:
+                os.remove(work)
+                offset = 0
+            else:
+                offset = size
+        raise MXNetError(
+            "http fetch of %r kept arriving truncated (%d of %d bytes "
+            "after retries)" % (uri, last[0], last[1]))
+    except MXNetError:
+        # park whatever arrived (plus its freshness validator) for the
+        # next resume — unless a parked partial already exists: never
+        # clobber another rank's bytes
+        try:
+            if os.path.getsize(work) > 0 and not os.path.exists(part):
+                if validator:
+                    with open(part + ".meta", "w") as f:
+                        f.write(validator)
+                os.rename(work, part)
+            else:
+                os.remove(work)
+        except OSError:
+            pass
+        raise
+    except BaseException:
+        try:
+            os.remove(work)
+        except OSError:
+            pass
+        raise
+
+
+def _http_stream(uri, work, offset, chunk, validator=None):
+    """GET ``uri`` into ``work`` (append from ``offset`` when the server
+    grants the Range, truncate+restart otherwise).  ``validator`` is the
+    partial's ETag/Last-Modified, sent as ``If-Range`` so a server that
+    republished the object since returns 200-whole instead of splicing.
+    Returns (total size or None, response validator or None).  Every
+    network error — connect, HTTP status, or mid-body — raises
+    MXNetError."""
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(uri)
+    if offset:
+        req.add_header("Range", "bytes=%d-" % offset)
+        if validator:
+            req.add_header("If-Range", validator)
+    try:
+        resp = urllib.request.urlopen(req)
+    except urllib.error.HTTPError as e:
+        if offset and e.code == 416:
+            # our offset is past the object's end (stale partial from a
+            # republished, now-smaller object — or a crash after the
+            # final byte; indistinguishable in general, so re-fetch
+            # whole for correctness)
+            return _http_stream(uri, work, 0, chunk)
+        raise MXNetError("http fetch of %r failed: %s" % (uri, e))
+    except urllib.error.URLError as e:
+        raise MXNetError("http fetch of %r failed: %s" % (uri, e))
+    total = None
+    resp_validator = resp.headers.get("ETag") \
+        or resp.headers.get("Last-Modified")
+    try:
+        with resp:
+            if offset and resp.status == 206:
+                rng = resp.headers.get("Content-Range", "")
+                if "/" in rng and rng.split("/")[-1].isdigit():
+                    total = int(rng.split("/")[-1])
+                mode = "ab"
+            else:
+                offset = 0  # server ignored the Range: restart whole
+                length = resp.headers.get("Content-Length")
+                total = int(length) if length and length.isdigit() \
+                    else None
+                mode = "wb"
+            with open(work, mode) as f:
+                while True:
+                    buf = resp.read(chunk)
+                    if not buf:
+                        break
+                    f.write(buf)
+    except (OSError, http.client.HTTPException) as e:
+        raise MXNetError(
+            "http fetch of %r failed mid-stream after %d bytes: %s"
+            % (uri, os.path.getsize(work) if os.path.exists(work) else 0,
+               e)) from e
+    return total, resp_validator
 
 
 class MXRecordIO:

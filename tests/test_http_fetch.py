@@ -1,0 +1,299 @@
+"""Built-in http:// fetch hook for RecordIO remote reads.
+
+The reference served s3://-style URIs through dmlc::InputSplit filesystem
+providers (`/root/reference/src/io/iter_image_recordio.cc:105-126`);
+round 4 shipped the hook plumbing with only file:// built in.  This tests
+the real remote scheme (round-4 verdict task 7): streaming download,
+caching, Range-based resume, and restart against range-less servers —
+all against a stdlib http.server on localhost (no egress).
+"""
+import http.server
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio
+from mxnet_tpu.base import MXNetError
+
+
+class _RangeHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal static file server with optional Range support."""
+
+    ranges = True          # class-level knobs, set per-fixture
+    root = "."
+    log = None             # list collecting (path, range-header)
+
+    def do_GET(self):
+        if self.log is not None:
+            self.log.append((self.path, self.headers.get("Range")))
+        fpath = os.path.join(self.root, self.path.lstrip("/"))
+        if not os.path.isfile(fpath):
+            self.send_error(404)
+            return
+        with open(fpath, "rb") as f:
+            data = f.read()
+        rng = self.headers.get("Range")
+        if rng and self.ranges:
+            start = int(rng.split("=")[1].rstrip("-").split("-")[0])
+            if start >= len(data):
+                self.send_error(416)
+                return
+            body = data[start:]
+            self.send_response(206)
+            self.send_header("Content-Range", "bytes %d-%d/%d"
+                             % (start, len(data) - 1, len(data)))
+        else:
+            body = data
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # keep pytest output clean
+        pass
+
+
+@pytest.fixture
+def http_root(tmp_path, monkeypatch):
+    """Serve tmp_path/ over localhost http; fetch cache also in tmp."""
+    root = tmp_path / "www"
+    root.mkdir()
+    log = []
+    handler = type("H", (_RangeHandler,),
+                   {"root": str(root), "log": log, "ranges": True})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("MXNET_FETCH_CACHE", str(tmp_path / "cache"))
+    try:
+        yield ("http://127.0.0.1:%d" % srv.server_address[1], root, log,
+               handler)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _write_rec(path, n=8):
+    w = recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              b"payload-%03d" % i))
+    w.close()
+
+
+def test_recordio_reads_over_http(http_root):
+    base, root, log, _ = http_root
+    _write_rec(root / "data.rec")
+    r = recordio.MXRecordIO(base + "/data.rec", "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(recordio.unpack(rec))
+    r.close()
+    assert len(got) == 8
+    assert got[3][1] == b"payload-003"
+    assert np.isclose(got[3][0].label, 3.0)
+    # reset() must not re-download (resolve-once contract)
+    n_req = len(log)
+    r = recordio.MXRecordIO(base + "/data.rec", "r")
+    r.reset()
+    assert r.read() is not None
+    r.close()
+    assert len(log) == n_req  # cache hit: no new requests
+
+
+def test_resume_uses_range_and_appends(http_root):
+    base, root, log, _ = http_root
+    blob = bytes(range(256)) * 1024  # 256 KiB
+    (root / "blob.bin").write_bytes(blob)
+    uri = base + "/blob.bin"
+    # simulate an interrupted download: .part holds the first half
+    cache = os.environ["MXNET_FETCH_CACHE"]
+    os.makedirs(cache, exist_ok=True)
+    import hashlib
+
+    part = os.path.join(
+        cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-blob.bin")
+    with open(part + ".part", "wb") as f:
+        f.write(blob[:100_000])
+    local = recordio.http_fetch(uri)
+    with open(local, "rb") as f:
+        assert f.read() == blob
+    (path, rng), = log
+    assert rng == "bytes=100000-"  # resumed, not restarted
+
+
+def test_resume_restarts_when_server_ignores_range(http_root):
+    base, root, log, handler = http_root
+    handler.ranges = False
+    blob = os.urandom(50_000)
+    (root / "b2.bin").write_bytes(blob)
+    uri = base + "/b2.bin"
+    cache = os.environ["MXNET_FETCH_CACHE"]
+    os.makedirs(cache, exist_ok=True)
+    import hashlib
+
+    part = os.path.join(
+        cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-b2.bin")
+    with open(part + ".part", "wb") as f:
+        f.write(b"stale-partial-bytes")
+    local = recordio.http_fetch(uri)
+    with open(local, "rb") as f:
+        assert f.read() == blob  # full restart, stale prefix discarded
+
+
+def test_missing_object_raises_mxnet_error(http_root):
+    base, _, _, _ = http_root
+    with pytest.raises(MXNetError, match="http fetch"):
+        recordio.http_fetch(base + "/no-such-file.rec")
+
+
+def test_registered_hook_overrides_builtin(http_root, tmp_path):
+    base, root, _, _ = http_root
+    _write_rec(root / "d2.rec", n=2)
+    override = tmp_path / "override.rec"
+    _write_rec(override, n=1)
+    prev = recordio.register_fetch_hook("http", lambda uri: str(override))
+    try:
+        assert recordio.resolve_uri(base + "/d2.rec") == str(override)
+    finally:
+        if prev is None:
+            recordio._FETCH_HOOKS.pop("http", None)
+        else:
+            recordio.register_fetch_hook("http", prev)
+
+
+def test_stale_partial_past_end_refetches_whole(http_root):
+    """.part longer than the (republished, smaller) object: the Range
+    request 416s and the fetcher must discard the stale bytes and fetch
+    the whole object — never 'finalize' the stale partial."""
+    base, root, log, _ = http_root
+    blob = os.urandom(1000)
+    (root / "b3.bin").write_bytes(blob)
+    uri = base + "/b3.bin"
+    cache = os.environ["MXNET_FETCH_CACHE"]
+    os.makedirs(cache, exist_ok=True)
+    import hashlib
+
+    stem = os.path.join(
+        cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-b3.bin")
+    with open(stem + ".part", "wb") as f:
+        f.write(os.urandom(5000))  # longer than the current object
+    local = recordio.http_fetch(uri)
+    with open(local, "rb") as f:
+        assert f.read() == blob
+
+
+def test_midstream_failure_is_mxnet_error_and_parks_partial(http_root):
+    """A connection that dies mid-body must surface as MXNetError (the
+    fetch contract) and park the received bytes as .part for resume."""
+    base, root, log, handler = http_root
+    blob = os.urandom(80_000)
+    (root / "b4.bin").write_bytes(blob)
+
+    orig_get = handler.do_GET
+
+    def truncating_get(self):
+        if self.log is not None:
+            self.log.append((self.path, self.headers.get("Range")))
+        fpath = os.path.join(self.root, self.path.lstrip("/"))
+        with open(fpath, "rb") as f:
+            data = f.read()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data[: len(data) // 2])  # die mid-body
+        self.wfile.flush()
+        self.connection.close()
+
+    handler.do_GET = truncating_get
+    uri = base + "/b4.bin"
+    with pytest.raises(MXNetError, match="http fetch"):
+        recordio.http_fetch(uri, chunk=4096)
+    import hashlib
+
+    cache = os.environ["MXNET_FETCH_CACHE"]
+    stem = os.path.join(
+        cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-b4.bin")
+    assert os.path.exists(stem + ".part")  # parked for resume
+    assert 0 < os.path.getsize(stem + ".part") < len(blob)
+    # server recovers: the next fetch resumes and completes
+    handler.do_GET = orig_get
+    local = recordio.http_fetch(uri, chunk=4096)
+    with open(local, "rb") as f:
+        assert f.read() == blob
+
+
+def test_refresh_discards_stale_partial(http_root, monkeypatch):
+    base, root, _, _ = http_root
+    blob = os.urandom(2000)
+    (root / "b5.bin").write_bytes(blob)
+    uri = base + "/b5.bin"
+    cache = os.environ["MXNET_FETCH_CACHE"]
+    os.makedirs(cache, exist_ok=True)
+    import hashlib
+
+    stem = os.path.join(
+        cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-b5.bin")
+    with open(stem + ".part", "wb") as f:
+        f.write(b"old-version-bytes")
+    monkeypatch.setenv("MXNET_FETCH_REFRESH", "1")
+    local = recordio.http_fetch(uri)
+    with open(local, "rb") as f:
+        assert f.read() == blob  # no old/new splice
+
+
+def test_if_range_detects_same_size_republish(http_root):
+    """A same-size republish defeats the length check; the parked
+    validator (.part.meta) sent as If-Range must make the server answer
+    200-whole so the fetcher never splices old and new bytes."""
+    base, root, log, handler = http_root
+    old = os.urandom(40_000)
+    new = os.urandom(40_000)  # same size, different content
+    (root / "b6.bin").write_bytes(new)
+
+    def etag_get(self):
+        if self.log is not None:
+            self.log.append((self.path, self.headers.get("Range")))
+        fpath = os.path.join(self.root, self.path.lstrip("/"))
+        with open(fpath, "rb") as f:
+            data = f.read()
+        import hashlib as _h
+
+        etag = '"%s"' % _h.sha1(data).hexdigest()[:16]
+        rng = self.headers.get("Range")
+        if_range = self.headers.get("If-Range")
+        if rng and (if_range is None or if_range == etag):
+            start = int(rng.split("=")[1].rstrip("-").split("-")[0])
+            body = data[start:]
+            self.send_response(206)
+            self.send_header("Content-Range", "bytes %d-%d/%d"
+                             % (start, len(data) - 1, len(data)))
+        else:
+            body = data  # validator mismatch: whole object
+            self.send_response(200)
+        self.send_header("ETag", etag)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    handler.do_GET = etag_get
+    uri = base + "/b6.bin"
+    cache = os.environ["MXNET_FETCH_CACHE"]
+    os.makedirs(cache, exist_ok=True)
+    import hashlib
+
+    stem = os.path.join(
+        cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-b6.bin")
+    # parked partial of the OLD object, with the old object's validator
+    with open(stem + ".part", "wb") as f:
+        f.write(old[:10_000])
+    with open(stem + ".part.meta", "w") as f:
+        f.write('"%s"' % hashlib.sha1(old).hexdigest()[:16])
+    local = recordio.http_fetch(uri)
+    with open(local, "rb") as f:
+        assert f.read() == new  # no old/new splice

@@ -110,10 +110,12 @@ def main():
         import ctypes
 
         lib = _native.LIB
-        hnd = lib.mxtpu_loader_open_u8(
-            jpg.encode(), 0, 1, batch, 3 * 256 * 256,
-            os.cpu_count() or 1, 4)
-        if hnd:
+
+        def raw_decode_rate(threads):
+            hnd = lib.mxtpu_loader_open_u8(
+                jpg.encode(), 0, 1, batch, 3 * 256 * 256, threads, 4)
+            if not hnd:
+                return None
             dbuf = np.empty((batch, 256, 256, 3), np.uint8)
             lbuf = np.empty((batch,), np.float32)
             t0 = time.time()
@@ -126,8 +128,25 @@ def main():
                 if m <= 0:
                     break
                 got += m
-            out["jpeg_native_raw_decode"] = round(got / (time.time() - t0), 1)
             lib.mxtpu_loader_close(hnd)
+            return round(got / (time.time() - t0), 1)
+
+        # io_cores sweep (round-4 verdict task 4): 1 thread and all-cores
+        # (plus IOBENCH_THREADS override) — on a single-core host the two
+        # coincide and the per-core rate is the scaling story
+        ncores = int(os.environ.get("IOBENCH_THREADS", "0")) \
+            or (os.cpu_count() or 1)
+        r1 = raw_decode_rate(1)
+        if r1 is not None:
+            out["jpeg_native_raw_decode_1thread"] = r1
+        if ncores != 1:
+            rn = raw_decode_rate(ncores)
+            if rn is not None:
+                out["jpeg_native_raw_decode"] = rn
+                out["io_threads"] = ncores
+        elif r1 is not None:
+            out["jpeg_native_raw_decode"] = r1
+            out["io_threads"] = 1
 
         it = mx.io.ImageRecordIter(
             path_imgrec=jpg, data_shape=(3, 256, 256), batch_size=batch,
@@ -148,6 +167,13 @@ def main():
     it = mx.io.ImageRecordIter(path_imgrec=npy, data_shape=(3, 224, 224),
                                batch_size=batch)
     out["npy_native_loader"] = round(_drain(it), 1)
+
+    if os.environ.get("IOBENCH_SKIP_TRAIN", "0") == "1":
+        # decode-only mode: the host-side numbers need no device at all
+        # (round-4 verdict task 4 — the IO number must exist even when
+        # the TPU relay is down)
+        _finish(out)
+        return
 
     # -- overlap: decode thread feeding device train steps ----------------
     # IOBENCH_TRAIN_IMAGE sizes the train model/pack: 224 (resnet18) on a
@@ -196,16 +222,36 @@ def main():
 
     out["serial_train"] = round(run_epoch(False), 1)
     out["overlapped_train"] = round(run_epoch(True), 1)
+    _finish(out)
 
+
+def _finish(out):
     ncores = os.cpu_count() or 1
     out["cores"] = ncores
-    out["jpeg_img_per_sec_per_core"] = round(
-        out["jpeg_read_decode"] / ncores, 1)
     out["jpeg_host_decode_per_core"] = round(
         out["jpeg_host_read_decode"] / ncores, 1)
+    if "jpeg_native_raw_decode" in out:
+        out["jpeg_native_raw_decode_per_core"] = round(
+            out["jpeg_native_raw_decode"] / ncores, 1)
+        best = out["jpeg_native_raw_decode"]
+    else:
+        best = out["jpeg_host_read_decode"]
     # the reference's ~3000 img/s rode OMP decode over many 2015 cores
-    # (~375 img/s/core); per-core host decode is the comparable number
-    out["vs_reference_3000"] = round(out["jpeg_host_read_decode"] / 3000.0, 3)
+    # (~375 img/s/core); per-core decode is the comparable number on
+    # core-starved hosts
+    out["vs_reference_3000"] = round(best / 3000.0, 3)
+    # persist as a replayable artifact so the number lands in the round
+    # record even when the bench capture happens with the relay down
+    try:
+        import bench_store
+
+        bench_store.record(
+            {"metric": "recordio_decode_img_per_sec", "value": best,
+             "unit": "img/s (host decode, %d core(s))" % ncores,
+             "vs_baseline": out["vs_reference_3000"], "extra": dict(out)},
+            kind="io")
+    except Exception as e:  # pragma: no cover
+        print("bench_store.record failed: %s" % e, file=sys.stderr)
     print(json.dumps(out))
 
 
