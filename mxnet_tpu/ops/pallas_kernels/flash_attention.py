@@ -1013,6 +1013,12 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
         raise ValueError("flash_attention expects (B, H, S, D) inputs")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    # Diagnostic pins: the DotProductAttention op builds into the model
+    # with its own block defaults, so an in-model block-size A/B needs an
+    # env override (round-4 isolated kernels measured block 256 ~1.6x
+    # block 128; the in-model winner is measured, not assumed)
+    block_q = int(_os.environ.get("MXNET_FLASH_BLOCK_Q", block_q))
+    block_k = int(_os.environ.get("MXNET_FLASH_BLOCK_K", block_k))
     q_off = jnp.asarray(q_offset, jnp.float32)
     k_off = jnp.asarray(k_offset, jnp.float32)
     out, lse = _flash(q, k, v, q_off, k_off, float(scale), bool(causal),

@@ -230,9 +230,10 @@ def http_fetch(uri, cache_dir=None, chunk=1 << 20):
         # over-long file (stale partial spliced with a republished,
         # smaller object) is discarded and re-fetched whole.
         last = None
+        meta = {"validator": validator}
         for _ in range(3):
             total, validator = _http_stream(uri, work, offset, chunk,
-                                            validator)
+                                            validator, meta_out=meta)
             size = os.path.getsize(work)
             if total is None or size == total:
                 os.replace(work, path)
@@ -252,9 +253,14 @@ def http_fetch(uri, cache_dir=None, chunk=1 << 20):
         # clobber another rank's bytes
         try:
             if os.path.getsize(work) > 0 and not os.path.exists(part):
-                if validator:
+                # meta carries the validator captured from the response
+                # headers even when the body died mid-stream — without
+                # it, the next resume would have no If-Range freshness
+                # check on the common interruption path
+                parked_validator = meta.get("validator")
+                if parked_validator:
                     with open(part + ".meta", "w") as f:
-                        f.write(validator)
+                        f.write(parked_validator)
                 os.rename(work, part)
             else:
                 os.remove(work)
@@ -269,14 +275,16 @@ def http_fetch(uri, cache_dir=None, chunk=1 << 20):
         raise
 
 
-def _http_stream(uri, work, offset, chunk, validator=None):
+def _http_stream(uri, work, offset, chunk, validator=None, meta_out=None):
     """GET ``uri`` into ``work`` (append from ``offset`` when the server
     grants the Range, truncate+restart otherwise).  ``validator`` is the
     partial's ETag/Last-Modified, sent as ``If-Range`` so a server that
     republished the object since returns 200-whole instead of splicing.
-    Returns (total size or None, response validator or None).  Every
-    network error — connect, HTTP status, or mid-body — raises
-    MXNetError."""
+    Returns (total size or None, response validator or None); the
+    response validator is also published into ``meta_out['validator']``
+    as soon as headers arrive, so a mid-body failure still leaves the
+    caller the validator to park beside the partial.  Every network
+    error — connect, HTTP status, or mid-body — raises MXNetError."""
     import http.client
     import urllib.error
     import urllib.request
@@ -294,13 +302,15 @@ def _http_stream(uri, work, offset, chunk, validator=None):
             # republished, now-smaller object — or a crash after the
             # final byte; indistinguishable in general, so re-fetch
             # whole for correctness)
-            return _http_stream(uri, work, 0, chunk)
+            return _http_stream(uri, work, 0, chunk, meta_out=meta_out)
         raise MXNetError("http fetch of %r failed: %s" % (uri, e))
     except urllib.error.URLError as e:
         raise MXNetError("http fetch of %r failed: %s" % (uri, e))
     total = None
     resp_validator = resp.headers.get("ETag") \
         or resp.headers.get("Last-Modified")
+    if meta_out is not None and resp_validator:
+        meta_out["validator"] = resp_validator
     try:
         with resp:
             if offset and resp.status == 206:

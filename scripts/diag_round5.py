@@ -193,16 +193,28 @@ def stage_depth():
 def stage_longctx():
     """S=4096/8192: layouts x block sizes (+ remat via env).  One config
     per process is safest on the relay; LONGCTX_CONFIGS picks a subset."""
-    want = os.environ.get("LONGCTX_CONFIGS", "")
+    # exact-match comma list (substring matching would also run a config
+    # whose tag is a prefix of the requested one — two chip builds in one
+    # process violates the one-config-per-process relay hygiene)
+    want = [t for t in os.environ.get("LONGCTX_CONFIGS", "").split(",")
+            if t.strip()]
     configs = []
     for S, B in ((4096, 8), (8192, 4)):
         for layout in ("hsd", "ds"):
-            configs.append((S, B, layout))
-    for S, B, layout in configs:
-        tag = "S%d_B%d_%s" % (S, B, layout)
-        if want and tag not in want:
+            configs.append((S, B, layout, None))
+    # remat axis: at long S the saved attention residuals dominate HBM —
+    # the 'attn' policy (keep only attention outputs, recompute the rest)
+    # is the candidate lever (docs/env_vars.md MXNET_BACKWARD_MIRROR_*)
+    configs.append((4096, 8, "hsd", "attn"))
+    configs.append((8192, 4, "hsd", "attn"))
+    for S, B, layout, remat in configs:
+        tag = "S%d_B%d_%s%s" % (S, B, layout,
+                                "_remat-%s" % remat if remat else "")
+        if want and tag not in want:  # exact tag match
             continue
         os.environ["MXNET_FLASH_LAYOUT"] = layout
+        if remat:
+            os.environ["MXNET_BACKWARD_MIRROR_POLICY"] = remat
         try:
             tr, dev, tokens = _make_lm_trainer(H=6, S=S, B=B)
             tok_s, dt = _measure_tok_s(tr, dev, tokens, ns=4)
@@ -214,13 +226,15 @@ def stage_longctx():
                 "metric": "longctx_" + tag,
                 "value": round(tok_s / 1e3, 1),
                 "unit": "k tokens/s/chip (mfu=%.3f, L=12 D=768 H=6 "
-                        "S=%d B=%d, %s layout)" % (mfu, S, B, layout),
+                        "S=%d B=%d, %s layout, remat=%s)"
+                        % (mfu, S, B, layout, remat),
                 "vs_baseline": None, "mfu": round(mfu, 4)})
             del tr, dev
         except Exception as e:
             print("longctx %s FAILED: %s" % (tag, str(e)[:200]))
         finally:
             os.environ.pop("MXNET_FLASH_LAYOUT", None)
+            os.environ.pop("MXNET_BACKWARD_MIRROR_POLICY", None)
 
 
 def stage_b64():

@@ -100,3 +100,21 @@ def test_pin_on_rejected_shape_warns_but_honors_pin(tpu_backend,
     with pytest.warns(UserWarning, match="auto-router would reject"):
         # over the VMEM cap: the pin stands but the user is told
         assert fa._pick_impl(q_of(16384, 128), 16384) == "pallas_hsd"
+
+
+def test_block_size_env_override(monkeypatch):
+    """MXNET_FLASH_BLOCK_Q/K pin the in-model block sizes (the
+    DotProductAttention op builds with its own defaults, so the on-chip
+    block A/B rides this env knob)."""
+    captured = {}
+
+    def fake_flash(q, k, v, qo, ko, scale, causal, bq, bk, impl):
+        captured["blocks"] = (bq, bk)
+        return q, jnp.zeros(q.shape[:3], jnp.float32)
+
+    monkeypatch.setattr(fa, "_flash", fake_flash)
+    monkeypatch.setenv("MXNET_FLASH_BLOCK_Q", "512")
+    monkeypatch.setenv("MXNET_FLASH_BLOCK_K", "64")
+    fa.flash_attention(q_of(256, 64), q_of(256, 64), q_of(256, 64),
+                       block_q=128, block_k=128)
+    assert captured["blocks"] == (512, 64)

@@ -204,6 +204,7 @@ def test_midstream_failure_is_mxnet_error_and_parks_partial(http_root):
         with open(fpath, "rb") as f:
             data = f.read()
         self.send_response(200)
+        self.send_header("ETag", '"v1-etag"')
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data[: len(data) // 2])  # die mid-body
@@ -221,6 +222,10 @@ def test_midstream_failure_is_mxnet_error_and_parks_partial(http_root):
         cache, hashlib.sha1(uri.encode()).hexdigest()[:16] + "-b4.bin")
     assert os.path.exists(stem + ".part")  # parked for resume
     assert 0 < os.path.getsize(stem + ".part") < len(blob)
+    # the response validator must be parked too (If-Range freshness on
+    # the next resume — the common interruption path)
+    with open(stem + ".part.meta") as f:
+        assert f.read() == '"v1-etag"'
     # server recovers: the next fetch resumes and completes
     handler.do_GET = orig_get
     local = recordio.http_fetch(uri, chunk=4096)

@@ -139,12 +139,13 @@ def main():
         r1 = raw_decode_rate(1)
         if r1 is not None:
             out["jpeg_native_raw_decode_1thread"] = r1
-        if ncores != 1:
-            rn = raw_decode_rate(ncores)
-            if rn is not None:
-                out["jpeg_native_raw_decode"] = rn
-                out["io_threads"] = ncores
+        rn = raw_decode_rate(ncores) if ncores != 1 else None
+        if rn is not None:
+            out["jpeg_native_raw_decode"] = rn
+            out["io_threads"] = ncores
         elif r1 is not None:
+            # the 1-thread rate is still a valid native measurement; the
+            # headline must not fall back to the slower python decode
             out["jpeg_native_raw_decode"] = r1
             out["io_threads"] = 1
 
@@ -231,8 +232,11 @@ def _finish(out):
     out["jpeg_host_decode_per_core"] = round(
         out["jpeg_host_read_decode"] / ncores, 1)
     if "jpeg_native_raw_decode" in out:
+        # divide by the threads that actually ran the sweep (IOBENCH_THREADS
+        # may differ from the host's core count), not os.cpu_count()
         out["jpeg_native_raw_decode_per_core"] = round(
-            out["jpeg_native_raw_decode"] / ncores, 1)
+            out["jpeg_native_raw_decode"]
+            / out.get("io_threads", ncores), 1)
         best = out["jpeg_native_raw_decode"]
     else:
         best = out["jpeg_host_read_decode"]
