@@ -15,18 +15,20 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
-def _proj(x_flat, name, num_hidden, weight=None, bias=None):
+def _proj(x_flat, name, num_hidden, weight=None, bias=None,
+          use_bias=True):
     kwargs = {}
     if weight is not None:
         kwargs["weight"] = weight
     if bias is not None:
         kwargs["bias"] = bias
     return sym.FullyConnected(data=x_flat, num_hidden=num_hidden,
-                              name=name, **kwargs)
+                              name=name, no_bias=not use_bias, **kwargs)
 
 
 def transformer_block(x, name, seq_len, num_heads, num_embed,
-                      num_ffn_hidden, dropout=0.0, causal=True):
+                      num_ffn_hidden, dropout=0.0, causal=True,
+                      use_bias=True, attn_layout="bhsd"):
     """One pre-LN block.  x: (batch, seq, embed) symbol."""
     head_dim = num_embed // num_heads
 
@@ -34,20 +36,41 @@ def transformer_block(x, name, seq_len, num_heads, num_embed,
     h = sym.LayerNorm(data=x, name=name + "_ln1")
     hf = sym.Reshape(data=h, shape=(-1, num_embed), name=name + "_ln1_flat")
 
-    def heads(role):
-        p = _proj(hf, "%s_%s" % (name, role), num_embed)
-        p = sym.Reshape(data=p, shape=(-1, seq_len, num_heads, head_dim),
-                        name="%s_%s_split" % (name, role))
-        return sym.transpose(p, axes=(0, 2, 1, 3),
-                             name="%s_%s_t" % (name, role))
+    if attn_layout == "bsd":
+        # transposeless path: projections feed the attention op in their
+        # natural (batch, seq, embed) layout; heads are carved on the
+        # lane axis inside the kernel (flash_attention_bsd) — no head
+        # split/merge transposes, no kernel-boundary layout copies
+        def heads(role):
+            p = _proj(hf, "%s_%s" % (name, role), num_embed,
+                      use_bias=use_bias)
+            return sym.Reshape(data=p, shape=(-1, seq_len, num_embed),
+                               name="%s_%s_seq" % (name, role))
 
-    attn = sym.DotProductAttention(
-        query=heads("q"), key=heads("k"), value=heads("v"),
-        causal=causal, name=name + "_attn")
-    attn = sym.transpose(attn, axes=(0, 2, 1, 3), name=name + "_attn_t")
-    attn = sym.Reshape(data=attn, shape=(-1, num_embed),
-                       name=name + "_attn_merge")
-    attn = _proj(attn, name + "_attn_out", num_embed)
+        attn = sym.DotProductAttention(
+            query=heads("q"), key=heads("k"), value=heads("v"),
+            causal=causal, layout="bsd", num_heads=num_heads,
+            name=name + "_attn")
+        attn = sym.Reshape(data=attn, shape=(-1, num_embed),
+                           name=name + "_attn_merge")
+    else:
+        def heads(role):
+            p = _proj(hf, "%s_%s" % (name, role), num_embed,
+                      use_bias=use_bias)
+            p = sym.Reshape(data=p,
+                            shape=(-1, seq_len, num_heads, head_dim),
+                            name="%s_%s_split" % (name, role))
+            return sym.transpose(p, axes=(0, 2, 1, 3),
+                                 name="%s_%s_t" % (name, role))
+
+        attn = sym.DotProductAttention(
+            query=heads("q"), key=heads("k"), value=heads("v"),
+            causal=causal, name=name + "_attn")
+        attn = sym.transpose(attn, axes=(0, 2, 1, 3),
+                             name=name + "_attn_t")
+        attn = sym.Reshape(data=attn, shape=(-1, num_embed),
+                           name=name + "_attn_merge")
+    attn = _proj(attn, name + "_attn_out", num_embed, use_bias=use_bias)
     if dropout > 0.0:
         attn = sym.Dropout(data=attn, p=dropout, name=name + "_attn_drop")
     attn = sym.Reshape(data=attn, shape=(-1, seq_len, num_embed),
@@ -57,9 +80,9 @@ def transformer_block(x, name, seq_len, num_heads, num_embed,
     # --- feed-forward sublayer ---
     h = sym.LayerNorm(data=x, name=name + "_ln2")
     hf = sym.Reshape(data=h, shape=(-1, num_embed), name=name + "_ln2_flat")
-    ffn = _proj(hf, name + "_ffn1", num_ffn_hidden)
+    ffn = _proj(hf, name + "_ffn1", num_ffn_hidden, use_bias=use_bias)
     ffn = sym.Activation(data=ffn, act_type="gelu", name=name + "_gelu")
-    ffn = _proj(ffn, name + "_ffn2", num_embed)
+    ffn = _proj(ffn, name + "_ffn2", num_embed, use_bias=use_bias)
     if dropout > 0.0:
         ffn = sym.Dropout(data=ffn, p=dropout, name=name + "_ffn_drop")
     ffn = sym.Reshape(data=ffn, shape=(-1, seq_len, num_embed),
@@ -69,7 +92,8 @@ def transformer_block(x, name, seq_len, num_heads, num_embed,
 
 def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
                        num_embed=128, num_ffn_hidden=None, dropout=0.0,
-                       causal=True, fused_head=False):
+                       causal=True, fused_head=False, use_bias=True,
+                       attn_layout="bhsd"):
     """Decoder-only LM.  data: (batch, seq) token ids; softmax_label:
     (batch, seq) next-token ids.  Loss rows are position-major like the
     reference's unrolled-LSTM head (`example/rnn/lstm.py:102-104`) is
@@ -80,7 +104,18 @@ def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
     flash-style `FusedSoftmaxCE` head (identical parameter names/shapes and
     gradients; the output becomes per-token NLL instead of the (tokens,
     vocab) probabilities — the training-speed configuration, since the
-    logits never touch HBM)."""
+    logits never touch HBM).
+
+    ``use_bias=False`` drops every projection bias (the TPU-era LM
+    convention, e.g. PaLM): the round-5 glue attribution measured the
+    bias-gradient reductions re-reading every dY tensor at ~12.6 GB of
+    the 133 GB step — the single largest removable traffic source.
+    GPT-2 parity keeps biases (the default).
+
+    ``attn_layout='bsd'`` routes attention through the transposeless
+    (batch, seq, embed) kernels (requires head_dim % 128 == 0 for the
+    Pallas path; other shapes fall back to a head-split jnp path).  The
+    'bhsd' default builds the classic head-split transposes."""
     if num_embed % num_heads != 0:
         raise ValueError("num_embed must be divisible by num_heads")
     if num_ffn_hidden is None:
@@ -98,7 +133,8 @@ def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
     for i in range(num_layers):
         x = transformer_block(x, "layer%d" % i, seq_len, num_heads,
                               num_embed, num_ffn_hidden, dropout=dropout,
-                              causal=causal)
+                              causal=causal, use_bias=use_bias,
+                              attn_layout=attn_layout)
 
     x = sym.LayerNorm(data=x, name="final_ln")
     xf = sym.Reshape(data=x, shape=(-1, num_embed), name="final_flat")
@@ -107,5 +143,6 @@ def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
     if fused_head:
         return sym.FusedSoftmaxCE(data=xf, label=label_flat,
                                   num_hidden=vocab_size, name="pred")
-    logits = sym.FullyConnected(data=xf, num_hidden=vocab_size, name="pred")
+    logits = sym.FullyConnected(data=xf, num_hidden=vocab_size,
+                                name="pred", no_bias=not use_bias)
     return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from .registry import OpDef, Param, register
 from .pallas_kernels import flash_attention
+from .pallas_kernels.flash_attention import flash_attention_bsd
 
 
 class DotProductAttention(OpDef):
@@ -32,6 +33,13 @@ class DotProductAttention(OpDef):
         "scale": Param(float, default=None),
         "block_q": Param(int, default=128),
         "block_k": Param(int, default=128),
+        # 'bhsd': (batch, heads, seq, head_dim) operands (default).
+        # 'bsd': (batch, seq, embed) operands with num_heads — the
+        # transposeless TPU path (flash_attention_bsd): no head
+        # split/merge transposes are ever built and no layout copies
+        # appear at the kernel boundary (round-5 glue attribution).
+        "layout": Param(str, default="bhsd"),
+        "num_heads": Param(int, default=0),
     }
 
     def list_arguments(self, params):
@@ -43,17 +51,32 @@ class DotProductAttention(OpDef):
             k = v
         if v is None and k is not None:
             v = k
-        for name, s in (("query", q), ("key", k), ("value", v)):
-            if s is not None and len(s) != 4:
+        if params["layout"] == "bsd":
+            if params["num_heads"] < 1:
                 raise MXNetError(
-                    "DotProductAttention: %s must be (batch, heads, seq, "
-                    "head_dim), got %s" % (name, s))
+                    "DotProductAttention(layout='bsd') requires num_heads")
+            for name, s in (("query", q), ("key", k), ("value", v)):
+                if s is not None and len(s) != 3:
+                    raise MXNetError(
+                        "DotProductAttention(layout='bsd'): %s must be "
+                        "(batch, seq, embed), got %s" % (name, s))
+                if s is not None and s[-1] % params["num_heads"] != 0:
+                    raise MXNetError(
+                        "DotProductAttention: embed %d not divisible by "
+                        "num_heads %d" % (s[-1], params["num_heads"]))
+        else:
+            for name, s in (("query", q), ("key", k), ("value", v)):
+                if s is not None and len(s) != 4:
+                    raise MXNetError(
+                        "DotProductAttention: %s must be (batch, heads, "
+                        "seq, head_dim), got %s" % (name, s))
         if k is not None and v is not None and k != v:
             raise MXNetError(
                 "DotProductAttention: key %s and value %s must match"
                 % (k, v))
         if q is not None and k is not None and (
-                q[0] != k[0] or q[1] != k[1] or q[3] != k[3]):
+                q[0] != k[0] or q[-1] != k[-1] or
+                (len(q) == 4 and q[1] != k[1])):
             raise MXNetError(
                 "DotProductAttention: query %s and key %s must agree on "
                 "(batch, heads, head_dim)" % (q, k))
@@ -64,13 +87,22 @@ class DotProductAttention(OpDef):
 
     def apply(self, octx, params, inputs, aux):
         q, k, v = inputs
-        out = flash_attention(
-            q, k, v,
-            causal=params["causal"],
-            scale=params["scale"],
-            block_q=params["block_q"],
-            block_k=params["block_k"],
-        )
+        if params["layout"] == "bsd":
+            out = flash_attention_bsd(
+                q, k, v, params["num_heads"],
+                causal=params["causal"],
+                scale=params["scale"],
+                block_q=params["block_q"],
+                block_k=params["block_k"],
+            )
+        else:
+            out = flash_attention(
+                q, k, v,
+                causal=params["causal"],
+                scale=params["scale"],
+                block_q=params["block_q"],
+                block_k=params["block_k"],
+            )
         # tag for MXNET_BACKWARD_MIRROR_POLICY=attn (save attention
         # outputs, rematerialize everything else — executor._mirror_policy)
         from jax.ad_checkpoint import checkpoint_name

@@ -896,6 +896,467 @@ def _flash_bwd_pallas_ds(scale, causal, block_q, block_k, res, grads):
 
 
 # ---------------------------------------------------------------------------
+# bsd-layout kernels: operands stay in the model's natural (B, S, E)
+# activation layout (E = num_heads * head_dim) and each head's lane slice
+# is carved TILE-ALIGNED by the BlockSpec index map (lane offset
+# h * head_dim, which is a 128-multiple when head_dim % 128 == 0).  The
+# round-5 AOT glue attribution measured the (B,S,H,d)<->(B,H,S,d) head
+# transposes plus the layout copies XLA inserts around the hsd custom
+# calls at ~13 GB of the 133 GB TPU-geometry step — in bsd form neither
+# exists: no transpose is ever built, and the kernel operand IS the
+# projection output, so there is no boundary for a relayout to appear at.
+# Same online-softmax recurrence as the hsd family; only the ref slicing
+# differs (heads live on the lane axis of rank-3 refs instead of a
+# dedicated array axis).  head_dim % 128 != 0 (e.g. GPT-2 parity d=64)
+# falls back to the transpose path.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_bsd(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                    scale, causal, block_q, block_k, kv_len):
+    # q_ref: (1, block_q, d); k_ref/v_ref: (1, Skv_p, d) — one head's
+    # tile-aligned lane slice of the (B, S, E) operand
+    qi = pl.program_id(2)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    q = q_ref[0].astype(jnp.float32) * scale              # (bq, d)
+    bq, d = q.shape
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    num_kb = pl.cdiv(kv_len, block_k)
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        hi = (last_q - k_off) // block_k + 1
+        num_kb = jnp.clip(hi, 0, num_kb)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_rel < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.broadcast_to((m + jnp.log(l_safe))[:, None],
+                                     (bq, 128))
+
+
+def _flash_fwd_pallas_bsd(q, k, v, q_off, k_off, scale, causal,
+                          block_q, block_k, num_heads):
+    """q/k/v: (B, S[q|kv], E).  Returns o (B, Sq, E), lse (B, H, Sq)."""
+    b, sq, e = q.shape
+    skv = k.shape[1]
+    d = e // num_heads
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    kernel = functools.partial(
+        _fwd_kernel_bsd, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=skv)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, num_heads, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda i, j, k_, qo, ko: (i, k_, j)),
+            pl.BlockSpec((1, skv_p, d),
+                         lambda i, j, k_, qo, ko: (i, 0, j)),
+            pl.BlockSpec((1, skv_p, d),
+                         lambda i, j, k_, qo, ko: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda i, j, k_, qo, ko: (i, k_, j)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
+            jax.ShapeDtypeStruct((b, num_heads, sq_p, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * num_heads * sq_p * skv_p * d,
+            bytes_accessed=(qp.size + kp.size + vp.size) * qp.dtype.itemsize,
+            transcendentals=b * num_heads * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
+      qp, kp, vp)
+    lse = lse[..., 0]
+    if pad_q:
+        out, lse = out[:, :sq], lse[:, :, :sq]
+    return out, lse
+
+
+def _bwd_dq_kernel_bsd(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                       lse_ref, delta_ref, dq_ref, *, scale, causal,
+                       block_q, block_k, kv_len, q_len):
+    qi = pl.program_id(2)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    q = q_ref[0].astype(jnp.float32)                      # (bq, d)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]                             # (bq,)
+    delta = delta_ref[0, 0, :, 0]
+    bq, d = q.shape
+
+    num_kb = pl.cdiv(kv_len, block_k)
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        hi = (last_q - k_off) // block_k + 1
+        num_kb = jnp.clip(hi, 0, num_kb)
+
+    q_rel = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 0)
+    q_pos = q_off + q_rel
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k.astype(k_ref.dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb, body,
+                           jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_bsd(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                        lse_ref, delta_ref, dk_ref, dv_ref, *, scale,
+                        causal, block_q, block_k, kv_len, q_len):
+    ki = pl.program_id(2)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+    k = k_ref[0].astype(jnp.float32)                      # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    sq_p = q_ref.shape[1]
+    num_qb = sq_p // block_q
+
+    lo = 0
+    if causal:
+        first_k = k_off + ki * block_k
+        lo = jnp.clip((first_k - q_off - block_q + 1 + block_q - 1)
+                      // block_q, 0, num_qb)
+
+    k_rel = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, bk), 1)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_rel = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_off + q_rel >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q.astype(q_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lo, num_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas_bsd(scale, causal, block_q, block_k, num_heads,
+                          res, grads):
+    q, k, v, o, lse, q_off, k_off = res   # (B, S, E) operands
+    g, glse = grads
+    b, sq, e = q.shape
+    skv = k.shape[1]
+    d = e // num_heads
+    block_q = min(block_q, max(sq, 128))
+    block_k = min(block_k, max(skv, 128))
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    dop = jnp.pad(g, ((0, 0), (0, pad_q), (0, 0))) if pad_q else g
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    # delta_i(h) = sum_d dO O - glse, computed per head on the (B, S, E)
+    # arrays (small output; XLA fuses the reduction into the readers)
+    gf = g.astype(jnp.float32).reshape(b, sq, num_heads, d)
+    of = o.astype(jnp.float32).reshape(b, sq, num_heads, d)
+    delta = jnp.einsum("bshd,bshd->bhs", gf, of) \
+        - glse.astype(jnp.float32)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q \
+        else delta
+    lsep = lsep[..., None]
+    deltap = deltap[..., None]
+
+    qo = jnp.asarray([q_off], jnp.int32)
+    ko = jnp.asarray([k_off], jnp.int32)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=skv, q_len=sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_bsd, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, num_heads, sq_p // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, k_, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, skv_p, d),
+                             lambda i, j, k_, qo, ko: (i, 0, j)),
+                pl.BlockSpec((1, skv_p, d),
+                             lambda i, j, k_, qo, ko: (i, 0, j)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, k_, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, qo, ko: (i, j, k_, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j, k_, qo, ko: (i, k_, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * b * num_heads * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * num_heads * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_bsd, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, num_heads, skv_p // block_k),
+            in_specs=[
+                pl.BlockSpec((1, sq_p, d),
+                             lambda i, j, k_, qo, ko: (i, 0, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, sq_p, d),
+                             lambda i, j, k_, qo, ko: (i, 0, j)),
+                pl.BlockSpec((1, 1, sq_p, 1),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+                pl.BlockSpec((1, 1, sq_p, 1),
+                             lambda i, j, k_, qo, ko: (i, j, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qo, ko: (i, k_, j)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, skv_p, e), k.dtype),
+            jax.ShapeDtypeStruct((b, skv_p, e), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * 3),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * b * num_heads * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * num_heads * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    if pad_q:
+        dq = dq[:, :sq]
+    if pad_k:
+        dk, dv = dk[:, :skv], dv[:, :skv]
+    zero_off = (jnp.asarray(q_off, jnp.float32) * 0,
+                jnp.asarray(k_off, jnp.float32) * 0)
+    return (dq, dk, dv) + zero_off
+
+
+def _bsd_to_heads(t, num_heads):
+    b, s, e = t.shape
+    return t.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
+
+
+def _heads_to_bsd(t):
+    b, h, s, d = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def _use_pallas_bsd(q, num_heads, kv_len):
+    e = q.shape[-1]
+    d = e // num_heads
+    if d % 128 != 0:
+        return False  # lane slicing must be tile-aligned
+    if jax.default_backend() != "tpu" and not _INTERPRET:
+        forced = _os.environ.get("MXNET_FLASH_IMPL")
+        if forced not in ("pallas_hsd", "pallas_ds", "pallas_bsd"):
+            return False
+    itemsize = jnp.dtype(q.dtype).itemsize
+    return _HAS_PALLAS and \
+        4 * kv_len * d * itemsize <= 12 * 1024 * 1024
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_bsd(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
+               num_heads, impl):
+    qo = jnp.asarray(q_off, jnp.int32)
+    ko = jnp.asarray(k_off, jnp.int32)
+    if impl == "pallas_bsd":
+        return _flash_fwd_pallas_bsd(q, k, v, qo, ko, scale, causal,
+                                     block_q, block_k, num_heads)
+    out, lse = _flash_fwd_jnp(
+        _bsd_to_heads(q, num_heads), _bsd_to_heads(k, num_heads),
+        _bsd_to_heads(v, num_heads), qo, ko, scale, causal, block_k)
+    return _heads_to_bsd(out), lse
+
+
+def _flash_bsd_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q,
+                        block_k, num_heads, impl):
+    qo = jnp.asarray(q_off, jnp.int32)
+    ko = jnp.asarray(k_off, jnp.int32)
+    out, lse = _flash_bsd(q, k, v, q_off, k_off, scale, causal, block_q,
+                          block_k, num_heads, impl)
+    return (out, lse), (q, k, v, out, lse, qo, ko)
+
+
+def _flash_bsd_bwd_rule(scale, causal, block_q, block_k, num_heads, impl,
+                        res, grads):
+    force_jnp = _os.environ.get("MXNET_FLASH_BWD", "pallas") == "jnp"
+    if impl == "pallas_bsd" and not force_jnp:
+        return _flash_bwd_pallas_bsd(scale, causal, block_q, block_k,
+                                     num_heads, res, grads)
+    q, k, v, o, lse, qo, ko = res
+    res_h = (_bsd_to_heads(q, num_heads), _bsd_to_heads(k, num_heads),
+             _bsd_to_heads(v, num_heads), _bsd_to_heads(o, num_heads),
+             lse, qo, ko)
+    g, glse = grads
+    dq, dk, dv, dqo, dko = _flash_bwd(
+        scale, causal, block_k, res_h, (_bsd_to_heads(g, num_heads), glse))
+    return (_heads_to_bsd(dq), _heads_to_bsd(dk), _heads_to_bsd(dv),
+            dqo, dko)
+
+
+_flash_bsd.defvjp(_flash_bsd_fwd_rule, _flash_bsd_bwd_rule)
+
+
+def flash_attention_bsd(q, k, v, num_heads, *, causal=False, scale=None,
+                        q_offset=0.0, k_offset=0.0, block_q=256,
+                        block_k=256, with_lse=False):
+    """Fused attention over (batch, seq, embed) arrays — the transposeless
+    TPU path (heads live on the lane axis; see the bsd section note).
+
+    Falls back to the blockwise jnp path (via head split/merge) when the
+    per-head width is not lane-aligned or the K/V stream exceeds the VMEM
+    cap.  Returns (out [, lse (batch, num_heads, seq)])."""
+    if q.ndim != 3 or k.ndim != 3 or v.ndim != 3:
+        raise ValueError("flash_attention_bsd expects (B, S, E) inputs")
+    if q.shape[-1] % num_heads != 0:
+        raise ValueError("embed dim %d not divisible by num_heads %d"
+                         % (q.shape[-1], num_heads))
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1] // num_heads)
+    block_q = int(_os.environ.get("MXNET_FLASH_BLOCK_Q", block_q))
+    block_k = int(_os.environ.get("MXNET_FLASH_BLOCK_K", block_k))
+    forced = _os.environ.get("MXNET_FLASH_IMPL")
+    if forced == "pallas_bsd":
+        # honor the pin with the same readable-failure contract as
+        # _pick_impl: never silently hand a pinned A/B run to the jnp
+        # fallback (that would mislabel recorded evidence)
+        if not _HAS_PALLAS:
+            raise RuntimeError(
+                "MXNET_FLASH_IMPL=pallas_bsd but jax.experimental.pallas "
+                "is unavailable in this build")
+        if not _use_pallas_bsd(q, num_heads, k.shape[1]) \
+                or q.shape[1] * k.shape[1] < 512 * 512:
+            import warnings
+
+            warnings.warn(
+                "MXNET_FLASH_IMPL=pallas_bsd pinned, but the auto-router "
+                "would reject this shape/backend (head_dim=%d, S=%dx%d) — "
+                "the pinned kernel may fail to lower or spill"
+                % (q.shape[-1] // num_heads, q.shape[1], k.shape[1]))
+        impl = "pallas_bsd"
+    elif forced == "jnp":
+        impl = "jnp_t"
+    else:
+        impl = "pallas_bsd" if (
+            _use_pallas_bsd(q, num_heads, k.shape[1])
+            and q.shape[1] * k.shape[1] >= 512 * 512) else "jnp_t"
+    q_off = jnp.asarray(q_offset, jnp.float32)
+    k_off = jnp.asarray(k_offset, jnp.float32)
+    out, lse = _flash_bsd(q, k, v, q_off, k_off, float(scale),
+                          bool(causal), int(block_q), int(block_k),
+                          int(num_heads), impl)
+    return (out, lse) if with_lse else out
+
+
+# ---------------------------------------------------------------------------
 # Public entry
 # ---------------------------------------------------------------------------
 

@@ -15,6 +15,7 @@ takes the identical-math jnp path (also the CPU-mesh test path).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,14 @@ _BLOCK_ROWS = 256
 
 
 def _use_pallas(x2d):
+    # MXNET_LN_IMPL pins the choice (pallas/jnp) — needed when AOT-
+    # compiling for a TPU topology from a CPU process, where the backend
+    # check would silently swap the jnp body into the lowered program
+    forced = os.environ.get("MXNET_LN_IMPL")
+    if forced == "pallas":
+        return _HAS_PALLAS and x2d.shape[-1] % 128 == 0
+    if forced == "jnp":
+        return False
     return (_HAS_PALLAS and jax.default_backend() == "tpu"
             and x2d.shape[-1] % 128 == 0)
 

@@ -121,7 +121,7 @@ class SPMDTrainer:
                  momentum=0.9, wd=0.0001, dtype=np.float32,
                  param_sharding=None, optimizer="sgd", beta1=0.9,
                  beta2=0.999, epsilon=1e-8, clip_gradient=None,
-                 adam_v_dtype=None):
+                 adam_v_dtype=None, abstract=False):
         self.symbol = symbol
         self.mesh = mesh
         self.lr, self.momentum, self.wd = lr, momentum, wd
@@ -148,46 +148,64 @@ class SPMDTrainer:
         shape_of = dict(zip(self.arg_names, arg_shapes))
 
         # init params on host (reference initializer protocol), then place
-        # replicated over the mesh (or a custom per-param sharding for TP)
+        # replicated over the mesh (or a custom per-param sharding for TP).
+        # abstract=True skips BOTH: state becomes ShapeDtypeStructs
+        # carrying the shardings, for AOT lowering/compiling the step
+        # against an abstract TPU topology (jax.experimental.topologies)
+        # with no live device — step()/run_steps() are unusable then.
         from ..initializer import Uniform
         from ..ndarray import zeros
 
+        self.abstract = abstract
         initializer = initializer or Uniform(0.07)
         repl = NamedSharding(mesh, P())
+
+        def place(value_or_shape, np_dtype, sh):
+            if abstract:
+                shape = value_or_shape if isinstance(value_or_shape, tuple) \
+                    else value_or_shape.shape
+                return jax.ShapeDtypeStruct(shape, np_dtype, sharding=sh)
+            if isinstance(value_or_shape, tuple):
+                value_or_shape = np.zeros(value_or_shape, np_dtype)
+            return _put_global(value_or_shape, sh)
+
         self._param_sharding = {}
         params = {}
         for n in self.param_names:
-            host = zeros(shape_of[n], dtype=np.float32)
-            initializer(n, host)
             sh = (param_sharding or {}).get(n, repl)
             self._param_sharding[n] = sh
+            if abstract:
+                params[n] = place(tuple(shape_of[n]), np.float32, sh)
+                continue
+            host = zeros(shape_of[n], dtype=np.float32)
+            initializer(n, host)
             params[n] = _put_global(host.data, sh)
         self.params = params
         if self.optimizer == "adam":
             vdt = np.dtype(self._adam_v_dtype) if self._adam_v_dtype \
                 else np.float32
-            self.momenta = {"_t": jnp.zeros((), jnp.float32)}
+            self.momenta = {"_t": place((), np.float32, repl)}
             self.momenta.update({
-                n: (_put_global(np.zeros(v.shape, np.float32),
-                                self._param_sharding[n]),
-                    _put_global(np.zeros(v.shape, vdt),
-                                self._param_sharding[n]))
+                n: (place(tuple(v.shape), np.float32,
+                          self._param_sharding[n]),
+                    place(tuple(v.shape), vdt, self._param_sharding[n]))
                 for n, v in params.items()
             })
         else:
             self.momenta = {
-                n: _put_global(np.zeros(v.shape, np.float32),
-                               self._param_sharding[n])
+                n: place(tuple(v.shape), np.float32,
+                         self._param_sharding[n])
                 for n, v in params.items()
             }
         self.aux = {
-            n: _put_global(np.zeros(s, np.float32), repl)
+            n: place(tuple(s), np.float32, repl)
             for n, s in zip(self.aux_names, aux_shapes)
         }
-        for n in self.aux_names:  # aux init: means 0, vars 1
-            if n.endswith("moving_var"):
-                self.aux[n] = _put_global(
-                    np.ones(self.aux[n].shape, np.float32), repl)
+        if not abstract:
+            for n in self.aux_names:  # aux init: means 0, vars 1
+                if n.endswith("moving_var"):
+                    self.aux[n] = _put_global(
+                        np.ones(self.aux[n].shape, np.float32), repl)
 
         graph_fn, _, _ = _build_graph_fn(symbol)
         # Rematerialization knobs (the reference's tunable mirroring plan,
@@ -307,6 +325,32 @@ class SPMDTrainer:
 
         self._fwd = jax.jit(fwd)
         self._nstep = 0
+
+    def lower_step(self, batch_dtypes=None):
+        """AOT-lower and compile the fused single-step program against
+        this trainer's mesh WITHOUT touching a device (requires
+        ``abstract=True``; the mesh may be built from
+        `jax.experimental.topologies` abstract devices).  Returns the
+        jax ``Compiled`` — `.as_text()` is the optimized target HLO and
+        `.cost_analysis()` the compiler's own FLOP/byte model, which is
+        how the perf campaign attributes traffic with the TPU relay
+        down.  ``batch_dtypes`` overrides per-input dtypes (token ids
+        are int32; default float32)."""
+        if not self.abstract:
+            raise MXNetError("lower_step needs SPMDTrainer(abstract=True)")
+        batch_dtypes = batch_dtypes or {}
+        batch = {
+            n: jax.ShapeDtypeStruct(
+                tuple(self._shape_of[n]),
+                np.dtype(batch_dtypes.get(n, np.float32)),
+                sharding=self._batch_sharding)
+            for n in self.data_names
+        }
+        repl = NamedSharding(self.mesh, P())
+        rng = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
+        lr = jax.ShapeDtypeStruct((), np.float32, sharding=repl)
+        return self._step.lower(self.params, self.momenta, self.aux,
+                                batch, rng, lr).compile()
 
     def shard_batch(self, batch):
         """Host numpy/NDArray dict -> device arrays laid out over the data

@@ -110,6 +110,47 @@ def _run_checks(jax, jnp, fa, fc, verbose):
         check("flash_bwd_ds_%s_dk" % tag, dk_d, dk_j, 3e-2)
         check("flash_bwd_ds_%s_dv" % tag, dv_d, dv_j, 3e-2)
 
+    # ---- bsd-layout kernels (transposeless (B, S, E) path) ------------
+    Hb, Db = 2, 128  # lane-aligned head_dim: the bsd Pallas gate
+    Eb = Hb * Db
+    qb = jnp.asarray(rng.randn(B, S, Eb), jnp.bfloat16)
+    kb = jnp.asarray(rng.randn(B, S, Eb), jnp.bfloat16)
+    vb = jnp.asarray(rng.randn(B, S, Eb), jnp.bfloat16)
+    dob = jnp.asarray(rng.randn(B, S, Eb), jnp.bfloat16)
+    scale_b = 1.0 / math.sqrt(Db)
+
+    def split(t):
+        return t.reshape(B, S, Hb, Db).transpose(0, 2, 1, 3)
+
+    def merge(t):
+        return t.transpose(0, 2, 1, 3).reshape(B, S, Eb)
+
+    for causal in (False, True):
+        tag = "causal" if causal else "full"
+        o_b, lse_b = jax.jit(
+            lambda q, k, v, c=causal: fa._flash_fwd_pallas_bsd(
+                q, k, v, zero, zero, scale_b, c, 128, 128, Hb))(qb, kb, vb)
+        o_j, lse_j = jax.jit(
+            lambda q, k, v, c=causal: fa._flash_fwd_jnp(
+                q, k, v, zero, zero, scale_b, c, 128))(
+            split(qb), split(kb), split(vb))
+        check("flash_fwd_bsd_%s_out" % tag, split(o_b), o_j, 2e-2)
+        check("flash_fwd_bsd_%s_lse" % tag, lse_b, lse_j, 1e-3)
+
+        res_b = (qb, kb, vb, o_b, lse_b, zero, zero)
+        dq_b, dk_b, dv_b = jax.jit(
+            lambda res, grads, c=causal: fa._flash_bwd_pallas_bsd(
+                scale_b, c, 128, 128, Hb, res, grads)[:3])(
+            res_b, (dob, jnp.zeros_like(lse_b)))
+        dq_j, dk_j, dv_j = jax.jit(
+            lambda res, grads, c=causal: fa._flash_bwd(
+                scale_b, c, 128, res, grads)[:3])(
+            (split(qb), split(kb), split(vb), o_j, lse_j, zero, zero),
+            (split(dob), jnp.zeros_like(lse_j)))
+        check("flash_bwd_bsd_%s_dq" % tag, split(dq_b), dq_j, 3e-2)
+        check("flash_bwd_bsd_%s_dk" % tag, split(dk_b), dk_j, 3e-2)
+        check("flash_bwd_bsd_%s_dv" % tag, split(dv_b), dv_j, 3e-2)
+
     # ---- fused softmax-CE: fwd + bwd ----------------------------------
     N, Dm, V = 512, 128, 4096
     x = jnp.asarray(rng.randn(N, Dm) * 0.5, jnp.bfloat16)

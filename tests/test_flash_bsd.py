@@ -1,0 +1,130 @@
+"""Transposeless (batch, seq, embed) attention — the bsd layout path.
+
+The round-5 AOT glue attribution measured the head-split transposes plus
+the layout copies around the hsd kernel boundary at ~13 GB of the 133 GB
+TPU-geometry step; `flash_attention_bsd` / DotProductAttention(layout=
+'bsd') removes both by carving heads on the lane axis inside the kernel.
+These tests pin the math on the CPU mesh (fallback path) and the
+model-level equivalence of the two layouts; the kernel bodies run in
+tests/test_pallas_interpret.py and on-chip via the preflight.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas_kernels import flash_attention_mod as fa
+
+
+def naive_bhsd(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, skv = q.shape[2], k.shape[2]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(skv)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def to_bsd(t):
+    b, h, s, d = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bsd_fallback_matches_naive_with_grads(causal):
+    rng = np.random.RandomState(0)
+    b, h, s, d = 2, 4, 640, 32  # d=32: not lane-aligned -> jnp_t fallback
+    q4 = jnp.asarray(rng.randn(b, h, s, d) * 0.5, jnp.float32)
+    k4 = jnp.asarray(rng.randn(b, h, s, d) * 0.5, jnp.float32)
+    v4 = jnp.asarray(rng.randn(b, h, s, d) * 0.5, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_bsd(q, k, v):
+        out = fa.flash_attention_bsd(q, k, v, h, causal=causal)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_ref(q4, k4, v4):
+        return jnp.sum(naive_bhsd(q4, k4, v4, causal, scale) ** 2)
+
+    out = fa.flash_attention_bsd(to_bsd(q4), to_bsd(k4), to_bsd(v4), h,
+                                 causal=causal)
+    ref = to_bsd(naive_bhsd(q4, k4, v4, causal, scale))
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-4
+
+    g = jax.grad(loss_bsd, argnums=(0, 1, 2))(
+        to_bsd(q4), to_bsd(k4), to_bsd(v4))
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q4, k4, v4)
+    for got, want in zip(g, g_ref):
+        assert float(jnp.max(jnp.abs(got - to_bsd(want)))) < 1e-3
+
+
+def test_bsd_validation_errors():
+    q = jnp.zeros((2, 64, 128))
+    with pytest.raises(ValueError, match="divisible"):
+        fa.flash_attention_bsd(q, q, q, 3)
+    with pytest.raises(ValueError, match="expects"):
+        fa.flash_attention_bsd(jnp.zeros((2, 2, 64, 64)),
+                               jnp.zeros((2, 2, 64, 64)),
+                               jnp.zeros((2, 2, 64, 64)), 2)
+
+
+def test_model_layouts_agree():
+    """The bsd and bhsd transformer builds share parameter names and must
+    produce the same forward outputs from the same parameters."""
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    V, S, B = 256, 64, 4
+    kwargs = dict(vocab_size=V, seq_len=S, num_layers=2, num_heads=2,
+                  num_embed=64)
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randint(0, V, (B, S)).astype(np.int32),
+             "softmax_label": rng.randint(0, V, (B, S)).astype(np.float32)}
+    outs = {}
+    trainers = {}
+    for layout in ("bhsd", "bsd"):
+        net = models.get_transformer_lm(attn_layout=layout, **kwargs)
+        mesh = make_mesh(shape=(1,), axis_names=("data",))
+        trainers[layout] = SPMDTrainer(
+            net, mesh, data_shapes={"data": (B, S),
+                                    "softmax_label": (B, S)},
+            lr=1e-3, optimizer="adam")
+    assert sorted(trainers["bhsd"].params) == \
+        sorted(trainers["bsd"].params)  # same parameterization
+    # the initializer consumes a global RNG stream, so the two builds drew
+    # different values — compare forwards from ONE parameter set
+    trainers["bsd"].params = dict(trainers["bhsd"].params)
+    for layout in ("bhsd", "bsd"):
+        outs[layout] = np.asarray(trainers[layout].forward(batch)[0])
+    assert np.allclose(outs["bhsd"], outs["bsd"], atol=1e-5), \
+        np.abs(outs["bhsd"] - outs["bsd"]).max()
+
+
+def test_model_bsd_trains(tmp_path):
+    """One SPMD step through the bsd path on the CPU mesh: loss finite,
+    params move."""
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    V, S, B = 128, 64, 8
+    net = models.get_transformer_lm(
+        vocab_size=V, seq_len=S, num_layers=2, num_heads=2, num_embed=64,
+        attn_layout="bsd", use_bias=False)
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    tr = SPMDTrainer(net, mesh,
+                     data_shapes={"data": (B, S), "softmax_label": (B, S)},
+                     lr=1e-2, optimizer="adam")
+    rng = np.random.RandomState(1)
+    batch = {"data": rng.randint(0, V, (B, S)).astype(np.int32),
+             "softmax_label": rng.randint(0, V, (B, S)).astype(np.float32)}
+    before = np.asarray(tr.params["layer0_q_weight"]).copy()
+    tr.step(batch)
+    after = np.asarray(tr.params["layer0_q_weight"])
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)  # attention grads flowed
+    # no bias parameters were built
+    assert not any(n.endswith("_bias") for n in tr.params
+                   if n.startswith("layer"))

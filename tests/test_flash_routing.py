@@ -118,3 +118,27 @@ def test_block_size_env_override(monkeypatch):
     fa.flash_attention(q_of(256, 64), q_of(256, 64), q_of(256, 64),
                        block_q=128, block_k=128)
     assert captured["blocks"] == (512, 64)
+
+
+def test_bsd_pin_error_without_pallas(monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_IMPL", "pallas_bsd")
+    monkeypatch.setattr(fa, "_HAS_PALLAS", False)
+    q = jnp.zeros((1, 1024, 256), jnp.bfloat16)
+    with pytest.raises(RuntimeError, match="pallas_bsd"):
+        fa.flash_attention_bsd(q, q, q, 2)
+
+
+def test_bsd_pin_warns_on_rejected_shape(monkeypatch):
+    """head_dim 64 is not lane-aligned: the pin is honored but warned."""
+    monkeypatch.setenv("MXNET_FLASH_IMPL", "pallas_bsd")
+    captured = {}
+
+    def fake(q, k, v, qo, ko, scale, causal, bq, bk, h, impl):
+        captured["impl"] = impl
+        return q, jnp.zeros((q.shape[0], h, q.shape[1]), jnp.float32)
+
+    monkeypatch.setattr(fa, "_flash_bsd", fake)
+    q = jnp.zeros((1, 1024, 256), jnp.bfloat16)
+    with pytest.warns(UserWarning, match="auto-router would reject"):
+        fa.flash_attention_bsd(q, q, q, 4)  # head_dim 64
+    assert captured["impl"] == "pallas_bsd"

@@ -57,9 +57,11 @@ def run():
     if dtype == "bfloat16":
         from mxnet_tpu.base import bfloat16 as dtype
 
+    use_bias = os.environ.get("TBENCH_USE_BIAS", "1") != "0"
+    attn_layout = os.environ.get("TBENCH_ATTN_LAYOUT", "bhsd")
     net = models.get_transformer_lm(
         vocab_size=V, seq_len=S, num_layers=L, num_heads=H, num_embed=D,
-        fused_head=fused)
+        fused_head=fused, use_bias=use_bias, attn_layout=attn_layout)
     n_dev = len(jax.devices())
     n_dev = next(k for k in range(n_dev, 0, -1) if B % k == 0)
     mesh = make_mesh(shape=(n_dev,), axis_names=("data",))
@@ -108,9 +110,10 @@ def run():
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_dev, 1),
         "unit": "tokens/sec/chip (mfu=%.3f, L=%d D=%d H=%d S=%d B=%d, %s, "
-                "%s head, adam_v=%s)"
+                "%s head, adam_v=%s, bias=%s, attn=%s)"
                 % (mfu, L, D, H, S, B, np.dtype(dtype).name,
-                   "fused" if fused else "dense", adam_v or "float32"),
+                   "fused" if fused else "dense", adam_v or "float32",
+                   int(use_bias), attn_layout),
         "vs_baseline": None,
         "mfu": round(mfu, 4),
     }
