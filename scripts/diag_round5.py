@@ -70,7 +70,7 @@ def _store(kind, payload, compile_derived=False):
 
 
 def _make_lm_trainer(H=12, L=12, S=1024, B=32, fused=False, D=768,
-                     V=32768):
+                     V=32768, use_bias=True, attn_layout="bhsd"):
     # DIAG_SMALL=1: tiny shapes so every stage smoke-runs on the CPU mesh
     # (validates the harness itself without the chip).  L is NOT clamped
     # — stage_depth's slope fit needs the depths it asked for (it passes
@@ -85,7 +85,8 @@ def _make_lm_trainer(H=12, L=12, S=1024, B=32, fused=False, D=768,
 
     net = models.get_transformer_lm(vocab_size=V, seq_len=S, num_layers=L,
                                     num_heads=H, num_embed=D,
-                                    fused_head=fused)
+                                    fused_head=fused, use_bias=use_bias,
+                                    attn_layout=attn_layout)
     mesh = make_mesh(shape=(1,), axis_names=("data",))
     tr = SPMDTrainer(net, mesh,
                      data_shapes={"data": (B, S), "softmax_label": (B, S)},
@@ -304,6 +305,43 @@ def stage_glueAB():
                     "baseline not in this run), variants in extra",
             "vs_baseline": None, "extra": {"variants": results}},
                compile_derived=True)
+
+
+def stage_variantsAB():
+    """On-chip tok/s for the glue-fix variants the AOT byte A/B
+    shortlisted (TPU geometry, S=1024 B=32).  One variant per process is
+    safest (VARIANTS_CONFIGS selects); fused_bsd_nobias is the
+    compile-predicted winner (105.8 vs 133.5 GB/step)."""
+    variants = [
+        ("baseline", {}),
+        ("bsd", {"attn_layout": "bsd"}),
+        ("bsd_nobias", {"attn_layout": "bsd", "use_bias": False}),
+        ("fused_head", {"fused": True}),
+        ("fused_bsd", {"attn_layout": "bsd", "fused": True}),
+        ("fused_bsd_nobias", {"attn_layout": "bsd", "fused": True,
+                              "use_bias": False}),
+    ]
+    want = [t for t in os.environ.get("VARIANTS_CONFIGS", "").split(",")
+            if t.strip()]
+    for tag, kw in variants:
+        if want and tag not in want:
+            continue
+        try:
+            tr, dev, tokens = _make_lm_trainer(H=6, **kw)
+            tok_s, dt = _measure_tok_s(tr, dev, tokens)
+            mfu = _lm_flops_token(12, 768, 1024, 32768) * tokens / dt \
+                / PEAK_FLOPS
+            print("variantsAB %s: %.1fk tok/s, %.1f%% MFU (%.0f ms/step)"
+                  % (tag, tok_s / 1e3, mfu * 100, dt * 1e3))
+            _store("variant_" + tag, {
+                "metric": "transformer_variant_" + tag,
+                "value": round(tok_s / 1e3, 1),
+                "unit": "k tokens/s/chip (mfu=%.3f, TPU geom S=1024 B=32, "
+                        "%s)" % (mfu, kw or "baseline"),
+                "vs_baseline": None, "mfu": round(mfu, 4)})
+            del tr, dev
+        except Exception as e:
+            print("variantsAB %s FAILED: %s" % (tag, str(e)[:250]))
 
 
 def stage_depth():

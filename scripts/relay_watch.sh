@@ -56,8 +56,21 @@ timeout -k 60 3000 python bench.py \
     > bench_results/campaign_bench.out 2>&1
 log "bench.py exit $? : $(tail -c 300 bench_results/campaign_bench.out)"
 
-# 2. glue attribution (compile-only, fast) then the measured stages
-for st in glue depth ghostbn b64; do
+# 2. the on-chip variant A/B first (the round's main question: does the
+#    compile-predicted fused_bsd_nobias byte cut translate to time?) —
+#    one variant per process per the relay hygiene rules
+for v in baseline bsd bsd_nobias fused_head fused_bsd fused_bsd_nobias; do
+    wait_quiet
+    log "stage variantsAB $v"
+    DIAG_STAGES=variantsAB VARIANTS_CONFIGS=$v \
+        timeout -k 60 3000 python scripts/diag_round5.py \
+        > "bench_results/campaign_variant_${v}.out" 2>&1
+    log "variantsAB $v exit $?"
+done
+
+# 3. remaining measured stages (glue is compile-only and already runs
+#    without the relay; keep it here for the cost_analysis cross-check)
+for st in depth ghostbn b64; do
     wait_quiet
     log "stage $st"
     DIAG_STAGES=$st timeout -k 60 3000 python scripts/diag_round5.py \
@@ -65,7 +78,7 @@ for st in glue depth ghostbn b64; do
     log "$st exit $?"
 done
 
-# 3. long-context: one config per process (the heaviest builds; round-4
+# 4. long-context: one config per process (the heaviest builds; round-4
 #    crashed the TPU worker building several large trainers in one process)
 for cfg in S4096_B8_hsd S4096_B8_ds S4096_B8_hsd_remat-attn \
            S8192_B4_hsd S8192_B4_ds S8192_B4_hsd_remat-attn; do
