@@ -1238,6 +1238,366 @@ def _flash_bwd_pallas_bsd(scale, causal, block_q, block_k, num_heads,
     return (dq, dk, dv) + zero_off
 
 
+# -- grid-streamed bsd variants (MXNET_FLASH_BSD_KERNEL=stream) ------------
+# Same operand layout as the loop-family bsd kernels above, but K/V
+# (resp. Q/dO) blocks stream through an innermost "arbitrary" grid axis
+# with VMEM scratch accumulators instead of an in-kernel fori_loop over
+# dynamic slices — the structure that measured 3-5x faster in isolation
+# in round 4 (docs/mfu_roofline.md), and that lost in-model only through
+# the hsd boundary copies, which the bsd layout does not have.  The
+# round-5 AOT attribution shows S>=4096 is attention-compute-bound, so
+# kernel-side streaming is the long-context lever; the on-chip
+# variantsAB/longctx stages decide loop vs stream.
+
+
+def _fwd_kernel_bsd_gs(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref,
+                       lse_ref, m_sc, l_sc, acc_sc, *, scale, causal,
+                       block_q, block_k, kv_len):
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    run = True
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        run = k_off + kb * block_k <= last_q
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        bq = q.shape[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_rel < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_off + k_rel)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = m_sc[0]
+        l = l_sc[0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        m_sc[0] = m_new
+        l_sc[0] = l * corr + jnp.sum(p, axis=-1)
+        acc_sc[...] = acc_sc[...] * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, d)
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        l = l_sc[0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            (m_sc[0] + jnp.log(l_safe))[:, None], lse_ref.shape[2:])
+
+
+def _flash_fwd_pallas_bsd_gs(q, k, v, q_off, k_off, scale, causal,
+                             block_q, block_k, num_heads):
+    b, sq, e = q.shape
+    skv = k.shape[1]
+    d = e // num_heads
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    kernel = functools.partial(
+        _fwd_kernel_bsd_gs, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, kv_len=skv)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, num_heads, sq_p // block_q, skv_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda i, j, k_, kb, qo, ko: (i, k_, j)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, k_, kb, qo, ko: (i, kb, j)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, k_, kb, qo, ko: (i, kb, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d),
+                         lambda i, j, k_, kb, qo, ko: (i, k_, j)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda i, j, k_, kb, qo, ko: (i, j, k_, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block_q), jnp.float32),
+            pltpu.VMEM((1, block_q), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
+            jax.ShapeDtypeStruct((b, num_heads, sq_p, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * num_heads * sq_p * skv_p * d,
+            bytes_accessed=(qp.size + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * num_heads * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
+      qp, kp, vp)
+    lse = lse[..., 0]
+    if pad_q:
+        out, lse = out[:, :sq], lse[:, :, :sq]
+    return out, lse
+
+
+def _bwd_dq_kernel_bsd_gs(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, delta_ref, dq_ref, dq_sc, *, scale,
+                          causal, block_q, block_k, kv_len, q_len):
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    run = True
+    if causal:
+        last_q = q_off + (qi + 1) * block_q - 1
+        run = k_off + kb * block_k <= last_q
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)                  # (bq, d)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        bq = q.shape[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_rel = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_rel = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_off + q_rel >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k.astype(k_ref.dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_bsd_gs(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                           lse_ref, delta_ref, dk_ref, dv_ref, dk_sc,
+                           dv_sc, *, scale, causal, block_q, block_k,
+                           kv_len, q_len):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    q_off = qo_ref[0]
+    k_off = ko_ref[0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    run = True
+    if causal:
+        run = q_off + (qi + 1) * block_q - 1 >= k_off + ki * block_k
+
+    @pl.when(run)
+    def _update():
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)                  # (bq, d)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        bk = k.shape[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_rel = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        k_rel = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 1)
+        mask = jnp.logical_and(k_rel < kv_len, q_rel < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, q_off + q_rel >= k_off + k_rel)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q.astype(q_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas_bsd_gs(scale, causal, block_q, block_k, num_heads,
+                             res, grads):
+    q, k, v, o, lse, q_off, k_off = res
+    g, glse = grads
+    b, sq, e = q.shape
+    skv = k.shape[1]
+    d = e // num_heads
+    block_q = min(block_q, max(sq, 128))
+    block_k = min(block_k, max(skv, 128))
+    pad_q = (-sq) % block_q
+    pad_k = (-skv) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    dop = jnp.pad(g, ((0, 0), (0, pad_q), (0, 0))) if pad_q else g
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    sq_p, skv_p = sq + pad_q, skv + pad_k
+
+    gf = g.astype(jnp.float32).reshape(b, sq, num_heads, d)
+    of = o.astype(jnp.float32).reshape(b, sq, num_heads, d)
+    delta = jnp.einsum("bshd,bshd->bhs", gf, of) \
+        - glse.astype(jnp.float32)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q \
+        else delta
+    lsep = lsep[..., None]
+    deltap = deltap[..., None]
+
+    qo = jnp.asarray([q_off], jnp.int32)
+    ko = jnp.asarray([k_off], jnp.int32)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, kv_len=skv, q_len=sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_bsd_gs, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, num_heads, sq_p // block_q, skv_p // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, k_, kb, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, kb, qo, ko: (i, kb, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, kb, qo, ko: (i, kb, j)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, k_, kb, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, kb, qo, ko: (i, j, k_, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, kb, qo, ko: (i, j, k_, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda i, j, k_, kb, qo, ko: (i, k_, j)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * b * num_heads * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * num_heads * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_bsd_gs, **common),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, num_heads, skv_p // block_k, sq_p // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, k_, qb, qo, ko: (i, qb, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qb, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qb, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, block_q, d),
+                             lambda i, j, k_, qb, qo, ko: (i, qb, j)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, qb, qo, ko: (i, j, qb, 0)),
+                pl.BlockSpec((1, 1, block_q, 1),
+                             lambda i, j, k_, qb, qo, ko: (i, j, qb, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qb, qo, ko: (i, k_, j)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda i, j, k_, qb, qo, ko: (i, k_, j)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, skv_p, e), k.dtype),
+            jax.ShapeDtypeStruct((b, skv_p, e), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * b * num_heads * sq_p * skv_p * d,
+            bytes_accessed=(qp.size * 2 + kp.size + vp.size)
+            * qp.dtype.itemsize,
+            transcendentals=b * num_heads * sq_p * skv_p,
+        ),
+        interpret=_INTERPRET,
+    )(qo, ko, qp, kp, vp, dop, lsep, deltap)
+
+    if pad_q:
+        dq = dq[:, :sq]
+    if pad_k:
+        dk, dv = dk[:, :skv], dv[:, :skv]
+    zero_off = (jnp.asarray(q_off, jnp.float32) * 0,
+                jnp.asarray(k_off, jnp.float32) * 0)
+    return (dq, dk, dv) + zero_off
+
+
 def _bsd_to_heads(t, num_heads):
     b, s, e = t.shape
     return t.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
@@ -1257,9 +1617,27 @@ def _use_pallas_bsd(q, num_heads, kv_len):
         forced = _os.environ.get("MXNET_FLASH_IMPL")
         if forced not in ("pallas_hsd", "pallas_ds", "pallas_bsd"):
             return False
+    if not _HAS_PALLAS:
+        return False
+    if _os.environ.get("MXNET_FLASH_BSD_KERNEL", "loop") == "stream":
+        # the grid-streamed kernels hold only (block, d) tiles in VMEM —
+        # the whole-K/V residency cap below does not apply (they exist
+        # precisely for the contexts that exceed it)
+        return True
     itemsize = jnp.dtype(q.dtype).itemsize
-    return _HAS_PALLAS and \
-        4 * kv_len * d * itemsize <= 12 * 1024 * 1024
+    return 4 * kv_len * d * itemsize <= 12 * 1024 * 1024
+
+
+def _bsd_fwd_dispatch(q, k, v, qo, ko, scale, causal, block_q, block_k,
+                      num_heads):
+    # MXNET_FLASH_BSD_KERNEL selects the kernel structure: 'loop'
+    # (in-kernel fori over K/V slices) vs 'stream' (grid-streamed with
+    # scratch accumulators) — the long-context A/B knob
+    if _os.environ.get("MXNET_FLASH_BSD_KERNEL", "loop") == "stream":
+        return _flash_fwd_pallas_bsd_gs(q, k, v, qo, ko, scale, causal,
+                                        block_q, block_k, num_heads)
+    return _flash_fwd_pallas_bsd(q, k, v, qo, ko, scale, causal,
+                                 block_q, block_k, num_heads)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
@@ -1268,8 +1646,8 @@ def _flash_bsd(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
     qo = jnp.asarray(q_off, jnp.int32)
     ko = jnp.asarray(k_off, jnp.int32)
     if impl == "pallas_bsd":
-        return _flash_fwd_pallas_bsd(q, k, v, qo, ko, scale, causal,
-                                     block_q, block_k, num_heads)
+        return _bsd_fwd_dispatch(q, k, v, qo, ko, scale, causal,
+                                 block_q, block_k, num_heads)
     out, lse = _flash_fwd_jnp(
         _bsd_to_heads(q, num_heads), _bsd_to_heads(k, num_heads),
         _bsd_to_heads(v, num_heads), qo, ko, scale, causal, block_k)
@@ -1289,6 +1667,10 @@ def _flash_bsd_bwd_rule(scale, causal, block_q, block_k, num_heads, impl,
                         res, grads):
     force_jnp = _os.environ.get("MXNET_FLASH_BWD", "pallas") == "jnp"
     if impl == "pallas_bsd" and not force_jnp:
+        if _os.environ.get("MXNET_FLASH_BSD_KERNEL", "loop") == "stream":
+            return _flash_bwd_pallas_bsd_gs(scale, causal, block_q,
+                                            block_k, num_heads, res,
+                                            grads)
         return _flash_bwd_pallas_bsd(scale, causal, block_q, block_k,
                                      num_heads, res, grads)
     q, k, v, o, lse, qo, ko = res

@@ -320,12 +320,21 @@ def stage_variantsAB():
         ("fused_bsd", {"attn_layout": "bsd", "fused": True}),
         ("fused_bsd_nobias", {"attn_layout": "bsd", "fused": True,
                               "use_bias": False}),
+        ("fused_bsd_nobias_stream", {"attn_layout": "bsd", "fused": True,
+                                     "use_bias": False,
+                                     "bsd_kernel": "stream"}),
     ]
     want = [t for t in os.environ.get("VARIANTS_CONFIGS", "").split(",")
             if t.strip()]
     for tag, kw in variants:
         if want and tag not in want:
             continue
+        kw = dict(kw)
+        bsd_kernel = kw.pop("bsd_kernel", "loop")
+        saved_bk = os.environ.get("MXNET_FLASH_BSD_KERNEL")
+        # pin explicitly either way (and restore after): an exported
+        # stream pin must not leak into the loop-tagged variants
+        os.environ["MXNET_FLASH_BSD_KERNEL"] = bsd_kernel
         try:
             tr, dev, tokens = _make_lm_trainer(H=6, **kw)
             tok_s, dt = _measure_tok_s(tr, dev, tokens)
@@ -342,6 +351,11 @@ def stage_variantsAB():
             del tr, dev
         except Exception as e:
             print("variantsAB %s FAILED: %s" % (tag, str(e)[:250]))
+        finally:
+            if saved_bk is None:
+                os.environ.pop("MXNET_FLASH_BSD_KERNEL", None)
+            else:
+                os.environ["MXNET_FLASH_BSD_KERNEL"] = saved_bk
 
 
 def stage_depth():
@@ -377,23 +391,28 @@ def stage_longctx():
             if t.strip()]
     configs = []
     for S, B in ((4096, 8), (8192, 4)):
-        for layout in ("hsd", "ds"):
-            configs.append((S, B, layout, None))
-    # remat axis: at long S the saved attention residuals dominate HBM —
-    # the 'attn' policy (keep only attention outputs, recompute the rest)
-    # is the candidate lever (docs/env_vars.md MXNET_BACKWARD_MIRROR_*)
-    configs.append((4096, 8, "hsd", "attn"))
-    configs.append((8192, 4, "hsd", "attn"))
-    for S, B, layout, remat in configs:
-        tag = "S%d_B%d_%s%s" % (S, B, layout,
-                                "_remat-%s" % remat if remat else "")
+        # kernel-layout axis: the hsd default, the unpadded-residual dS
+        # opt-in, and the transposeless bsd family (loop and streamed —
+        # the AOT attribution shows long S is attention-compute-bound,
+        # so the kernel structure is the lever)
+        configs.append((S, B, "hsd", {}, {}))
+        configs.append((S, B, "ds", {"MXNET_FLASH_LAYOUT": "ds"}, {}))
+        configs.append((S, B, "bsd", {}, {"attn_layout": "bsd"}))
+        configs.append((S, B, "bsdstream",
+                        {"MXNET_FLASH_BSD_KERNEL": "stream"},
+                        {"attn_layout": "bsd"}))
+        # remat axis: saved-residual traffic at long S (attn policy keeps
+        # only attention outputs; docs/env_vars.md MXNET_BACKWARD_MIRROR_*)
+        configs.append((S, B, "hsd_remat-attn",
+                        {"MXNET_BACKWARD_MIRROR_POLICY": "attn"}, {}))
+    for S, B, name, env, mkw in configs:
+        tag = "S%d_B%d_%s" % (S, B, name)
         if want and tag not in want:  # exact tag match
             continue
-        os.environ["MXNET_FLASH_LAYOUT"] = layout
-        if remat:
-            os.environ["MXNET_BACKWARD_MIRROR_POLICY"] = remat
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
         try:
-            tr, dev, tokens = _make_lm_trainer(H=6, S=S, B=B)
+            tr, dev, tokens = _make_lm_trainer(H=6, S=S, B=B, **mkw)
             tok_s, dt = _measure_tok_s(tr, dev, tokens, ns=4)
             mfu = _lm_flops_token(12, 768, S, 32768) * tokens / dt \
                 / PEAK_FLOPS
@@ -403,15 +422,18 @@ def stage_longctx():
                 "metric": "longctx_" + tag,
                 "value": round(tok_s / 1e3, 1),
                 "unit": "k tokens/s/chip (mfu=%.3f, L=12 D=768 H=6 "
-                        "S=%d B=%d, %s layout, remat=%s)"
-                        % (mfu, S, B, layout, remat),
+                        "S=%d B=%d, %s, env=%s)"
+                        % (mfu, S, B, name, env),
                 "vs_baseline": None, "mfu": round(mfu, 4)})
             del tr, dev
         except Exception as e:
             print("longctx %s FAILED: %s" % (tag, str(e)[:200]))
         finally:
-            os.environ.pop("MXNET_FLASH_LAYOUT", None)
-            os.environ.pop("MXNET_BACKWARD_MIRROR_POLICY", None)
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
 
 
 def stage_b64():

@@ -151,6 +151,32 @@ def _run_checks(jax, jnp, fa, fc, verbose):
         check("flash_bwd_bsd_%s_dk" % tag, split(dk_b), dk_j, 3e-2)
         check("flash_bwd_bsd_%s_dv" % tag, split(dv_b), dv_j, 3e-2)
 
+    # ---- grid-streamed bsd variants (MXNET_FLASH_BSD_KERNEL=stream) ---
+    for causal in (False, True):
+        tag = ("causal" if causal else "full") + "_gs"
+        o_g, lse_g = jax.jit(
+            lambda q, k, v, c=causal: fa._flash_fwd_pallas_bsd_gs(
+                q, k, v, zero, zero, scale_b, c, 128, 128, Hb))(qb, kb, vb)
+        o_j, lse_j = jax.jit(
+            lambda q, k, v, c=causal: fa._flash_fwd_jnp(
+                q, k, v, zero, zero, scale_b, c, 128))(
+            split(qb), split(kb), split(vb))
+        check("flash_fwd_bsd_%s_out" % tag, split(o_g), o_j, 2e-2)
+        check("flash_fwd_bsd_%s_lse" % tag, lse_g, lse_j, 1e-3)
+        dq_g, dk_g, dv_g = jax.jit(
+            lambda res, grads, c=causal: fa._flash_bwd_pallas_bsd_gs(
+                scale_b, c, 128, 128, Hb, res, grads)[:3])(
+            (qb, kb, vb, o_g, lse_g, zero, zero),
+            (dob, jnp.zeros_like(lse_g)))
+        dq_j, dk_j, dv_j = jax.jit(
+            lambda res, grads, c=causal: fa._flash_bwd(
+                scale_b, c, 128, res, grads)[:3])(
+            (split(qb), split(kb), split(vb), o_j, lse_j, zero, zero),
+            (split(dob), jnp.zeros_like(lse_j)))
+        check("flash_bwd_bsd_%s_dq" % tag, split(dq_g), dq_j, 3e-2)
+        check("flash_bwd_bsd_%s_dk" % tag, split(dk_g), dk_j, 3e-2)
+        check("flash_bwd_bsd_%s_dv" % tag, split(dv_g), dv_j, 3e-2)
+
     # ---- fused softmax-CE: fwd + bwd ----------------------------------
     N, Dm, V = 512, 128, 4096
     x = jnp.asarray(rng.randn(N, Dm) * 0.5, jnp.bfloat16)

@@ -59,7 +59,8 @@ log "bench.py exit $? : $(tail -c 300 bench_results/campaign_bench.out)"
 # 2. the on-chip variant A/B first (the round's main question: does the
 #    compile-predicted fused_bsd_nobias byte cut translate to time?) —
 #    one variant per process per the relay hygiene rules
-for v in baseline bsd bsd_nobias fused_head fused_bsd fused_bsd_nobias; do
+for v in baseline bsd bsd_nobias fused_head fused_bsd fused_bsd_nobias \
+         fused_bsd_nobias_stream; do
     wait_quiet
     log "stage variantsAB $v"
     DIAG_STAGES=variantsAB VARIANTS_CONFIGS=$v \
@@ -80,8 +81,9 @@ done
 
 # 4. long-context: one config per process (the heaviest builds; round-4
 #    crashed the TPU worker building several large trainers in one process)
-for cfg in S4096_B8_hsd S4096_B8_ds S4096_B8_hsd_remat-attn \
-           S8192_B4_hsd S8192_B4_ds S8192_B4_hsd_remat-attn; do
+for cfg in S4096_B8_hsd S4096_B8_bsd S4096_B8_bsdstream S4096_B8_ds \
+           S4096_B8_hsd_remat-attn S8192_B4_hsd S8192_B4_bsd \
+           S8192_B4_bsdstream S8192_B4_ds S8192_B4_hsd_remat-attn; do
     wait_quiet
     log "stage longctx $cfg"
     DIAG_STAGES=longctx LONGCTX_CONFIGS=$cfg \

@@ -174,3 +174,46 @@ def test_flash_bsd_kernels_match_jnp(interpret, causal, sq, skv):
                            (dv_b, dv_j, "dv")):
         got4 = got.reshape(b, -1, h, d).transpose(0, 2, 1, 3)
         assert _maxerr(got4, want) < 1e-4, tag
+
+
+@pytest.mark.parametrize("causal,sq,skv", [(True, 256, 256),
+                                           (False, 256, 384)])
+def test_flash_bsd_grid_streamed_kernels_match_jnp(interpret, causal, sq,
+                                                   skv):
+    """MXNET_FLASH_BSD_KERNEL=stream: the grid-streamed bsd variants
+    (scratch accumulators over an arbitrary K/Q grid axis) against the
+    jnp reference."""
+    rng = np.random.RandomState(4)
+    b, h, d = 2, 2, 128
+    e = h * d
+    scale = 1.0 / np.sqrt(d)
+    zero = jnp.asarray(0, jnp.int32)
+    q = jnp.asarray(rng.randn(b, sq, e) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.randn(b, skv, e) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.randn(b, skv, e) * 0.5, jnp.float32)
+    q4, k4, v4 = (t.reshape(t.shape[0], t.shape[1], h, d).transpose(
+        0, 2, 1, 3) for t in (q, k, v))
+    o_j, lse_j = jax.jit(lambda q, k, v: fa._flash_fwd_jnp(
+        q, k, v, zero, zero, scale, causal, 128))(q4, k4, v4)
+
+    o_b, lse_b = jax.jit(lambda q, k, v: fa._flash_fwd_pallas_bsd_gs(
+        q, k, v, zero, zero, scale, causal, 128, 128, h))(q, k, v)
+    o_b4 = o_b.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
+    assert _maxerr(o_b4, o_j) < 1e-5
+    assert _maxerr(lse_b, lse_j) < 1e-5
+
+    do = jnp.asarray(rng.randn(b, sq, e), jnp.float32)
+    do4 = do.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
+    res_b = (q, k, v, o_b, lse_b, zero, zero)
+    dq_b, dk_b, dv_b = jax.jit(
+        lambda res, g: fa._flash_bwd_pallas_bsd_gs(
+            scale, causal, 128, 128, h, res, g)[:3])(
+        res_b, (do, jnp.zeros_like(lse_b)))
+    res_j = (q4, k4, v4, o_j, lse_j, zero, zero)
+    dq_j, dk_j, dv_j = jax.jit(
+        lambda res, g: fa._flash_bwd(scale, causal, 128, res, g)[:3])(
+        res_j, (do4, jnp.zeros_like(lse_j)))
+    for got, want, tag in ((dq_b, dq_j, "dq"), (dk_b, dk_j, "dk"),
+                           (dv_b, dv_j, "dv")):
+        got4 = got.reshape(b, -1, h, d).transpose(0, 2, 1, 3)
+        assert _maxerr(got4, want) < 1e-4, tag
