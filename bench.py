@@ -310,13 +310,14 @@ def _transformer_metrics():
     """Small-steps transformer-LM training throughput (tokens/s/chip +
     MFU) via tools/benchmark_transformer.py's accounting, in-process.
 
-    Two configs per round: the reference-parity GPT-2-small shape
-    (12 heads, head_dim 64) and the TPU-geometry variant (6 heads,
-    head_dim 128 — identical parameter count and FLOPs, but the head dim
-    fills the 128-lane MXU/VPU width; measured 116.4k tok/s / 42.4% MFU
-    vs 77.6k / 28.3% in round 4).  BENCH_TRANSFORMER_FUSED=1 adds the
-    FusedSoftmaxCE head (measured ~= dense at this shape; kept for the
-    capacity story)."""
+    Up to four configs per round: the reference-parity GPT-2-small shape
+    (12 heads, head_dim 64); the TPU-geometry variant (6 heads, head_dim
+    128 — identical parameter count and FLOPs, but the head dim fills
+    the 128-lane MXU/VPU width; measured 116.4k tok/s / 42.4% MFU vs
+    77.6k / 28.3% in round 4); the round-5 candidate `tpu_geom_fast_`
+    (TPU geometry + bsd transposeless attention + fused CE head + no
+    biases — ADR-11); and, with BENCH_TRANSFORMER_FUSED=1, the plain
+    FusedSoftmaxCE head at the parity shape."""
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(here, "tools"))
     import benchmark_transformer
@@ -329,25 +330,49 @@ def _transformer_metrics():
     out = {}
     base_heads = os.environ.get("TBENCH_HEADS")
     embed = int(os.environ.get("TBENCH_EMBED", "768"))
-    configs = [("", "0", base_heads)]
+    # each config: (record prefix, env overrides)
+    configs = [("", {"TBENCH_FUSED_HEAD": "0"})]
     # TPU geometry: head_dim 128 (same embed width, fewer heads) — only
     # meaningful when the embed divides into 128-wide heads and the
     # result differs from the parity config
     geom_heads = embed // 128
     parity_heads = base_heads or str(benchmark_transformer.DEFAULT_HEADS)
-    if geom_heads >= 1 and embed % 128 == 0 and \
-            str(geom_heads) != parity_heads:
-        configs.append(("tpu_geom_", "0", str(geom_heads)))
+    if geom_heads >= 1 and embed % 128 == 0:
+        if str(geom_heads) != parity_heads:
+            configs.append(("tpu_geom_",
+                            {"TBENCH_FUSED_HEAD": "0",
+                             "TBENCH_HEADS": str(geom_heads)}))
+        # the round-5 glue-campaign configuration: transposeless bsd
+        # attention + fused CE head + no biases (compile-measured 105.8
+        # vs 133.5 GB/step at this geometry, docs/mfu_roofline.md) —
+        # recorded alongside, NOT replacing, the reference-parity and
+        # plain TPU-geometry numbers.  Not inside the heads-differ
+        # dedupe: it differs from the parity config regardless (fused /
+        # bsd / no-bias), so it must record even when TBENCH_HEADS is
+        # pinned to the TPU geometry.
+        configs.append(("tpu_geom_fast_", {
+            "TBENCH_FUSED_HEAD": "1",
+            "TBENCH_HEADS": str(geom_heads),
+            "TBENCH_ATTN_LAYOUT": "bsd",
+            "TBENCH_USE_BIAS": "0"}))
     if os.environ.get("BENCH_TRANSFORMER_FUSED", "0") not in ("0", "false"):
-        configs.append(("fused_", "1", base_heads))
-    base_fused = os.environ.get("TBENCH_FUSED_HEAD")
-    try:
-        for prefix, fused, heads in configs:
-            os.environ["TBENCH_FUSED_HEAD"] = fused
-            if heads is None:
-                os.environ.pop("TBENCH_HEADS", None)
+        configs.append(("fused_", {"TBENCH_FUSED_HEAD": "1"}))
+    touched = ("TBENCH_HEADS", "TBENCH_FUSED_HEAD", "TBENCH_ATTN_LAYOUT",
+               "TBENCH_USE_BIAS")
+    saved = {name: os.environ.get(name) for name in touched}
+
+    def apply_env(overrides):
+        # each knob: the config's override, else the caller's original
+        for name in touched:
+            val = overrides.get(name, saved[name])
+            if val is None:
+                os.environ.pop(name, None)
             else:
-                os.environ["TBENCH_HEADS"] = heads
+                os.environ[name] = val
+
+    try:
+        for prefix, env in configs:
+            apply_env(env)
             try:
                 data = _run_with_oom_retry(benchmark_transformer.run)
             except Exception as e:
@@ -362,12 +387,7 @@ def _transformer_metrics():
                 "transformer_lm_%sconfig" % prefix: data["unit"],
             })
     finally:
-        for name, old in (("TBENCH_HEADS", base_heads),
-                          ("TBENCH_FUSED_HEAD", base_fused)):
-            if old is None:
-                os.environ.pop(name, None)
-            else:
-                os.environ[name] = old
+        apply_env({})
     return out
 
 
