@@ -123,11 +123,13 @@ def main():
         num_classes=1000, num_layers=50,
         # standard floor-mode ResNet geometry (56/28/14/7 stages): the
         # reference's ceil-mode default inflates every stage to 57/29/15/8,
-        # ~17% wasted FLOPs + HBM traffic on TPU-hostile shapes
-        pooling_convention=os.environ.get("BENCH_POOLCONV", "valid"),
-        # BENCH_GHOST_BN=32: per-sub-batch BN statistics (the roofline
-        # ceiling-breaker experiment; changes numerics, off by default)
-        ghost_batch=int(os.environ.get("BENCH_GHOST_BN", "0")))
+        # ~17% wasted FLOPs + HBM traffic on TPU-hostile shapes.
+        # (Ghost BN as a perf experiment was REVERTED in round 5: AOT
+        # byte A/B measured ghost=32 at 96.9 GB/step vs 59.0 dense on
+        # this HBM-bound net — the sub-batch reshape breaks the BN-stat
+        # fusions.  The BatchNorm ghost_batch param itself remains as a
+        # numerics feature.)
+        pooling_convention=os.environ.get("BENCH_POOLCONV", "valid"))
     # use the largest device count that divides the batch (a 4-image debug
     # batch on the 8-device CPU mesh must not fault)
     n_avail = len(jax.devices())

@@ -1022,6 +1022,19 @@ def _flash_fwd_pallas_bsd(q, k, v, q_off, k_off, scale, causal,
     return out, lse
 
 
+
+def _delta_bhs(g, o, glse, b, sq, num_heads, d):
+    """delta_i(h) = sum_d dO*O - glse on (B, S, E) operands.  Reshape
+    first (a bitcast), cast INSIDE the einsum via the f32 accumulator —
+    an astype before the reduce would materialize a full f32 copy of dO
+    and O (~100 MB each per call at bench shape)."""
+    gf = g.reshape(b, sq, num_heads, d)
+    of = o.reshape(b, sq, num_heads, d)
+    return jnp.einsum("bshd,bshd->bhs", gf, of,
+                      preferred_element_type=jnp.float32) \
+        - glse.astype(jnp.float32)
+
+
 def _bwd_dq_kernel_bsd(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
                        lse_ref, delta_ref, dq_ref, *, scale, causal,
                        block_q, block_k, kv_len, q_len):
@@ -1139,10 +1152,7 @@ def _flash_bwd_pallas_bsd(scale, causal, block_q, block_k, num_heads,
 
     # delta_i(h) = sum_d dO O - glse, computed per head on the (B, S, E)
     # arrays (small output; XLA fuses the reduction into the readers)
-    gf = g.astype(jnp.float32).reshape(b, sq, num_heads, d)
-    of = o.astype(jnp.float32).reshape(b, sq, num_heads, d)
-    delta = jnp.einsum("bshd,bshd->bhs", gf, of) \
-        - glse.astype(jnp.float32)
+    delta = _delta_bhs(g, o, glse, b, sq, num_heads, d)
     lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
     deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q \
         else delta
@@ -1492,10 +1502,7 @@ def _flash_bwd_pallas_bsd_gs(scale, causal, block_q, block_k, num_heads,
     vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
     sq_p, skv_p = sq + pad_q, skv + pad_k
 
-    gf = g.astype(jnp.float32).reshape(b, sq, num_heads, d)
-    of = o.astype(jnp.float32).reshape(b, sq, num_heads, d)
-    delta = jnp.einsum("bshd,bshd->bhs", gf, of) \
-        - glse.astype(jnp.float32)
+    delta = _delta_bhs(g, o, glse, b, sq, num_heads, d)
     lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q))) if pad_q else lse
     deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q))) if pad_q \
         else delta

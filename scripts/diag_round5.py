@@ -1,5 +1,7 @@
 #!/usr/bin/env python
-"""Round-5 performance campaign driver (round-4 verdict tasks 1, 3, 5, 6).
+"""Round-5 performance campaign driver (round-4 verdict tasks 1, 3, 6;
+task 5 — ghost BN — was resolved by the AOT byte A/B recorded in
+docs/mfu_roofline.md and needs no stage here).
 
 Stages (DIAG_STAGES=comma-list; each stage is chip-resident and should run
 in its OWN process under `timeout` — see the axon relay hygiene notes in
@@ -18,7 +20,6 @@ processes):
              cliff (42.4% -> 16.0%) per-component story.
   b64      — capacity preset A/B: dense-hsd b32 vs fused+ds b64 (the two
              knobs that remove the 2.1 GB logits + padded residuals).
-  ghostbn  — MXNET_GHOST_BN on the ResNet bench shape: keep or revert.
 
 Results print as text AND persist via tools/bench_store.record(kind=...)
 so the round's scoreboard survives a later relay-down capture.
@@ -461,49 +462,6 @@ def stage_b64():
             print("b64 %s FAILED: %s" % (tag, str(e)[:250]))
         finally:
             os.environ.pop("MXNET_FLASH_LAYOUT", None)
-
-
-def stage_ghostbn():
-    """MXNET_GHOST_BN keep/revert on the ResNet bench shape."""
-    import jax
-
-    from mxnet_tpu import models, profiler
-    from mxnet_tpu.base import bfloat16
-    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
-
-    batch, image, steps = 128, 224, 10
-    if os.environ.get("DIAG_SMALL", "0") == "1":
-        batch, image, steps = 8, 64, 2
-    for ghost in (0, min(batch // 2, 32)):
-        net = models.get_resnet(num_classes=1000, num_layers=50,
-                                pooling_convention="valid",
-                                ghost_batch=ghost)
-        mesh = make_mesh(shape=(1,), axis_names=("data",))
-        tr = SPMDTrainer(net, mesh,
-                         data_shapes={"data": (batch, 3, image, image),
-                                      "softmax_label": (batch,)},
-                         lr=0.1, momentum=0.9, wd=1e-4, dtype=bfloat16)
-        rng = np.random.RandomState(0)
-        dev = tr.shard_batch({
-            "data": rng.randn(batch, 3, image, image).astype(np.float32),
-            "softmax_label": rng.randint(0, 1000, (batch,)).astype(
-                np.float32)})
-        tr.run_steps(dev, steps)
-        profiler.device_sync(tr.params)
-        tr.run_steps(dev, steps)
-        profiler.device_sync(tr.params)
-        dt = profiler.timed_median(lambda: tr.run_steps(dev, steps),
-                                   lambda: tr.params, reps=2,
-                                   windows=3) / steps
-        ips = batch / dt
-        mfu = 3 * 2 * 4.089e9 * batch / dt / PEAK_FLOPS
-        print("ghostbn ghost=%d: %.1f img/s, %.1f%% MFU"
-              % (ghost, ips, mfu * 100))
-        _store("ghostbn_%d" % ghost, {
-            "metric": "resnet50_ghostbn_%d" % ghost, "value": round(ips, 1),
-            "unit": "img/s/chip (mfu=%.3f, ghost_batch=%d)" % (mfu, ghost),
-            "vs_baseline": None})
-        del tr, dev
 
 
 def main():

@@ -71,7 +71,7 @@ done
 
 # 3. remaining measured stages (glue is compile-only and already runs
 #    without the relay; keep it here for the cost_analysis cross-check)
-for st in depth ghostbn b64; do
+for st in depth b64; do
     wait_quiet
     log "stage $st"
     DIAG_STAGES=$st timeout -k 60 3000 python scripts/diag_round5.py \
