@@ -140,3 +140,22 @@ def check_numeric_gradient(sym, location, grad_nodes=None, rtol=1e-2,
                 "\nanalytic=%s\nnumeric=%s"
                 % (name, rd, rtol, got, expected))
     return exe
+
+
+def aot_v5e_mesh():
+    """One-device Mesh over an abstract v5e topology (AOT target compile
+    with no live device — ADR-11).  The single source of the topology
+    recipe for both CI (tests/test_aot_compile.py) and the perf campaign
+    (scripts/diag_round5.py); raises MXNetError when the jaxlib/libtpu
+    pair cannot build compile-only TPU clients."""
+    import jax  # noqa: F401  (topologies needs initialized jax)
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    try:
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:2x2")
+    except Exception as e:
+        raise MXNetError("no AOT TPU topology support: %s"
+                         % str(e)[:200]) from e
+    return Mesh(np.array(topo.devices[:1]), ("data",))
