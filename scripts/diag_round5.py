@@ -303,7 +303,8 @@ def stage_glueAB():
 
 def stage_variantsAB():
     """On-chip tok/s for the glue-fix variants the AOT byte A/B
-    shortlisted (TPU geometry, S=1024 B=32).  One variant per process is
+    shortlisted (S=1024 B=32; TPU geometry H=6 unless the variant pins
+    H — parity_fused_nobias runs H=12).  One variant per process is
     safest (VARIANTS_CONFIGS selects); fused_bsd_nobias is the
     compile-predicted winner (105.8 vs 133.5 GB/step)."""
     variants = [
@@ -317,6 +318,10 @@ def stage_variantsAB():
         ("fused_bsd_nobias_stream", {"attn_layout": "bsd", "fused": True,
                                      "use_bias": False,
                                      "bsd_kernel": "stream"}),
+        # the parity-shape (d=64, hsd) candidate: AOT-measured 126.9 GB
+        # vs 191.6 baseline — the >=35%-at-parity lever
+        ("parity_fused_nobias", {"H": 12, "fused": True,
+                                 "use_bias": False}),
     ]
     want = [t for t in os.environ.get("VARIANTS_CONFIGS", "").split(",")
             if t.strip()]
@@ -330,7 +335,8 @@ def stage_variantsAB():
         # stream pin must not leak into the loop-tagged variants
         os.environ["MXNET_FLASH_BSD_KERNEL"] = bsd_kernel
         try:
-            tr, dev, tokens = _make_lm_trainer(H=6, **kw)
+            effective = {"H": 6, **kw}  # recorded: geometry must be
+            tr, dev, tokens = _make_lm_trainer(**effective)  # unambiguous
             tok_s, dt = _measure_tok_s(tr, dev, tokens)
             mfu = _lm_flops_token(12, 768, 1024, 32768) * tokens / dt \
                 / PEAK_FLOPS
@@ -339,8 +345,8 @@ def stage_variantsAB():
             _store("variant_" + tag, {
                 "metric": "transformer_variant_" + tag,
                 "value": round(tok_s / 1e3, 1),
-                "unit": "k tokens/s/chip (mfu=%.3f, TPU geom S=1024 B=32, "
-                        "%s)" % (mfu, kw or "baseline"),
+                "unit": "k tokens/s/chip (mfu=%.3f, S=1024 B=32, "
+                        "%s)" % (mfu, effective),
                 "vs_baseline": None, "mfu": round(mfu, 4)})
             del tr, dev
         except Exception as e:

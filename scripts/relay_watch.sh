@@ -60,7 +60,7 @@ log "bench.py exit $? : $(tail -c 300 bench_results/campaign_bench.out)"
 #    compile-predicted fused_bsd_nobias byte cut translate to time?) —
 #    one variant per process per the relay hygiene rules
 for v in baseline bsd bsd_nobias fused_head fused_bsd fused_bsd_nobias \
-         fused_bsd_nobias_stream; do
+         fused_bsd_nobias_stream parity_fused_nobias; do
     wait_quiet
     log "stage variantsAB $v"
     DIAG_STAGES=variantsAB VARIANTS_CONFIGS=$v \
