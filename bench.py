@@ -244,10 +244,11 @@ def main():
         except Exception as e:  # pragma: no cover
             # retry on the scan-fallback attention backward: a Mosaic
             # lowering failure in the new Pallas bwd kernels must not cost
-            # the round its transformer number.  A memory error is NOT a
-            # lowering failure — flipping the backend for it would record
-            # jnp-scan numbers under a false "pallas failed" note.
-            if ("RESOURCE_EXHAUSTED" not in str(e)
+            # the round its transformer number.  A memory error or dropped
+            # relay RPC is NOT a lowering failure — flipping the backend
+            # for one would record jnp-scan numbers under a false "pallas
+            # failed" note.
+            if (not any(t in str(e) for t in _TRANSIENT_ERRS)
                     and os.environ.get("MXNET_FLASH_BWD") != "jnp"):
                 os.environ["MXNET_FLASH_BWD"] = "jnp"
                 try:
@@ -287,9 +288,18 @@ def main():
         sys.exit(1)
 
 
+_TRANSIENT_ERRS = (
+    "RESOURCE_EXHAUSTED",          # freed buffers drain on the relay's clock
+    "remote_compile",              # axon relay dropped a compile RPC body
+    "response body closed",        # (seen round 5: INTERNAL mid-compile)
+    "DEADLINE_EXCEEDED",
+)
+
+
 def _run_with_oom_retry(fn, tries=3, wait=20):
-    """Retry RESOURCE_EXHAUSTED: the freed ResNet buffers drain on the
-    relay's schedule, not ours.  Applied per config so one transient OOM
+    """Retry transient relay failures: RESOURCE_EXHAUSTED (the freed
+    ResNet buffers drain on the relay's schedule, not ours) and dropped
+    remote-compile RPCs.  Applied per config so one transient fault
     cannot cost the round a headline number."""
     import gc
     import time as _time
@@ -298,7 +308,8 @@ def _run_with_oom_retry(fn, tries=3, wait=20):
         try:
             return fn()
         except Exception as e:
-            if "RESOURCE_EXHAUSTED" not in str(e) or attempt == tries - 1:
+            transient = any(t in str(e) for t in _TRANSIENT_ERRS)
+            if not transient or attempt == tries - 1:
                 raise
         # back off OUTSIDE the except block: the exception's traceback
         # frames pin the failed attempt's device buffers, so collecting

@@ -137,11 +137,17 @@ def _run_checks(jax, jnp, fa, fc, verbose):
         check("flash_fwd_bsd_%s_out" % tag, split(o_b), o_j, 2e-2)
         check("flash_fwd_bsd_%s_lse" % tag, lse_b, lse_j, 1e-3)
 
-        res_b = (qb, kb, vb, o_b, lse_b, zero, zero)
+        # bwd isolation: feed the kernel the REFERENCE fwd outputs
+        # (o_j/lse_j), exactly as the hsd checks above do.  Feeding the
+        # kernel's own (o_b, lse_b) compounds the fwd's tolerated ulp-
+        # level differences through bf16 rounding cliffs in p=exp(s-lse),
+        # which the 1e-3 relative floor then inflates into on-chip "dv
+        # err 0.106"-style false failures (seen round 5, relay campaign).
+        res_b = (qb, kb, vb, merge(o_j), lse_j, zero, zero)
         dq_b, dk_b, dv_b = jax.jit(
             lambda res, grads, c=causal: fa._flash_bwd_pallas_bsd(
                 scale_b, c, 128, 128, Hb, res, grads)[:3])(
-            res_b, (dob, jnp.zeros_like(lse_b)))
+            res_b, (dob, jnp.zeros_like(lse_j)))
         dq_j, dk_j, dv_j = jax.jit(
             lambda res, grads, c=causal: fa._flash_bwd(
                 scale_b, c, 128, res, grads)[:3])(
@@ -163,11 +169,12 @@ def _run_checks(jax, jnp, fa, fc, verbose):
             split(qb), split(kb), split(vb))
         check("flash_fwd_bsd_%s_out" % tag, split(o_g), o_j, 2e-2)
         check("flash_fwd_bsd_%s_lse" % tag, lse_g, lse_j, 1e-3)
+        # same bwd isolation as the loop-variant bsd checks above
         dq_g, dk_g, dv_g = jax.jit(
             lambda res, grads, c=causal: fa._flash_bwd_pallas_bsd_gs(
                 scale_b, c, 128, 128, Hb, res, grads)[:3])(
-            (qb, kb, vb, o_g, lse_g, zero, zero),
-            (dob, jnp.zeros_like(lse_g)))
+            (qb, kb, vb, merge(o_j), lse_j, zero, zero),
+            (dob, jnp.zeros_like(lse_j)))
         dq_j, dk_j, dv_j = jax.jit(
             lambda res, grads, c=causal: fa._flash_bwd(
                 scale_b, c, 128, res, grads)[:3])(
