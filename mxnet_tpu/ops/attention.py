@@ -31,8 +31,11 @@ class DotProductAttention(OpDef):
     params = {
         "causal": Param(bool, default=False),
         "scale": Param(float, default=None),
-        "block_q": Param(int, default=128),
-        "block_k": Param(int, default=128),
+        # <=0 = auto: the kernel layer resolves the measured per-impl
+        # winner (512 loop / 1024 streamed / 256 jnp+dS — the round-5
+        # on-chip block sweep; see flash_attention._auto_blocks)
+        "block_q": Param(int, default=0),
+        "block_k": Param(int, default=0),
         # 'bhsd': (batch, heads, seq, head_dim) operands (default).
         # 'bsd': (batch, seq, embed) operands with num_heads — the
         # transposeless TPU path (flash_attention_bsd): no head

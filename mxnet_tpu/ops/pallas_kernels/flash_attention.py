@@ -44,13 +44,18 @@ def _use_pallas(q, kv_len=None):
     # but tiny head_dim is better served by XLA.
     if q.shape[-1] < 32:
         return False
-    # the kernels hold one head's full K/V (and Q in the dk/dv pass) in
-    # VMEM with double-buffering; beyond ~12 MB of streamed operands the
-    # blockwise jnp path must take over (single-chip ultra-long context —
-    # ring attention shards S across devices long before this triggers)
+    # the loop kernels hold one head's full K/V (dq pass) or full Q/dO
+    # (dk/dv pass) in VMEM, double-buffered by the Mosaic pipeline: the
+    # scoped need is ~2 streams x 2 operands x S x d.  Round-5 on-chip
+    # anchors (d=128 bf16): S=4096 compiles and runs at block 512
+    # (~10 MB scoped), S=8192 is rejected by Mosaic at ANY block size
+    # ("scoped allocation 24.5M > 16M limit"), so the 4*S*d model this
+    # gate previously used was too loose by 2x.  Beyond the cap the
+    # blockwise jnp path or the grid-streamed bsd kernels take over
+    # (ring attention shards S across devices long before this matters).
     s = kv_len if kv_len is not None else q.shape[2]
     itemsize = jnp.dtype(q.dtype).itemsize
-    return 4 * s * q.shape[-1] * itemsize <= 12 * 1024 * 1024
+    return 8 * s * q.shape[-1] * itemsize <= 12 * 1024 * 1024
 
 
 try:  # pallas is TPU-only in some builds; import lazily and gate on backend
@@ -1615,7 +1620,9 @@ def _heads_to_bsd(t):
     return t.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
 
-def _use_pallas_bsd(q, num_heads, kv_len):
+def _bsd_eligible(q, num_heads):
+    """Backend/shape eligibility for ANY bsd Pallas kernel (structure-
+    independent)."""
     e = q.shape[-1]
     d = e // num_heads
     if d % 128 != 0:
@@ -1624,23 +1631,39 @@ def _use_pallas_bsd(q, num_heads, kv_len):
         forced = _os.environ.get("MXNET_FLASH_IMPL")
         if forced not in ("pallas_hsd", "pallas_ds", "pallas_bsd"):
             return False
-    if not _HAS_PALLAS:
-        return False
-    if _os.environ.get("MXNET_FLASH_BSD_KERNEL", "loop") == "stream":
-        # the grid-streamed kernels hold only (block, d) tiles in VMEM —
-        # the whole-K/V residency cap below does not apply (they exist
-        # precisely for the contexts that exceed it)
-        return True
+    return _HAS_PALLAS
+
+
+def _bsd_loop_fits_vmem(q, num_heads, kv_len):
+    # same double-buffered whole-stream residency model as _use_pallas
+    # (round-5 anchors: S=4096 fits, S=8192 Mosaic-OOMs at any block).
+    # The grid-streamed kernels hold only (block, d) tiles in VMEM, so
+    # this cap does not apply to them — they exist precisely for the
+    # contexts that exceed it.
+    d = q.shape[-1] // num_heads
     itemsize = jnp.dtype(q.dtype).itemsize
-    return 4 * kv_len * d * itemsize <= 12 * 1024 * 1024
+    return 8 * kv_len * d * itemsize <= 12 * 1024 * 1024
+
+
+def _bsd_structure(q, num_heads, kv_len):
+    """Pick the kernel structure: MXNET_FLASH_BSD_KERNEL pins it; unset,
+    the loop kernels win wherever their whole-K/V VMEM residency fits
+    (round-5: 52.6% vs 41.9% MFU at S=4096) and the grid-streamed
+    kernels take over beyond the cap (S=8192: 46.9% MFU vs a jnp-scan
+    fallback — auto-promotion instead of silently losing 5x)."""
+    raw = _os.environ.get("MXNET_FLASH_BSD_KERNEL")
+    if raw in ("loop", "stream"):
+        return raw
+    return "loop" if _bsd_loop_fits_vmem(q, num_heads, kv_len) \
+        else "stream"
 
 
 def _bsd_fwd_dispatch(q, k, v, qo, ko, scale, causal, block_q, block_k,
-                      num_heads):
-    # MXNET_FLASH_BSD_KERNEL selects the kernel structure: 'loop'
-    # (in-kernel fori over K/V slices) vs 'stream' (grid-streamed with
-    # scratch accumulators) — the long-context A/B knob
-    if _os.environ.get("MXNET_FLASH_BSD_KERNEL", "loop") == "stream":
+                      num_heads, impl):
+    # impl carries the kernel structure: 'pallas_bsd' = in-kernel fori
+    # over K/V slices (whole-K/V VMEM residency), 'pallas_bsd_gs' =
+    # grid-streamed blocks with scratch accumulators (no residency cap)
+    if impl == "pallas_bsd_gs":
         return _flash_fwd_pallas_bsd_gs(q, k, v, qo, ko, scale, causal,
                                         block_q, block_k, num_heads)
     return _flash_fwd_pallas_bsd(q, k, v, qo, ko, scale, causal,
@@ -1652,9 +1675,9 @@ def _flash_bsd(q, k, v, q_off, k_off, scale, causal, block_q, block_k,
                num_heads, impl):
     qo = jnp.asarray(q_off, jnp.int32)
     ko = jnp.asarray(k_off, jnp.int32)
-    if impl == "pallas_bsd":
+    if impl in ("pallas_bsd", "pallas_bsd_gs"):
         return _bsd_fwd_dispatch(q, k, v, qo, ko, scale, causal,
-                                 block_q, block_k, num_heads)
+                                 block_q, block_k, num_heads, impl)
     out, lse = _flash_fwd_jnp(
         _bsd_to_heads(q, num_heads), _bsd_to_heads(k, num_heads),
         _bsd_to_heads(v, num_heads), qo, ko, scale, causal, block_k)
@@ -1673,11 +1696,11 @@ def _flash_bsd_fwd_rule(q, k, v, q_off, k_off, scale, causal, block_q,
 def _flash_bsd_bwd_rule(scale, causal, block_q, block_k, num_heads, impl,
                         res, grads):
     force_jnp = _os.environ.get("MXNET_FLASH_BWD", "pallas") == "jnp"
+    if impl == "pallas_bsd_gs" and not force_jnp:
+        return _flash_bwd_pallas_bsd_gs(scale, causal, block_q,
+                                        block_k, num_heads, res,
+                                        grads)
     if impl == "pallas_bsd" and not force_jnp:
-        if _os.environ.get("MXNET_FLASH_BSD_KERNEL", "loop") == "stream":
-            return _flash_bwd_pallas_bsd_gs(scale, causal, block_q,
-                                            block_k, num_heads, res,
-                                            grads)
         return _flash_bwd_pallas_bsd(scale, causal, block_q, block_k,
                                      num_heads, res, grads)
     q, k, v, o, lse, qo, ko = res
@@ -1695,14 +1718,16 @@ _flash_bsd.defvjp(_flash_bsd_fwd_rule, _flash_bsd_bwd_rule)
 
 
 def flash_attention_bsd(q, k, v, num_heads, *, causal=False, scale=None,
-                        q_offset=0.0, k_offset=0.0, block_q=256,
-                        block_k=256, with_lse=False):
+                        q_offset=0.0, k_offset=0.0, block_q=0,
+                        block_k=0, with_lse=False):
     """Fused attention over (batch, seq, embed) arrays — the transposeless
     TPU path (heads live on the lane axis; see the bsd section note).
 
     Falls back to the blockwise jnp path (via head split/merge) when the
     per-head width is not lane-aligned or the K/V stream exceeds the VMEM
-    cap.  Returns (out [, lse (batch, num_heads, seq)])."""
+    cap.  ``block_q``/``block_k`` <= 0 selects the measured per-impl
+    default (`_auto_blocks`).  Returns (out [, lse (batch, num_heads,
+    seq)])."""
     if q.ndim != 3 or k.ndim != 3 or v.ndim != 3:
         raise ValueError("flash_attention_bsd expects (B, S, E) inputs")
     if q.shape[-1] % num_heads != 0:
@@ -1713,6 +1738,7 @@ def flash_attention_bsd(q, k, v, num_heads, *, causal=False, scale=None,
     block_q = int(_os.environ.get("MXNET_FLASH_BLOCK_Q", block_q))
     block_k = int(_os.environ.get("MXNET_FLASH_BLOCK_K", block_k))
     forced = _os.environ.get("MXNET_FLASH_IMPL")
+    skv = k.shape[1]
     if forced == "pallas_bsd":
         # honor the pin with the same readable-failure contract as
         # _pick_impl: never silently hand a pinned A/B run to the jnp
@@ -1721,22 +1747,39 @@ def flash_attention_bsd(q, k, v, num_heads, *, causal=False, scale=None,
             raise RuntimeError(
                 "MXNET_FLASH_IMPL=pallas_bsd but jax.experimental.pallas "
                 "is unavailable in this build")
-        if not _use_pallas_bsd(q, num_heads, k.shape[1]) \
-                or q.shape[1] * k.shape[1] < 512 * 512:
+        if not _bsd_eligible(q, num_heads) \
+                or q.shape[1] * skv < 512 * 512:
             import warnings
 
             warnings.warn(
                 "MXNET_FLASH_IMPL=pallas_bsd pinned, but the auto-router "
                 "would reject this shape/backend (head_dim=%d, S=%dx%d) — "
                 "the pinned kernel may fail to lower or spill"
-                % (q.shape[-1] // num_heads, q.shape[1], k.shape[1]))
+                % (q.shape[-1] // num_heads, q.shape[1], skv))
         impl = "pallas_bsd"
     elif forced == "jnp":
         impl = "jnp_t"
     else:
         impl = "pallas_bsd" if (
-            _use_pallas_bsd(q, num_heads, k.shape[1])
-            and q.shape[1] * k.shape[1] >= 512 * 512) else "jnp_t"
+            _bsd_eligible(q, num_heads)
+            and q.shape[1] * skv >= 512 * 512) else "jnp_t"
+    if impl == "pallas_bsd":
+        structure = _bsd_structure(q, num_heads, skv)
+        if structure == "stream":
+            impl = "pallas_bsd_gs"
+        elif not _bsd_loop_fits_vmem(q, num_heads, skv):
+            # only reachable when MXNET_FLASH_BSD_KERNEL=loop is pinned
+            # (auto would have promoted to the streamed structure): honor
+            # the pin but say why Mosaic is about to reject it
+            import warnings
+
+            warnings.warn(
+                "MXNET_FLASH_BSD_KERNEL=loop pinned, but the whole-K/V "
+                "VMEM residency of the loop kernels exceeds the ~12 MB "
+                "model at kv_len=%d head_dim=%d — Mosaic will likely "
+                "reject the kernel; unset the pin to auto-promote to the "
+                "grid-streamed structure" % (skv, q.shape[-1] // num_heads))
+    block_q, block_k = _auto_blocks(block_q, block_k, impl)
     q_off = jnp.asarray(q_offset, jnp.float32)
     k_off = jnp.asarray(k_offset, jnp.float32)
     out, lse = _flash_bsd(q, k, v, q_off, k_off, float(scale),
@@ -1847,31 +1890,54 @@ def _pick_impl(q, kv_len):
     return "pallas_hsd"
 
 
+def _auto_blocks(block_q, block_k, impl):
+    """Resolve block<=0 ("auto") to the measured in-model winners.
+
+    Round-5 on-chip block sweep (S=1024..8192, h6/d128, full train step):
+    the loop kernels are monotonically faster up to 512 (S=1024: 42.4%
+    MFU at 128 -> 53.7% at 512; S=4096: 27.5% -> 52.6%) and VMEM-reject
+    beyond it; the grid-streamed kernels peak at 1024 (S=8192: 9.4% at
+    128 -> 46.9% at 1024, OOM at bq1024/bk2048).  The jnp scan and the
+    dS kernels keep their prior 256 (the dS structure is unmeasured at
+    512 and is a capacity knob, not a speed path).  MXNET_FLASH_BLOCK_Q/K
+    still override everything.
+    """
+    auto = {"pallas_hsd": 512, "pallas_bsd": 512,
+            "pallas_bsd_gs": 1024}.get(impl, 256)
+    if block_q <= 0:
+        block_q = auto
+    if block_k <= 0:
+        block_k = auto
+    return block_q, block_k
+
+
 def flash_attention(q, k, v, *, causal=False, scale=None,
                     q_offset=0.0, k_offset=0.0,
-                    block_q=256, block_k=256, with_lse=False):
+                    block_q=0, block_k=0, with_lse=False):
     """Fused attention over (batch, heads, seq, head_dim) arrays.
 
     ``scale`` defaults to 1/sqrt(head_dim).  ``q_offset``/``k_offset`` are
     the global positions of row/col 0 for causal masking (may be traced;
     passed as floats so gradients flow cleanly through `custom_vjp`).
-    Returns the attention output; with ``with_lse=True`` also returns the
-    per-row logsumexp of the scaled scores (float32, (batch, heads, seq))
-    for cross-device combination (see `parallel/sequence.py`).
+    ``block_q``/``block_k`` <= 0 selects the measured per-impl default
+    (`_auto_blocks`).  Returns the attention output; with ``with_lse=True``
+    also returns the per-row logsumexp of the scaled scores (float32,
+    (batch, heads, seq)) for cross-device combination (see
+    `parallel/sequence.py`).
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("flash_attention expects (B, H, S, D) inputs")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    impl = _pick_impl(q, k.shape[2])
     # Diagnostic pins: the DotProductAttention op builds into the model
-    # with its own block defaults, so an in-model block-size A/B needs an
-    # env override (round-4 isolated kernels measured block 256 ~1.6x
-    # block 128; the in-model winner is measured, not assumed)
+    # with its own block params, so an in-model block-size A/B needs an
+    # env override
     block_q = int(_os.environ.get("MXNET_FLASH_BLOCK_Q", block_q))
     block_k = int(_os.environ.get("MXNET_FLASH_BLOCK_K", block_k))
+    block_q, block_k = _auto_blocks(block_q, block_k, impl)
     q_off = jnp.asarray(q_offset, jnp.float32)
     k_off = jnp.asarray(k_offset, jnp.float32)
     out, lse = _flash(q, k, v, q_off, k_off, float(scale), bool(causal),
-                      int(block_q), int(block_k),
-                      _pick_impl(q, k.shape[2]))
+                      int(block_q), int(block_k), impl)
     return (out, lse) if with_lse else out

@@ -69,10 +69,13 @@ def test_tiny_head_dim_routes_to_jnp(tpu_backend, monkeypatch):
 
 def test_vmem_cap_routes_to_jnp(tpu_backend, monkeypatch):
     monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
-    # bf16 d=128: 4 * S * 128 * 2 bytes of streamed K/V+Q/dO; the ~12 MB
-    # cap trips above S=12288
-    assert fa._pick_impl(q_of(12288, 128), 12288) == "pallas_hsd"
-    assert fa._pick_impl(q_of(16384, 128), 16384) == "jnp"
+    # bf16 d=128: 8 * S * 128 * 2 bytes of double-buffered whole-stream
+    # residency (round-5 on-chip anchors: S=4096 compiles at block 512,
+    # S=8192 Mosaic-OOMs at any block) — the ~12 MB cap trips above
+    # S=6144
+    assert fa._pick_impl(q_of(4096, 128), 4096) == "pallas_hsd"
+    assert fa._pick_impl(q_of(6144, 128), 6144) == "pallas_hsd"
+    assert fa._pick_impl(q_of(8192, 128), 8192) == "jnp"
 
 
 def test_pin_jnp_always_wins(tpu_backend, monkeypatch):
@@ -142,3 +145,79 @@ def test_bsd_pin_warns_on_rejected_shape(monkeypatch):
     with pytest.warns(UserWarning, match="auto-router would reject"):
         fa.flash_attention_bsd(q, q, q, 4)  # head_dim 64
     assert captured["impl"] == "pallas_bsd"
+
+
+# ---- round-5 additions: auto blocks + bsd structure auto-promotion ----
+
+
+def bsd_q(s, e, dtype=jnp.bfloat16):
+    return jnp.zeros((1, s, e), dtype)
+
+
+def test_auto_blocks_per_impl():
+    # measured winners (round-5 on-chip block sweep, docs/mfu_roofline.md)
+    assert fa._auto_blocks(0, 0, "pallas_hsd") == (512, 512)
+    assert fa._auto_blocks(0, 0, "pallas_bsd") == (512, 512)
+    assert fa._auto_blocks(0, 0, "pallas_bsd_gs") == (1024, 1024)
+    assert fa._auto_blocks(0, 0, "pallas_ds") == (256, 256)
+    assert fa._auto_blocks(0, 0, "jnp") == (256, 256)
+    # explicit values always win over auto
+    assert fa._auto_blocks(128, 256, "pallas_hsd") == (128, 256)
+    # partial auto resolves only the unset side
+    assert fa._auto_blocks(0, 256, "pallas_bsd_gs") == (1024, 256)
+
+
+def test_bsd_structure_auto_promotes_past_vmem_cap(tpu_backend,
+                                                   monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_BSD_KERNEL", raising=False)
+    # d=128 bf16: loop residency 8*S*128*2 crosses 12MB above S=6144
+    assert fa._bsd_structure(bsd_q(4096, 768), 6, 4096) == "loop"
+    assert fa._bsd_structure(bsd_q(8192, 768), 6, 8192) == "stream"
+
+
+def test_bsd_structure_env_pin_wins(tpu_backend, monkeypatch):
+    monkeypatch.setenv("MXNET_FLASH_BSD_KERNEL", "stream")
+    assert fa._bsd_structure(bsd_q(1024, 768), 6, 1024) == "stream"
+    monkeypatch.setenv("MXNET_FLASH_BSD_KERNEL", "loop")
+    assert fa._bsd_structure(bsd_q(8192, 768), 6, 8192) == "loop"
+
+
+def test_bsd_eligibility_lane_alignment(tpu_backend, monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    assert fa._bsd_eligible(bsd_q(1024, 768), 6)        # d=128
+    assert not fa._bsd_eligible(bsd_q(1024, 768), 12)   # d=64
+
+
+def test_bsd_loop_pin_over_vmem_warns(tpu_backend, monkeypatch):
+    """A pinned loop structure on an over-VMEM shape is honored but
+    warned (auto would have promoted to the streamed structure)."""
+    monkeypatch.setenv("MXNET_FLASH_BSD_KERNEL", "loop")
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    captured = {}
+
+    def fake(q, k, v, qo, ko, scale, causal, bq, bk, h, impl):
+        captured["impl"] = impl
+        return q, jnp.zeros((q.shape[0], h, q.shape[1]), jnp.float32)
+
+    monkeypatch.setattr(fa, "_flash_bsd", fake)
+    q = bsd_q(8192, 768)
+    with pytest.warns(UserWarning, match="MXNET_FLASH_BSD_KERNEL=loop"):
+        fa.flash_attention_bsd(q, q, q, 6)
+    assert captured["impl"] == "pallas_bsd"
+
+
+def test_bsd_auto_promotes_impl_to_gs(tpu_backend, monkeypatch):
+    monkeypatch.delenv("MXNET_FLASH_BSD_KERNEL", raising=False)
+    monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
+    captured = {}
+
+    def fake(q, k, v, qo, ko, scale, causal, bq, bk, h, impl):
+        captured["impl"] = impl
+        captured["blocks"] = (bq, bk)
+        return q, jnp.zeros((q.shape[0], h, q.shape[1]), jnp.float32)
+
+    monkeypatch.setattr(fa, "_flash_bsd", fake)
+    q = bsd_q(8192, 768)
+    fa.flash_attention_bsd(q, q, q, 6)
+    assert captured["impl"] == "pallas_bsd_gs"
+    assert captured["blocks"] == (1024, 1024)
