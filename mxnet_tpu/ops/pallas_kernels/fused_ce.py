@@ -492,6 +492,10 @@ def fused_softmax_ce(x, weight, bias, label, *, grad_scale=1.0,
     """
     if x.ndim != 2 or weight.ndim != 2:
         raise ValueError("fused_softmax_ce expects 2-D x and weight")
+    # in-model block A/B without rebuilding the model, mirroring
+    # MXNET_FLASH_BLOCK_Q/K on the attention side
+    block_n = int(_os.environ.get("MXNET_CE_BLOCK_N", block_n))
+    block_v = int(_os.environ.get("MXNET_CE_BLOCK_V", block_v))
     if bias is None:
         # derive from weight (not a fresh constant) so its varying-manual-
         # axes type matches under shard_map
