@@ -29,7 +29,15 @@ def _proj(x_flat, name, num_hidden, weight=None, bias=None,
 def transformer_block(x, name, seq_len, num_heads, num_embed,
                       num_ffn_hidden, dropout=0.0, causal=True,
                       use_bias=True, attn_layout="bhsd"):
-    """One pre-LN block.  x: (batch, seq, embed) symbol."""
+    """One pre-LN block.  x: (batch, seq, embed) symbol.
+
+    ``attn_layout`` must be resolved here ('bsd' or 'bhsd') — 'auto' is
+    a `get_transformer_lm`-level value."""
+    if attn_layout not in ("bsd", "bhsd"):
+        raise ValueError(
+            "transformer_block attn_layout must be 'bsd' or 'bhsd', got "
+            "%r ('auto' is resolved by get_transformer_lm)"
+            % (attn_layout,))
     head_dim = num_embed // num_heads
 
     # --- attention sublayer ---
@@ -93,7 +101,7 @@ def transformer_block(x, name, seq_len, num_heads, num_embed,
 def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
                        num_embed=128, num_ffn_hidden=None, dropout=0.0,
                        causal=True, fused_head=False, use_bias=True,
-                       attn_layout="bhsd"):
+                       attn_layout="auto"):
     """Decoder-only LM.  data: (batch, seq) token ids; softmax_label:
     (batch, seq) next-token ids.  Loss rows are position-major like the
     reference's unrolled-LSTM head (`example/rnn/lstm.py:102-104`) is
@@ -114,10 +122,24 @@ def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
 
     ``attn_layout='bsd'`` routes attention through the transposeless
     (batch, seq, embed) kernels (requires head_dim % 128 == 0 for the
-    Pallas path; other shapes fall back to a head-split jnp path).  The
-    'bhsd' default builds the classic head-split transposes."""
+    Pallas path; other shapes fall back to a head-split jnp path);
+    'bhsd' builds the classic head-split transposes.  The 'auto'
+    default picks 'bsd' whenever the head width is lane-aligned: the
+    layouts measure equal at short S (round-5 on-chip: 147.3k vs 147.4k
+    tok/s at S=1024), the parameter set is identical either way (only
+    internal reshapes differ, so checkpoints are interchangeable), and
+    past the loop kernels' VMEM cap (S > 6144 at d=128) only the bsd
+    path auto-promotes to the grid-streamed kernels (46.9% MFU at
+    S=8192) instead of falling back to the jnp scan."""
     if num_embed % num_heads != 0:
         raise ValueError("num_embed must be divisible by num_heads")
+    if attn_layout not in ("auto", "bsd", "bhsd"):
+        raise ValueError(
+            "attn_layout must be 'auto', 'bsd', or 'bhsd', got %r"
+            % (attn_layout,))
+    if attn_layout == "auto":
+        attn_layout = "bsd" if (num_embed // num_heads) % 128 == 0 \
+            else "bhsd"
     if num_ffn_hidden is None:
         num_ffn_hidden = 4 * num_embed
 

@@ -58,6 +58,10 @@ def run():
         from mxnet_tpu.base import bfloat16 as dtype
 
     use_bias = os.environ.get("TBENCH_USE_BIAS", "1") != "0"
+    # deliberately pinned to 'bhsd' (NOT the library's 'auto' default):
+    # the recorded parity/geometry configs must stay byte-comparable
+    # across rounds, and the unit string discloses the layout either way
+    # — the bsd path is measured by the explicit tpu_geom_fast_ config
     attn_layout = os.environ.get("TBENCH_ATTN_LAYOUT", "bhsd")
     net = models.get_transformer_lm(
         vocab_size=V, seq_len=S, num_layers=L, num_heads=H, num_embed=D,
