@@ -23,6 +23,27 @@ class MXNetError(Exception):
     """Error raised by mxnet_tpu — mirrors the reference's `MXNetError`."""
 
 
+_donation_warning_silenced = False
+
+
+def silence_cpu_donation_warning():
+    """Buffer donation is a no-op (with a warning per dispatch) on backends
+    without aliasing support.  Silence exactly that warning, and only when
+    the default backend is such a backend (CPU) — on devices where donation
+    works, user code's own donation diagnostics stay live."""
+    global _donation_warning_silenced
+    if _donation_warning_silenced:
+        return
+    _donation_warning_silenced = True
+    import warnings
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+
+
 # Integer type flags.  0-4 match the reference (`python/mxnet/ndarray.py:30-44`)
 # so saved .params files round-trip; >=5 are TPU-era extensions.
 _DTYPE_NP_TO_MX = {

@@ -27,14 +27,16 @@ compile it.
 from __future__ import annotations
 
 import os
+import weakref
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from . import profiler
 from . import random as _random
-from .base import MXNetError
+from .base import MXNetError, silence_cpu_donation_warning
 from .context import Context
 from .ndarray import NDArray
 from .ops.registry import OpCtx
@@ -377,13 +379,30 @@ class Executor:
             return outs, new_aux, grads
 
         self._train_step_fn = train_step  # un-jitted, for profiler.plan
-        self._jit_train_step = jax.jit(train_step)
+        # The pending (aux, cot) buffers are DONATED: aux is rebound to the
+        # returned new_aux right after the call and the default cotangents
+        # are created per-call, so neither outlives the step.  The bound
+        # args canNOT be donated here — the weights must survive the step
+        # for the (separate) optimizer update; the path that donates them
+        # is parallel.SPMDTrainer, whose step owns the update too.  A
+        # non-donating variant serves user-supplied out_grads, whose
+        # buffers the caller may reuse.
+        silence_cpu_donation_warning()
+        self._jit_train_step = jax.jit(train_step, donate_argnums=(1, 3))
+        self._jit_train_step_keep = jax.jit(train_step)
         self._base_key = _random.next_key()
         self._step = 0
         self._pending = None  # (args, aux, rng) snapshot for lazy train fwd
         self._outputs = None
         self._monitor_cb = None
         self._device = self._ctx.jax_device() if self._ctx is not None else None
+        # NDArrays verified resident on self._device: `_set_data` preserves
+        # device placement, so one check per bound array suffices instead of
+        # re-checking every array every step.  Keyed id -> weakref, with the
+        # weakref target compared by identity on lookup: a dead or retargeted
+        # weakref means CPython recycled the id for a different array, which
+        # must be re-verified rather than trusted
+        self._placed_refs = {}
 
     # -- dict views (python/mxnet/executor.py) -----------------------------
     @property
@@ -406,8 +425,9 @@ class Executor:
         reference's `Executor::outputs` NDArrays)."""
         if self._outputs is None:
             if self._pending is not None:
-                args, aux, rng = self._pending
+                args, aux, rng = self._pending_live()
                 outs, new_aux = self._jit_train(args, aux, rng)
+                profiler.record_dispatch("executor.forward")
                 for nd, arr in zip(self.aux_arrays, new_aux):
                     nd._set_data(arr)
                 self._outputs = [NDArray(o) for o in outs]
@@ -420,13 +440,59 @@ class Executor:
 
     # -- execution ---------------------------------------------------------
     def _gather(self, arrays):
+        """Raw jax arrays of the bound NDArrays, resident on this
+        executor's device.
+
+        Device placement is verified ONCE per bound root array (cached in
+        `_placed_refs`): `_set_data` keeps the old buffer's device on every
+        write, so an array placed at first gather stays placed for the
+        executor's lifetime.  Misplaced roots are moved and pinned; views
+        read through their parent and are re-checked each time."""
         out = []
+        placed = self._placed_refs
         for nd in arrays:
-            arr = nd.data if isinstance(nd, NDArray) else jnp.asarray(nd)
-            if self._device is not None and getattr(arr, "device", None) != self._device:
-                arr = jax.device_put(arr, self._device)
-            out.append(arr)
+            if isinstance(nd, NDArray):
+                ref = placed.get(id(nd))
+                if ref is not None and ref() is nd:
+                    out.append(nd.data)
+                    continue
+                arr = nd.data
+                if self._device is not None and \
+                        getattr(arr, "device", None) != self._device:
+                    arr = jax.device_put(arr, self._device)
+                    profiler.record_dispatch("executor.gather",
+                                             kind="transfer")
+                    if nd._parent is None:
+                        nd._data = arr  # pin: future _set_data keeps device
+                if nd._parent is None:
+                    placed[id(nd)] = weakref.ref(nd)
+                out.append(arr)
+            else:
+                out.append(jnp.asarray(nd))
         return out
+
+    def _pending_live(self):
+        """The `_pending` snapshot with donated buffers refreshed.
+
+        The snapshot holds the raw weight/aux buffers gathered at
+        forward(); a fused optimizer update between forward() and
+        backward()/outputs donates the bound weights into `update_multi`,
+        deleting those buffers.  Feeding them back to XLA is a crash, so a
+        stale snapshot is re-gathered from the bound NDArrays — the replay
+        then computes with the post-update values, i.e. the same
+        recompute-with-current-weights semantics the eager `outputs` path
+        has always had."""
+        args, aux, rng = self._pending
+
+        def stale(arrs):
+            return any(getattr(a, "is_deleted", None) is not None
+                       and a.is_deleted() for a in arrs)
+
+        if stale(args) or stale(aux):
+            args = self._gather(self.arg_arrays)
+            aux = self._gather(self.aux_arrays)
+            self._pending = (args, aux, rng)
+        return args, aux, rng
 
     def forward(self, is_train=False, **kwargs):
         """Run forward.  kwargs copy new values into bound args by name,
@@ -458,6 +524,7 @@ class Executor:
             return _LazyOutputs(self)
         jit = self._jit_train if is_train else self._jit_eval
         outs, new_aux = jit(args, aux, rng)
+        profiler.record_dispatch("executor.forward")
         self._pending = None
         if is_train:
             for nd, arr in zip(self.aux_arrays, new_aux):
@@ -518,10 +585,21 @@ class Executor:
             raise MXNetError("bind with args_grad to use backward()")
         if self._pending is None:
             raise MXNetError("call forward(is_train=True) before backward()")
-        args, aux, rng = self._pending
+        args, aux, rng = self._pending_live()
         if out_grads is None:
             avals = self._out_avals(args, aux, rng)
             cot = tuple(jnp.ones(o.shape, o.dtype) for o in avals)
+            step = self._jit_train_step  # donates (aux, cot): both are ours
+            # donating the same buffer twice — aux states bound to one
+            # shared array, or an aux aliasing a bound arg — is an XLA
+            # error; such binds take the non-donating program (the same
+            # guard update_multi applies to its weight/state donation)
+            seen = set(map(id, args))
+            for a in aux:
+                if id(a) in seen:
+                    step = self._jit_train_step_keep
+                    break
+                seen.add(id(a))
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -529,7 +607,11 @@ class Executor:
                 g.data if isinstance(g, NDArray) else jnp.asarray(g)
                 for g in out_grads
             )
-        outs, new_aux, grads = self._jit_train_step(args, aux, rng, cot)
+            # user-supplied cotangent buffers must survive the call
+            step = self._jit_train_step_keep
+        outs, new_aux, grads = step(args, aux, rng, cot)
+        profiler.record_dispatch("executor.train_step")
+        self._pending = None  # aux was donated: forbid replay on stale aux
         self._outputs = [NDArray(o) for o in outs]
         for nd, arr in zip(self.aux_arrays, new_aux):
             nd._set_data(arr)
@@ -553,16 +635,30 @@ class Executor:
         return str(profiler.plan(self, mode=mode))
 
     def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
-        """Copy parameters by name (`executor.py` copy_params_from)."""
+        """Copy parameters by name (`executor.py` copy_params_from).
+
+        Both args and aux get a PRIVATE buffer copy (not `copyto`'s
+        pointer share): the fused train step donates its aux inputs and
+        `Optimizer.update_multi` donates the bound weights, so neither may
+        alias the caller's param dicts.  The copies run once at bind/init
+        time, not per step."""
         for name, array in arg_params.items():
             if name in self._arg_names:
-                array.copyto(self.arg_arrays[self._arg_names.index(name)])
+                dst = self.arg_arrays[self._arg_names.index(name)]
+                if array.shape != dst.shape:
+                    raise MXNetError("copyto shape mismatch %s vs %s"
+                                     % (array.shape, dst.shape))
+                dst._set_data(jnp.array(array.data, dtype=dst.dtype))
             elif not allow_extra_params:
                 raise MXNetError("unknown argument %r" % name)
         if aux_params:
             for name, array in aux_params.items():
                 if name in self._aux_names:
-                    array.copyto(self.aux_arrays[self._aux_names.index(name)])
+                    dst = self.aux_arrays[self._aux_names.index(name)]
+                    if array.shape != dst.shape:
+                        raise MXNetError("copyto shape mismatch %s vs %s"
+                                         % (array.shape, dst.shape))
+                    dst._set_data(jnp.array(array.data, dtype=dst.dtype))
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux state %r" % name)
 
@@ -581,7 +677,16 @@ class Executor:
         ]
         new_grads = None
         if self.grad_arrays is not None:
-            new_grads = [zeros(s, ctx=self._ctx) for s in arg_shapes]
-        new_aux = [zeros(s, ctx=self._ctx) for s in aux_shapes]
+            # grads must match the arg dtype (a bf16 bind used to get f32
+            # grads here) and keep per-arg None for grad_req='null' args
+            new_grads = [
+                zeros(s, ctx=self._ctx, dtype=a.dtype) if g is not None
+                else None
+                for s, a, g in zip(arg_shapes, self.arg_arrays,
+                                   self.grad_arrays)
+            ]
+        new_aux = [zeros(s, ctx=self._ctx, dtype=x.dtype)
+                   for s, x in zip(aux_shapes, self.aux_arrays)]
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self._grad_req, new_aux)
+                        self._grad_req, new_aux,
+                        group2ctx=self._group2ctx)

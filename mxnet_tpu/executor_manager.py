@@ -199,15 +199,22 @@ class DataParallelExecutorManager:
             e.copy_params_from(arg_params, aux_params)
 
     def copy_to(self, arg_params, aux_params):
-        """Average params over devices into host dicts (`copy_to`)."""
-        for name, blocks in zip(self.param_names, self.param_arrays):
-            acc = _reduce_blocks(blocks)
-            arg_params[name]._set_data((acc / len(blocks)).astype(
-                arg_params[name].dtype))
-        for name, blocks in zip(self.aux_names, self.aux_arrays):
-            acc = _reduce_blocks(blocks)
-            aux_params[name]._set_data((acc / len(blocks)).astype(
-                aux_params[name].dtype))
+        """Average params over devices into host dicts (`copy_to`) — all
+        entries reduced in one fused program (the step-level bucketing
+        idea applied to the epoch-end copy)."""
+        from .kvstore import fused_reduce_lists
+
+        blocks_list = list(self.param_arrays) + list(self.aux_arrays)
+        dsts = [arg_params[n] for n in self.param_names] + \
+               [aux_params[n] for n in self.aux_names]
+        if not dsts:
+            return
+        means = fused_reduce_lists(
+            [[b.data for b in blocks] for blocks in blocks_list],
+            mean=True, stage_site="executor_manager.stage",
+            reduce_site="executor_manager.fused_mean")
+        for dst, mean in zip(dsts, means):
+            dst._set_data(mean.astype(dst.dtype))
 
     @property
     def param_arrays(self):

@@ -32,8 +32,66 @@ import pickle
 
 import jax.numpy as jnp
 
+from . import profiler
 from .base import MXNetError
 from .ndarray import NDArray, zeros
+
+_fused_reduce_jits = {}
+
+
+def fused_reduce_lists(lists, mean=False, stage_site="kvstore.stage",
+                       reduce_site="kvstore.fused_reduce"):
+    """Reduce each entry of `lists` — a list of per-device raw-array lists
+    — to one array (sum; per-entry mean with ``mean=True``) in ONE cached
+    jitted program, after staging every array onto the bucket's common
+    device (Horovod-style tensor fusion; the reference got the same effect
+    from its async engine overlapping many small reduces).  One program
+    cannot span committed devices: when entries target different devices,
+    each entry is instead reduced eagerly on its own device — decided
+    BEFORE any staging so the fallback doesn't transfer cross-device
+    values twice.  Shared by `KVStore._merge_batch` and
+    `executor_manager.DataParallelExecutorManager.copy_to`."""
+    import jax
+
+    if all(len(arrs) == 1 for arrs in lists):
+        return [arrs[0] for arrs in lists]  # nothing to reduce
+
+    def stage(arrs, dev):
+        row = []
+        for a in arrs:
+            if getattr(a, "device", None) != dev:
+                a = jax.device_put(a, dev)
+                profiler.record_dispatch(stage_site, kind="transfer")
+            row.append(a)
+        return row
+
+    devs = {getattr(arrs[0], "device", None) for arrs in lists}
+    if len(devs) > 1:
+        out = []
+        for arrs in lists:
+            arrs = stage(arrs, getattr(arrs[0], "device", None))
+            acc = arrs[0]
+            for a in arrs[1:]:
+                acc = acc + a
+            out.append(acc / len(arrs) if mean else acc)
+        return out
+    (dev,) = devs
+    staged = tuple(tuple(stage(arrs, dev)) for arrs in lists)
+    fn = _fused_reduce_jits.get(mean)
+    if fn is None:
+        def reduce_all(lists, _mean=mean):
+            out = []
+            for arrs in lists:
+                acc = arrs[0]
+                for a in arrs[1:]:
+                    acc = acc + a
+                out.append(acc / len(arrs) if _mean else acc)
+            return tuple(out)
+
+        fn = jax.jit(reduce_all)
+        _fused_reduce_jits[mean] = fn
+    profiler.record_dispatch(reduce_site)
+    return list(fn(staged))
 
 
 class KVStore:
@@ -67,19 +125,18 @@ class KVStore:
         return out
 
     def _merge(self, vals):
-        """Reduce a list of NDArrays (possibly on different devices).  Fixed
-        left-to-right order for the determinism gate
-        (`tests/nightly/multi_lenet.py`; SURVEY §7)."""
-        import jax
+        """Reduce one key's list of NDArrays — the single-entry case of
+        `fused_reduce_lists` (same staging and fixed left-to-right order,
+        for the determinism gate; `tests/nightly/multi_lenet.py`,
+        SURVEY §7)."""
+        return fused_reduce_lists([[v.data for v in vals]])[0]
 
-        dev = getattr(vals[0].data, "device", None)
-        acc = vals[0].data
-        for v in vals[1:]:
-            arr = v.data
-            if getattr(arr, "device", None) != dev:
-                arr = jax.device_put(arr, dev)
-            acc = acc + arr
-        return acc
+    def _merge_batch(self, vals):
+        """Bucketed reduce: every key's per-device sum in ONE jitted
+        program (per-key eager reduces when the keys' committed devices
+        differ)."""
+        return fused_reduce_lists(
+            [[v.data for v in vlist] for vlist in vals])
 
     # -- API ---------------------------------------------------------------
     def init(self, key, value):
@@ -92,20 +149,31 @@ class KVStore:
             self._store[k] = v.copy()
 
     def push(self, key, value, priority=0):
+        """Push values.  A list of keys is treated as one bucket: all merges
+        run as a single fused reduce and a batch-capable updater (see
+        `optimizer.get_fused_updater`) applies the whole bucket in one
+        `update_multi` dispatch."""
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
-        for k, vlist in zip(keys, vals):
-            merged = NDArray(self._merge(vlist))
-            # semantics of `KVStoreLocal::Push` (`kvstore_local.h:39-55`):
-            # with an updater, the merged value updates the stored weight
-            # (init required); without one it only lands in the merge buffer
-            # (push-before-init is legal pure-aggregation usage)
-            if self._updater is not None:
+        merged = [NDArray(a) for a in self._merge_batch(vals)] \
+            if len(keys) > 1 else [NDArray(self._merge(vals[0]))]
+        # semantics of `KVStoreLocal::Push` (`kvstore_local.h:39-55`):
+        # with an updater, the merged value updates the stored weight
+        # (init required); without one it only lands in the merge buffer
+        # (push-before-init is legal pure-aggregation usage)
+        if self._updater is not None:
+            for k in keys:
                 if k not in self._store:
                     raise MXNetError("key %r not initialized" % k)
-                self._updater(k, merged, self._store[k])
+            if len(keys) > 1 and getattr(self._updater, "supports_multi",
+                                         False):
+                self._updater(keys, merged, [self._store[k] for k in keys])
             else:
-                self._merge_buf[k] = merged
+                for k, m in zip(keys, merged):
+                    self._updater(k, m, self._store[k])
+        else:
+            for k, m in zip(keys, merged):
+                self._merge_buf[k] = m
 
     def pull(self, key, out=None, priority=0):
         if out is None:
@@ -138,15 +206,19 @@ class KVStore:
     def set_optimizer(self, optimizer):
         """Install an optimizer as the updater.  In dist mode the reference
         pickles it to the servers (`kvstore.py:231`, `kvstore_server.py:24-56`);
-        locally it becomes a `get_updater` closure."""
-        from .optimizer import get_updater
+        locally it becomes a batch-capable `get_updater` closure: pushed
+        key buckets apply as one fused `update_multi` (per-key under the
+        MXNET_FUSED_UPDATE=0 kill-switch, honored per call, not captured
+        here at install time); donation is off because pull pointer-shares
+        stored weights with the puller's arrays."""
+        from .optimizer import get_fused_updater
 
         if "dist" in self.type and self.rank != 0:
             return
         # exercise the serialization path like the reference (optimizers must
         # remain picklable for the server protocol)
         pickle.loads(pickle.dumps(optimizer))
-        self._set_updater(get_updater(optimizer))
+        self._set_updater(get_fused_updater(optimizer, donate=False))
 
     @property
     def rank(self):

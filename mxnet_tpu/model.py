@@ -25,7 +25,7 @@ from .context import Context, cpu, current_context
 from .executor_manager import DataParallelExecutorManager, _check_arguments
 from .io import DataIter, NDArrayIter
 from .ndarray import NDArray, zeros
-from .optimizer import Optimizer, get_updater
+from .optimizer import Optimizer, fused_update_enabled, get_fused_updater
 from .symbol import Symbol
 
 BASE_ESTIMATOR = object
@@ -65,13 +65,31 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
+def _live_params(param_arrays, grad_arrays):
+    """(index, arg_list, grad_list) triples for params that have grads."""
+    return [(i, a, g)
+            for i, (a, g) in enumerate(zip(param_arrays, grad_arrays))
+            if g[0] is not None]
+
+
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """(`model.py:89-98`) — push grads (priority by layer index so early
-    layers sync first), pull fresh weights."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    """(`model.py:89-98`) — push grads, pull fresh weights.
+
+    Fused default: ONE bucketed push (all keys merged in a single jitted
+    reduce, the updater applied as one `update_multi`) and one bucketed
+    pull, instead of a push+pull pair per parameter.  The reference's
+    priority trick (early layers sync first to overlap comms) is moot
+    in-process where push is synchronous; `MXNET_FUSED_UPDATE=0` restores
+    the per-key loop."""
+    live = _live_params(param_arrays, grad_arrays)
+    if not live:
+        return
+    if fused_update_enabled():
+        keys = [i for i, _, _ in live]
+        kvstore.push(keys, [g for _, _, g in live], priority=0)
+        kvstore.pull(keys, out=[a for _, a, _ in live], priority=0)
+        return
+    for index, arg_list, grad_list in live:
         kvstore.push(index, grad_list, priority=-index)
         kvstore.pull(index, arg_list, priority=-index)
 
@@ -79,11 +97,26 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
     """(`model.py:100-117`) — local update path; with a kvstore, aggregate
-    there first but run the updater per device with faked indices."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    there first but run the updater per device with faked indices.
+
+    With a batch-capable updater (`get_fused_updater`), the whole parameter
+    list is handed to `Optimizer.update_multi` in one jitted dispatch per
+    device — the executor's grad arrays are read directly, with no
+    per-parameter `_set_data` round-trips between Python and XLA."""
+    live = _live_params(param_arrays, grad_arrays)
+    if not live:
+        return
+    if getattr(updater, "supports_multi", False) and fused_update_enabled():
+        if kvstore:
+            keys = [i for i, _, _ in live]
+            kvstore.push(keys, [g for _, _, g in live], priority=0)
+            kvstore.pull(keys, out=[g for _, _, g in live], priority=0)
+        for k in range(num_device):
+            updater([i * num_device + k for i, _, _ in live],
+                    [g[k] for _, _, g in live],
+                    [a[k] for _, a, _ in live])
+        return
+    for index, arg_list, grad_list in live:
         if kvstore:
             kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, grad_list, priority=-index)
@@ -139,7 +172,10 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
 
     updater = None
     if not update_on_kvstore:
-        updater = get_updater(optimizer)
+        # fused multi-tensor updater: one jitted optimizer dispatch per
+        # device per step instead of one per parameter; honors the
+        # MXNET_FUSED_UPDATE=0 kill-switch per call
+        updater = get_fused_updater(optimizer)
     if kvstore:
         _initialize_kvstore(
             kvstore=kvstore,

@@ -13,7 +13,7 @@ from ..executor_manager import DataParallelExecutorGroup, _split_input_slice
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore)
 from ..ndarray import NDArray, zeros
-from ..optimizer import Optimizer, get_updater
+from ..optimizer import Optimizer, get_fused_updater
 from .base_module import BaseModule
 
 
@@ -151,7 +151,11 @@ class Module(BaseModule):
         if update_on_kvstore:
             kvstore.set_optimizer(optimizer)
         else:
-            self._updater = get_updater(optimizer)
+            # fused multi-tensor updater (one jitted dispatch per device
+            # per update()); it honors the MXNET_FUSED_UPDATE=0
+            # kill-switch per call, so installing it unconditionally keeps
+            # mid-session flips working
+            self._updater = get_fused_updater(optimizer)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):

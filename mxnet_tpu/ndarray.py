@@ -34,6 +34,7 @@ import struct
 import numpy as np
 
 from . import engine
+from . import profiler as _profiler
 from .base import MXNetError, check_shape, dtype_flag, np_dtype, numeric_types
 from .context import Context, cpu, current_context
 
@@ -118,11 +119,21 @@ class NDArray:
         if self._parent is not None:
             self._parent._set_data(self._parent.data.at[self._index].set(value))
         else:
-            # keep device placement of the old buffer
-            dev = getattr(self._data, "device", None)
-            value = jnp.asarray(value, dtype=self._data.dtype)
+            # keep device placement of the old buffer; a buffer consumed by
+            # donation (fused train step / update_multi) has no device to
+            # read — its replacement was produced on the right device by
+            # the very program that consumed it, so adopt its placement
+            old = self._data
+            if getattr(old, "is_deleted", None) is not None \
+                    and old.is_deleted():
+                dev = None
+            else:
+                dev = getattr(old, "device", None)
+            value = jnp.asarray(value, dtype=old.dtype)
             if dev is not None and getattr(value, "device", None) != dev:
                 value = jax.device_put(value, dev)
+                _profiler.record_dispatch("ndarray.set_data",
+                                          kind="transfer")
             self._data = value
 
     # -- properties -------------------------------------------------------
@@ -180,6 +191,7 @@ class NDArray:
     def asnumpy(self) -> np.ndarray:
         """Copy to a numpy array; a synchronization point like the reference
         (`ndarray.py` asnumpy -> `MXNDArraySyncCopyToCPU`)."""
+        _profiler.record_dispatch("ndarray.asnumpy", kind="transfer")
         return np.asarray(self.data)
 
     def asscalar(self):
@@ -225,7 +237,15 @@ class NDArray:
         return NDArray(None, _parent=self, _index=slice(start, stop))
 
     def reshape(self, shape):
-        """Reshaped view sharing data (`ndarray.h:241-250`)."""
+        """Return a reshaped **independent copy** of this array.
+
+        The reference's `Reshape` (`ndarray.h:241-250`) returns a zero-copy
+        view; XLA buffers are immutable, so here the result owns its own
+        buffer and writes to it do NOT propagate back to this array.  (XLA
+        aliases the memory until either array is written, so the copy is
+        free until mutation.)  For write-through aliasing over axis 0 use
+        `slice()` / `__getitem__`, whose views write through to the
+        parent."""
         shape = check_shape(shape)
         return NDArray(jnp.reshape(self.data, shape))
 

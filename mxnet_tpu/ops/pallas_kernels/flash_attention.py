@@ -1650,10 +1650,20 @@ def _bsd_structure(q, num_heads, kv_len):
     the loop kernels win wherever their whole-K/V VMEM residency fits
     (round-5: 52.6% vs 41.9% MFU at S=4096) and the grid-streamed
     kernels take over beyond the cap (S=8192: 46.9% MFU vs a jnp-scan
-    fallback — auto-promotion instead of silently losing 5x)."""
+    fallback — auto-promotion instead of silently losing 5x).
+
+    Unrecognized values raise (readable-failure contract of the
+    MXNET_FLASH_IMPL pins): a typo like 'streamed' must not silently
+    change which kernel a pinned A/B run measures."""
     raw = _os.environ.get("MXNET_FLASH_BSD_KERNEL")
     if raw in ("loop", "stream"):
         return raw
+    if raw not in (None, "", "auto"):
+        from ...base import MXNetError
+
+        raise MXNetError(
+            "MXNET_FLASH_BSD_KERNEL must be 'loop', 'stream' or "
+            "unset/'auto', got %r" % raw)
     return "loop" if _bsd_loop_fits_vmem(q, num_heads, kv_len) \
         else "stream"
 
@@ -1765,6 +1775,20 @@ def flash_attention_bsd(q, k, v, num_heads, *, causal=False, scale=None,
             and q.shape[1] * skv >= 512 * 512) else "jnp_t"
     if impl == "pallas_bsd":
         structure = _bsd_structure(q, num_heads, skv)
+        if forced == "pallas_bsd" and \
+                _os.environ.get("MXNET_FLASH_BSD_KERNEL") not in (
+                    "loop", "stream"):
+            # a pinned impl with an auto-resolved structure can silently
+            # mix two kernel structures across shapes in recorded evidence
+            # (round-5 ADVICE); surface which one this shape resolved to
+            import logging
+
+            logging.getLogger(__name__).info(
+                "MXNET_FLASH_IMPL=pallas_bsd pinned: auto-resolved kernel "
+                "structure '%s' for S=%dx%d head_dim=%d (set "
+                "MXNET_FLASH_BSD_KERNEL=loop|stream to pin the structure "
+                "for A/B runs)",
+                structure, q.shape[1], skv, q.shape[-1] // num_heads)
         if structure == "stream":
             impl = "pallas_bsd_gs"
         elif not _bsd_loop_fits_vmem(q, num_heads, skv):

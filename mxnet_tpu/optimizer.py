@@ -5,27 +5,70 @@ Registry + the reference's optimizer set: SGD (momentum/clip/rescale), SGLD,
 ccSGD (alias of SGD — the C++ fused impl is here the XLA-fused one), Adam,
 AdaGrad, RMSProp, AdaDelta, Test (used by distributed closed-form oracles).
 
-TPU-first: each `update` is a pure jitted kernel over (weight, grad, state);
-XLA fuses the whole update chain into one HBM-bandwidth-bound pass — the
+TPU-first: each `update` is a pure kernel over (weight, grad, state); XLA
+fuses the whole update chain into one HBM-bandwidth-bound pass — the
 reference needed a hand-written CUDA kernel (`sgd.cu`) for the same effect.
 Per-parameter lr/wd multipliers, `param_idx2name`, lr schedulers and
 `get_updater` keep reference semantics so KVStore updaters work unchanged.
+
+Multi-tensor apply (`update_multi` / `get_fused_updater`): the per-parameter
+`update` issues O(n_params) small dispatches per training step from Python —
+the exact overhead the reference built its async engine to hide.  Every
+optimizer's math lives in a pure `_update_math(w, g, state, scalars, key)`;
+`update` runs it eagerly per key, while `update_multi` traces it once over
+the whole parameter list into ONE jitted program with weight/state buffers
+donated (the Horovod-bucket / PyTorch-`foreach` idea).  Host-side scalar
+coefficients (lr/wd multiplier folds, Adam's bias correction) are computed
+identically in both paths, so fused vs per-key updates are bit-for-bit
+equal.  `MXNET_FUSED_UPDATE=0` kill-switches every fused call site back to
+the per-key path.
 """
 from __future__ import annotations
 
 import math
+import os
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, silence_cpu_donation_warning
 from .ndarray import NDArray, zeros
+from . import profiler
 from . import random as _random
 
 __all__ = ["Optimizer", "SGD", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
-           "AdaDelta", "Test", "create", "get_updater", "register"]
+           "AdaDelta", "Test", "create", "get_updater", "get_fused_updater",
+           "fused_update_enabled", "register"]
+
+
+def fused_update_enabled():
+    """The MXNET_FUSED_UPDATE kill-switch (default ON).  Read per call so
+    tests and debugging sessions can flip it without rebuilding objects."""
+    return os.environ.get("MXNET_FUSED_UPDATE", "1").lower() not in (
+        "0", "false", "no")
+
+
+def _state_arrays(state):
+    """NDArray state -> raw jax array pytree (None passes through)."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(None if s is None else s.data for s in state)
+    return state.data
+
+
+def _store_state(state, new_state):
+    """Write `_update_math`'s state result back into the NDArray slots."""
+    if state is None:
+        return
+    if isinstance(state, (tuple, list)):
+        for s, n in zip(state, new_state):
+            if s is not None:
+                s._set_data(n)
+    else:
+        state._set_data(new_state)
 
 
 class Optimizer:
@@ -68,9 +111,11 @@ class Optimizer:
         """Pickle support for kvstore set_optimizer (the reference ships
         pickled optimizers to servers, `kvstore.py:231`): drop the Symbol
         reference — its op objects hold jax callables that don't pickle,
-        and the lr/wd multiplier dicts it seeded are already materialized."""
+        and the lr/wd multiplier dicts it seeded are already materialized.
+        The cached update jits are likewise rebuilt on demand."""
         state = self.__dict__.copy()
         state["sym"] = None
+        state.pop("_jit_cache", None)
         return state
 
     # -- multipliers (optimizer.py:124-170) -------------------------------
@@ -127,8 +172,165 @@ class Optimizer:
     def create_state(self, index, weight):
         raise NotImplementedError()
 
-    def update(self, index, weight, grad, state):
+    # -- per-key / multi-tensor update drivers -----------------------------
+    #
+    # Subclasses implement the pure math once; `update` (jitted per key)
+    # and `update_multi` (one jitted program over the whole list) share it.
+    # Scalar coefficients are computed HOST-side in `_step_scalars` with
+    # python-float arithmetic in both paths, then fed to the trace as f32
+    # array elements in BOTH paths — identical host rounding plus identical
+    # per-parameter HLO is what makes fused vs per-key updates bit-for-bit
+    # equal (eager per-primitive execution would differ in the last ulp
+    # from XLA's fused/FMA'd whole-chain compilation).
+
+    def _step_scalars(self, index):
+        """Host-side per-parameter scalar coefficients for one update, in
+        the exact order the reference's update() resolves them (multipliers
+        against the pre-increment num_update, then the count bump)."""
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        return (lr, wd)
+
+    def _needs_key(self):
+        """Whether `_update_math` consumes a PRNG key (SGLD noise,
+        stochastic-rounded bf16 state)."""
+        return False
+
+    # attrs recomputed host-side every call (never traced) or mutated per
+    # step — excluded from the trace key so they don't thrash the cache
+    _UNTRACED_ATTRS = frozenset(("lr", "wd", "num_update", "sym",
+                                 "lr_scheduler"))
+
+    def _trace_key(self):
+        """Fingerprint of the hyperparameters that get captured as
+        constants inside the cached jitted updates (rescale_grad,
+        clip_gradient, momentum, betas, ...).  Mutating one mid-training —
+        e.g. ``opt.rescale_grad = 1.0 / new_batch`` — must invalidate the
+        cache, because the eager path honored such mutations every call.
+        lr/wd and the multiplier dicts flow through `_step_scalars`
+        host-side on every call and never enter a trace."""
+        items = []
+        for k, v in self.__dict__.items():
+            if k.startswith("_") or k in self._UNTRACED_ATTRS:
+                continue
+            if isinstance(v, (int, float, bool, str, bytes,
+                              type(None), type)) or \
+                    isinstance(v, np.dtype):
+                items.append((k, v))
+        return tuple(sorted(items, key=lambda kv: kv[0]))
+
+    def _jit_for(self, kind, build):
+        """Cached jitted update program for `kind`, invalidated whenever
+        the traced hyperparameters change."""
+        tk = self._trace_key()
+        cache = getattr(self, "_jit_cache", None)
+        if cache is None or cache[0] != tk:
+            cache = (tk, {})
+            self._jit_cache = cache
+        fn = cache[1].get(kind)
+        if fn is None:
+            fn = build()
+            cache[1][kind] = fn
+        return fn
+
+    def _update_math(self, w, g, state, scalars, key=None):
+        """Pure per-parameter update: (new_weight, new_state) from raw jax
+        arrays.  Traced under both `update` (alone) and `update_multi`
+        (over the whole parameter list)."""
         raise NotImplementedError()
+
+    def update(self, index, weight, grad, state):
+        scalars = tuple(float(s) for s in self._step_scalars(index))
+        key = _random.next_key() if self._needs_key() else None
+        nscal = len(scalars)
+
+        def build():
+            def apply(w, g, s, sc, k):
+                # scalars cast to the weight dtype, like the weak-typed
+                # python floats of the old eager path; the result cast
+                # back keeps bf16 weights bf16 inside the program instead
+                # of paying an eager f32->bf16 cast per parameter
+                scal = tuple(sc[j].astype(w.dtype) for j in range(nscal))
+                nw, ns = self._update_math(w, g, s, scal, key=k)
+                return nw.astype(w.dtype), ns
+
+            return jax.jit(apply)
+
+        new_w, new_state = self._jit_for("single", build)(
+            weight.data, grad.data, _state_arrays(state),
+            jnp.asarray(scalars, jnp.float32), key)
+        _store_state(state, new_state)
+        weight._set_data(new_w)
+        profiler.record_dispatch("optimizer.update")
+
+    def update_multi(self, indices, weights, grads, states, donate=True):
+        """Multi-tensor apply: update MANY parameters in ONE jitted
+        dispatch (weights/states buffers donated when safe).
+
+        Equivalent to calling `update(i, w, g, s)` over the lists in order
+        — bit-for-bit, including lr/wd multipliers, schedulers and update
+        counts — but issues a single XLA program instead of O(n_params)
+        small ones.  ``donate=False`` keeps the input buffers alive for
+        callers whose weight arrays alias other live NDArrays (the KVStore
+        pull path shares buffers between the store and executor args)."""
+        indices = list(indices)
+        if not indices:
+            return
+        scalars, keys = [], []
+        for i in indices:
+            scalars.append(tuple(float(s) for s in self._step_scalars(i)))
+            keys.append(_random.next_key() if self._needs_key() else None)
+        w_arrs = [w.data for w in weights]
+        g_arrs = [g.data for g in grads]
+        s_arrs = [_state_arrays(s) for s in states]
+        sc = jnp.asarray(scalars, jnp.float32)  # (n, k): one transfer
+        key_arr = jnp.stack(keys) if keys[0] is not None else None
+
+        if donate:
+            # donating the same buffer twice is invalid: optimizers whose
+            # state aliases the weight (Test) fall back to the keep path
+            seen, dup = set(), False
+            for a in w_arrs + [x for s in s_arrs if s is not None
+                               for x in (s if isinstance(s, tuple) else (s,))]:
+                if a is None:
+                    continue
+                if id(a) in seen:
+                    dup = True
+                    break
+                seen.add(id(a))
+            donate = not dup
+
+        nscal = len(scalars[0])
+
+        def build(donate=donate):
+            def apply(ws, gs, ss, sc, key_arr):
+                new_ws, new_ss = [], []
+                for i in range(len(ws)):
+                    # same weak-float-like scalar/result dtype handling as
+                    # the per-key driver in `update` — the two must stay
+                    # bit-for-bit identical per parameter
+                    scal = tuple(sc[i, j].astype(ws[i].dtype)
+                                 for j in range(nscal))
+                    k = key_arr[i] if key_arr is not None else None
+                    nw, ns = self._update_math(ws[i], gs[i], ss[i], scal,
+                                               key=k)
+                    new_ws.append(nw.astype(ws[i].dtype))
+                    new_ss.append(ns)
+                return new_ws, new_ss
+
+            return jax.jit(apply, donate_argnums=(0, 2) if donate else ())
+
+        if donate:
+            silence_cpu_donation_warning()
+        fused = self._jit_for("multi_donate" if donate else "multi_keep",
+                              build)
+        new_ws, new_ss = fused(w_arrs, g_arrs, s_arrs, sc, key_arr)
+        for w, nw in zip(weights, new_ws):
+            w._set_data(nw)
+        for s, ns in zip(states, new_ss):
+            _store_state(s, ns)
+        profiler.record_dispatch("optimizer.update_multi")
 
 
 @Optimizer.register
@@ -147,18 +349,13 @@ class SGD(Optimizer):
             return None
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess(grad.data)
-        w = weight.data
+    def _update_math(self, w, g, state, scalars, key=None):
+        lr, wd = scalars
+        g = self._preprocess(g)
         if state is not None:
-            mom = self.momentum * state.data - lr * (g + wd * w)
-            state._set_data(mom)
-            weight._set_data(w + mom)
-        else:
-            weight._set_data(w - lr * (g + wd * w))
+            mom = self.momentum * state - lr * (g + wd * w)
+            return w + mom, mom
+        return w - lr * (g + wd * w), None
 
 
 class ccSGD(SGD):
@@ -179,14 +376,22 @@ class SGLD(Optimizer):
     def create_state(self, index, weight):
         return None
 
-    def update(self, index, weight, grad, state):
+    def _needs_key(self):
+        return True
+
+    def _step_scalars(self, index):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         self._update_count(index)
-        g = self._preprocess(grad.data)
-        w = weight.data
-        noise = jax.random.normal(_random.next_key(), w.shape, w.dtype) * math.sqrt(lr)
-        weight._set_data(w - lr / 2 * (g + wd * w) + noise)
+        # sqrt/halving stay host-side python-float math so the fused and
+        # per-key paths multiply by bit-identical coefficients
+        return (lr / 2, wd, math.sqrt(lr))
+
+    def _update_math(self, w, g, state, scalars, key=None):
+        half_lr, wd, sqrt_lr = scalars
+        g = self._preprocess(g)
+        noise = jax.random.normal(key, w.shape, w.dtype) * sqrt_lr
+        return w - half_lr * (g + wd * w) + noise, None
 
 
 def stochastic_round_bf16(x, key):
@@ -229,25 +434,35 @@ class Adam(Optimizer):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=self.v_dtype))
 
-    def update(self, index, weight, grad, state):
+    def _needs_key(self):
+        return self.v_dtype == jnp.bfloat16
+
+    def _step_scalars(self, index):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         self._update_count(index)
         t = self._index_update_count[index]
-        mean, var = state
-        g = self._preprocess(grad.data) + wd * weight.data
-        m = self.beta1 * mean.data + (1 - self.beta1) * g
-        v = (self.beta2 * var.data.astype(jnp.float32)
-             + (1 - self.beta2) * jnp.square(g))
-        mean._set_data(m)
-        if self.v_dtype == jnp.bfloat16:
-            var._set_data(stochastic_round_bf16(v, _random.next_key()))
-        else:
-            var._set_data(v.astype(self.v_dtype))
+        # bias correction in host python-float math (f64), exactly like the
+        # reference — computing it traced in f32 would break the fused
+        # path's bit-for-bit parity with per-key updates
         coef1 = 1 - self.beta1 ** t
         coef2 = 1 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
-        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+        return (lr_t, wd)
+
+    def _update_math(self, w, g, state, scalars, key=None):
+        lr_t, wd = scalars
+        mean, var = state
+        g = self._preprocess(g) + wd * w
+        m = self.beta1 * mean + (1 - self.beta1) * g
+        v = (self.beta2 * var.astype(jnp.float32)
+             + (1 - self.beta2) * jnp.square(g))
+        if self.v_dtype == jnp.bfloat16:
+            v_store = stochastic_round_bf16(v, key)
+        else:
+            v_store = v.astype(self.v_dtype)
+        new_w = w - lr_t * m / (jnp.sqrt(v) + self.epsilon)
+        return new_w, (m, v_store)
 
 
 @Optimizer.register
@@ -261,17 +476,12 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context, dtype=weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess(grad.data)
-        hist = state.data + jnp.square(g)
-        state._set_data(hist)
-        weight._set_data(
-            weight.data
-            - lr * (g / jnp.sqrt(hist + self.float_stable_eps) + wd * weight.data)
-        )
+    def _update_math(self, w, g, state, scalars, key=None):
+        lr, wd = scalars
+        g = self._preprocess(g)
+        hist = state + jnp.square(g)
+        new_w = w - lr * (g / jnp.sqrt(hist + self.float_stable_eps) + wd * w)
+        return new_w, hist
 
 
 @Optimizer.register
@@ -289,21 +499,16 @@ class RMSProp(Optimizer):
                 zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
                 zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
+    def _update_math(self, w, g, state, scalars, key=None):
+        lr, wd = scalars
         n, gbar, delta = state
-        g = self._preprocess(grad.data) + wd * weight.data
-        n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
-        g_new = (1 - self.gamma1) * g + self.gamma1 * gbar.data
-        d_new = self.gamma2 * delta.data - lr * (
+        g = self._preprocess(g) + wd * w
+        n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+        g_new = (1 - self.gamma1) * g + self.gamma1 * gbar
+        d_new = self.gamma2 * delta - lr * (
             g / jnp.sqrt(n_new - jnp.square(g_new) + 1e-4)
         )
-        n._set_data(n_new)
-        gbar._set_data(g_new)
-        delta._set_data(d_new)
-        weight._set_data(weight.data + d_new)
+        return w + d_new, (n_new, g_new, d_new)
 
 
 @Optimizer.register
@@ -319,20 +524,17 @@ class AdaDelta(Optimizer):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess(grad.data)
+    def _update_math(self, w, g, state, scalars, key=None):
+        wd = scalars[1]  # AdaDelta has no lr (reference semantics)
+        g = self._preprocess(g)
         acc_g, acc_delta = state
-        ag = self.rho * acc_g.data + (1 - self.rho) * jnp.square(g)
+        ag = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
         current_delta = (
-            jnp.sqrt(acc_delta.data + self.epsilon)
+            jnp.sqrt(acc_delta + self.epsilon)
             / jnp.sqrt(ag + self.epsilon)
         ) * g
-        ad = self.rho * acc_delta.data + (1 - self.rho) * jnp.square(current_delta)
-        acc_g._set_data(ag)
-        acc_delta._set_data(ad)
-        weight._set_data(weight.data - current_delta - wd * weight.data)
+        ad = self.rho * acc_delta + (1 - self.rho) * jnp.square(current_delta)
+        return w - current_delta - wd * w, (ag, ad)
 
 
 @Optimizer.register
@@ -344,9 +546,13 @@ class Test(Optimizer):
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context)
 
-    def update(self, index, weight, grad, state):
-        weight._set_data(weight.data + grad.data * self.rescale_grad)
-        state._set_data(weight.data)
+    def _step_scalars(self, index):
+        # the reference's Test.update tracks no counts/lr; keep that
+        return ()
+
+    def _update_math(self, w, g, state, scalars, key=None):
+        new_w = w + g * self.rescale_grad
+        return new_w, new_w
 
 
 create = Optimizer.create_optimizer
@@ -364,4 +570,42 @@ def get_updater(optimizer):
 
     updater.optimizer = optimizer
     updater.states = states
+    return updater
+
+
+def get_fused_updater(optimizer, donate=True):
+    """`get_updater`-compatible closure with a multi-tensor batch form.
+
+    Called with scalar ``(index, grad, weight)`` it behaves exactly like
+    `get_updater`'s closure; called with LISTS it applies
+    `Optimizer.update_multi` — one jitted dispatch for the whole bucket.
+    The `MXNET_FUSED_UPDATE` kill-switch is honored PER CALL: flipping it
+    to 0 mid-session drops list-form calls back to per-key `update`
+    dispatches without rebuilding the updater (so every install site —
+    Module, FeedForward, KVStore — bisects the same way).
+    ``donate=False`` for stores whose weight buffers alias other live
+    arrays (KVStore: pull pointer-shares the stored weight with executor
+    args, so donating the store's buffer would invalidate them)."""
+    states = {}
+
+    def updater(index, grad, weight):
+        if isinstance(index, (list, tuple)):
+            for i, w in zip(index, weight):
+                if i not in states:
+                    states[i] = optimizer.create_state(i, w)
+            if not fused_update_enabled():
+                for i, g, w in zip(index, grad, weight):
+                    optimizer.update(i, w, g, states[i])
+                return
+            optimizer.update_multi(list(index), list(weight), list(grad),
+                                   [states[i] for i in index],
+                                   donate=donate)
+            return
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+
+    updater.optimizer = optimizer
+    updater.states = states
+    updater.supports_multi = True
     return updater

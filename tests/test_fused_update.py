@@ -1,0 +1,369 @@
+"""Fused legacy training hot path (multi-tensor optimizer apply).
+
+Covers the ISSUE-1 acceptance criteria:
+
+* dispatch-count regression — a legacy `Module`/`FeedForward` fit step
+  issues a CONSTANT number of jitted dispatches per batch regardless of
+  parameter count (the per-key path issues >= n_params), asserted CPU-only
+  via `profiler.count_dispatches`;
+* fused-vs-per-key parity — `Optimizer.update_multi` matches per-key
+  `update` bit-for-bit for SGD-momentum and Adam, including lr/wd
+  multipliers and `clip_gradient`;
+* the `MXNET_FUSED_UPDATE=0` kill-switch;
+* `KVStore` bucketed push/pull;
+* `Executor.reshape` grad dtype / group2ctx propagation;
+* `MXNET_FLASH_BSD_KERNEL` unrecognized-value hygiene.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.optimizer import (SGD, Adam, get_fused_updater, get_updater)
+
+
+def _mlp(layers, num_classes=4):
+    net = mx.sym.Variable("data")
+    for i in range(layers):
+        net = mx.sym.FullyConnected(data=net, name="fc%d" % i, num_hidden=16)
+        net = mx.sym.Activation(data=net, name="act%d" % i, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="out", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _data(n=64, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    y = (np.arange(n) % 4).astype(np.float32)
+    return X, y
+
+
+def _module_step_dispatches(layers, batch=32):
+    """Jitted-dispatch count of one warm forward/backward/update step."""
+    mx.random.seed(0)
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    mod = mx.mod.Module(_mlp(layers), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    b = next(iter(it))
+    mod.forward(b)
+    mod.backward()
+    mod.update()  # warm: everything compiled
+    with profiler.count_dispatches() as d:
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+    return d, len(mod._param_names)
+
+
+def test_module_step_dispatches_constant_in_nparams():
+    d_small, n_small = _module_step_dispatches(1)
+    d_big, n_big = _module_step_dispatches(6)
+    assert n_big - n_small == 10  # 5 extra layers x (weight, bias)
+    assert d_small.jit_entries == d_big.jit_entries, (
+        d_small.as_dict(), d_big.as_dict())
+    # fwd+bwd fuse into one train_step program + one update_multi
+    assert d_big.jit_entries <= 4, d_big.as_dict()
+
+
+def test_per_key_path_scales_with_nparams(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_UPDATE", "0")
+    d_small, n_small = _module_step_dispatches(1)
+    d_big, n_big = _module_step_dispatches(6)
+    assert d_small.jit_entries >= n_small + 1
+    assert d_big.jit_entries >= n_big + 1
+    assert d_big.jit_entries > d_small.jit_entries
+
+
+def _fit_dispatches(layers):
+    """Whole legacy FeedForward.fit epoch under the dispatch counter."""
+    mx.random.seed(0)
+    X, y = _data(n=128)
+    model = mx.model.FeedForward(
+        symbol=_mlp(layers), ctx=mx.cpu(), num_epoch=1, learning_rate=0.1,
+        momentum=0.9, numpy_batch_size=32)
+    with profiler.count_dispatches() as d:
+        model.fit(X, y)
+    return d
+
+
+def test_feedforward_fit_dispatches_constant_in_nparams():
+    d1 = _fit_dispatches(1)
+    d6 = _fit_dispatches(6)
+    assert d1.jit_entries == d6.jit_entries, (d1.as_dict(), d6.as_dict())
+
+
+def test_kill_switch_matches_fused_training(monkeypatch):
+    def run():
+        mx.random.seed(3)
+        X, y = _data(n=128, seed=3)
+        it = mx.io.NDArrayIter(X, y, batch_size=32)
+        mod = mx.mod.Module(_mlp(2), context=mx.cpu())
+        mod.fit(it, num_epoch=2,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    fused = run()
+    monkeypatch.setenv("MXNET_FUSED_UPDATE", "0")
+    per_key = run()
+    for k in fused:
+        np.testing.assert_array_equal(fused[k], per_key[k], err_msg=k)
+
+
+def test_kill_switch_flips_mid_session(monkeypatch):
+    """MXNET_FUSED_UPDATE is honored per call: flipping it to 0 AFTER
+    init_optimizer must drop the installed updater back to per-key
+    dispatches (bisection contract of the kill-switch)."""
+    mx.random.seed(0)
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(2), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    b = next(iter(it))
+    mod.forward(b)
+    mod.backward()
+    mod.update()
+    with profiler.count_dispatches() as d:
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+    assert d.by_site.get("optimizer.update_multi") == 1, d.as_dict()
+    monkeypatch.setenv("MXNET_FUSED_UPDATE", "0")
+    with profiler.count_dispatches() as d:
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+    assert "optimizer.update_multi" not in d.by_site, d.as_dict()
+    assert d.by_site.get("optimizer.update", 0) == len(mod._param_names)
+
+
+def test_update_between_forward_and_backward_replays_live_buffers():
+    """`update_multi` donates the bound weights; a pending lazy training
+    forward snapshot taken before the update must not feed those deleted
+    buffers back to XLA (regression: ValueError 'Invalid buffer passed:
+    buffer has been deleted or donated').  The replay re-gathers and runs
+    on the post-update weights — the eager recompute semantics."""
+    mx.random.seed(0)
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(2), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    b = next(iter(it))
+    mod.forward(b)
+    mod.backward()
+    mod.update()   # donates the weights the pending snapshot below holds
+    mod.forward(b)
+    mod.update()   # pathological order: update between forward and backward
+    mod.backward()
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all()
+    # same for the outputs-before-backward replay path
+    mod.forward(b)
+    mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# fused vs per-key optimizer parity (bit-for-bit)
+# ---------------------------------------------------------------------------
+
+_SHAPES = [(4, 3), (3,), (8,), (2, 2, 2)]
+_IDX2NAME = {0: "p0_weight", 1: "p0_bias", 2: "p1_gamma", 3: "p2_weight"}
+
+
+def _run_updates(make_opt, fused, steps=4, seed=5):
+    rng = np.random.RandomState(seed)
+    init_w = [rng.randn(*s).astype(np.float32) for s in _SHAPES]
+    grads = [[rng.randn(*s).astype(np.float32) for s in _SHAPES]
+             for _ in range(steps)]
+    mx.random.seed(seed)
+    opt = make_opt()
+    upd = get_fused_updater(opt) if fused else get_updater(opt)
+    ws = [mx.nd.array(w) for w in init_w]
+    for step_grads in grads:
+        gs = [mx.nd.array(g) for g in step_grads]
+        if fused:
+            upd(list(range(len(ws))), gs, ws)
+        else:
+            for i in range(len(ws)):
+                upd(i, gs[i], ws[i])
+    return [w.asnumpy() for w in ws]
+
+
+def _assert_parity(make_opt):
+    per_key = _run_updates(make_opt, fused=False)
+    fused = _run_updates(make_opt, fused=True)
+    for i, (a, b) in enumerate(zip(per_key, fused)):
+        np.testing.assert_array_equal(a, b, err_msg="param %d" % i)
+
+
+def test_sgd_momentum_fused_parity():
+    def make():
+        opt = SGD(learning_rate=0.05, momentum=0.9, wd=0.01,
+                  clip_gradient=0.5, rescale_grad=1.0 / 8,
+                  param_idx2name=_IDX2NAME)
+        opt.set_lr_mult({"p0_weight": 0.5})
+        opt.set_wd_mult({"p2_weight": 2.0})
+        return opt
+
+    _assert_parity(make)
+
+
+def test_adam_fused_parity():
+    def make():
+        opt = Adam(learning_rate=0.002, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, wd=0.01, clip_gradient=0.5,
+                   rescale_grad=1.0 / 8, param_idx2name=_IDX2NAME)
+        opt.set_lr_mult({"p0_weight": 0.25})
+        opt.set_wd_mult({"p2_weight": 2.0})
+        return opt
+
+    _assert_parity(make)
+
+
+def test_fused_updater_single_key_compatible():
+    """The fused updater keeps get_updater's scalar calling convention."""
+    opt = SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    upd = get_fused_updater(opt)
+    w, g = mx.nd.array([1.0]), mx.nd.array([1.0])
+    upd(0, g, w)
+    assert 0 in upd.states
+    np.testing.assert_allclose(w.asnumpy(), [0.9], rtol=1e-6)
+
+
+def test_update_multi_lazy_scheduler_counts():
+    """update_multi must advance update counts / num_update like the
+    per-key loop (schedulers key off them)."""
+    opt = SGD(learning_rate=1.0, momentum=0.0, rescale_grad=1.0)
+    upd = get_fused_updater(opt)
+    ws = [mx.nd.array([0.0]), mx.nd.array([0.0])]
+    gs = [mx.nd.array([1.0]), mx.nd.array([1.0])]
+    upd([0, 1], gs, ws)
+    upd([0, 1], gs, ws)
+    assert opt._index_update_count == {0: 2, 1: 2}
+    assert opt.num_update == 2
+
+
+# ---------------------------------------------------------------------------
+# KVStore bucketed batch API
+# ---------------------------------------------------------------------------
+
+def test_kvstore_bucketed_aggregation_matches_per_key():
+    keys = [3, 5, 9]
+    devs = [mx.cpu(i) for i in range(3)]
+
+    def grads(seed):
+        rng = np.random.RandomState(seed)
+        return {k: [mx.nd.array(rng.randn(4, 4).astype(np.float32), ctx=d)
+                    for d in devs] for k in keys}
+
+    kv_a, kv_b = mx.kv.create("local"), mx.kv.create("local")
+    g = grads(0)
+    kv_a.push(keys, [g[k] for k in keys])           # one bucketed push
+    for k in keys:                                   # per-key reference
+        kv_b.push(k, g[k])
+    for kv in (kv_a, kv_b):
+        outs = [mx.nd.zeros((4, 4)) for _ in keys]
+        kv.pull(keys, out=outs)
+        for k, o in zip(keys, outs):
+            ref = sum(x.asnumpy() for x in g[k])
+            np.testing.assert_allclose(o.asnumpy(), ref, rtol=1e-6)
+
+
+def test_kvstore_bucketed_push_applies_fused_updater():
+    kv = mx.kv.create("local")
+    keys = [0, 1]
+    for k in keys:
+        kv.init(k, mx.nd.ones((2, 2)))
+    kv.set_optimizer(mx.opt.create("test", rescale_grad=1.0))
+    with profiler.count_dispatches() as d:
+        kv.push(keys, [mx.nd.ones((2, 2)) * 2, mx.nd.ones((2, 2)) * 3])
+    outs = [mx.nd.zeros((2, 2)) for _ in keys]
+    kv.pull(keys, out=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), np.ones((2, 2)) * 3)
+    np.testing.assert_allclose(outs[1].asnumpy(), np.ones((2, 2)) * 4)
+    # the whole bucket applied as ONE update_multi dispatch
+    assert d.by_site.get("optimizer.update_multi") == 1, d.as_dict()
+
+
+def test_kvstore_push_missing_key_with_updater_raises():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((2,)))
+    kv.set_optimizer(mx.opt.create("test"))
+    with pytest.raises(mx.base.MXNetError):
+        kv.push([0, 1], [mx.nd.ones((2,)), mx.nd.ones((2,))])
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions
+# ---------------------------------------------------------------------------
+
+def test_executor_reshape_preserves_grad_dtype_and_nulls():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc", num_hidden=4)
+    arg_shapes, _, _ = net.infer_shape(data=(4, 8))
+    names = net.list_arguments()
+    args = [mx.nd.zeros(s, dtype="bfloat16") for s in arg_shapes]
+    grads = {"fc_weight": mx.nd.zeros(
+        arg_shapes[names.index("fc_weight")], dtype="bfloat16")}
+    exe = net.bind(mx.cpu(), args, args_grad=grads,
+                   group2ctx={"dev": mx.cpu(1)})
+    exe2 = exe.reshape(data=(8, 8))
+    gd = exe2.grad_dict
+    assert gd["fc_weight"].dtype == np.dtype("bfloat16")
+    assert gd["data"] is None and gd["fc_bias"] is None
+    assert exe2.arg_dict["data"].shape == (8, 8)
+    assert exe2._group2ctx == {"dev": mx.cpu(1)}
+
+
+def test_shared_aux_buffer_backward_no_double_donation():
+    """Two aux states bound to ONE underlying buffer must not be donated
+    twice into the fused train step (regression: XlaRuntimeError 'Attempt
+    to donate the same buffer twice in Execute()')."""
+    from mxnet_tpu.ndarray import NDArray
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data=data, name="bn")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(4, 3))
+    args = [mx.nd.ones(s) for s in arg_shapes]
+    grads = [mx.nd.zeros(s) for s in arg_shapes]
+    z = mx.nd.zeros(aux_shapes[0])
+    shared_aux = [NDArray(z.data) for _ in aux_shapes]  # one buffer, twice
+    exe = net.bind(mx.cpu(), args, args_grad=grads, aux_states=shared_aux)
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.isfinite(exe.outputs[0].asnumpy()).all()
+
+
+def test_flash_bsd_kernel_env_typo_raises(monkeypatch):
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_mod as fa
+
+    q = np.zeros((1, 128, 128), np.float32)
+    monkeypatch.setenv("MXNET_FLASH_BSD_KERNEL", "streamed")
+    with pytest.raises(mx.base.MXNetError):
+        fa._bsd_structure(q, 1, 128)
+    for ok in ("loop", "stream"):
+        monkeypatch.setenv("MXNET_FLASH_BSD_KERNEL", ok)
+        assert fa._bsd_structure(q, 1, 128) == ok
+
+
+def test_ndarray_reshape_returns_independent_copy():
+    a = mx.nd.array(np.arange(6, dtype=np.float32))
+    b = a.reshape((2, 3))
+    b[:] = np.zeros((2, 3), np.float32)
+    np.testing.assert_allclose(a.asnumpy(), np.arange(6, dtype=np.float32))
