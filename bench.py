@@ -90,8 +90,21 @@ def main():
     import jax
 
     import mxnet_tpu as mx
-    from mxnet_tpu import models
+    from mxnet_tpu import models, telemetry
     from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    # Telemetry stream next to the bench artifacts: per-phase dispatch
+    # counts, retrace events and comm bytes land in bench_results/ so a
+    # BENCH round carries mechanical evidence that nothing recompiled
+    # mid-measurement (render with tools/telemetry_report.py).  Fresh
+    # stream per run.
+    here = os.path.dirname(os.path.abspath(__file__))
+    tel_path = os.path.join(here, "bench_results", "telemetry_bench.jsonl")
+    try:
+        os.remove(tel_path)
+    except OSError:
+        pass
+    telemetry.add_sink(telemetry.JsonlSink(tel_path))
 
     # On-chip Pallas kernel parity gate (VERDICT r3 #3): CI's CPU mesh
     # only ever runs the jnp fallbacks, so kernel correctness is proven
@@ -164,6 +177,7 @@ def main():
     profiler.device_sync(trainer.params)
     trainer.run_steps(dev_batch, steps)
     profiler.device_sync(trainer.params)
+    telemetry.step_report(extra={"phase": "warmup", "bench_steps": 2 * steps})
 
     reps = int(os.environ.get("BENCH_REPS", "5"))
     # median of fixed windows: robust to one-off relay stalls; the ~0.75 s
@@ -172,6 +186,8 @@ def main():
         lambda: trainer.run_steps(dev_batch, steps),
         lambda: trainer.params, reps=max(1, reps // 2),
         windows=3) / steps
+
+    telemetry.step_report(extra={"phase": "timed"})
 
     ips = batch / dt
     ips_chip = ips / n_dev
@@ -260,6 +276,8 @@ def main():
             else:
                 extra["transformer_error"] = str(e)[:200]
     extra["pallas_parity"] = pallas_parity
+    telemetry.step_report(extra={"phase": "end"})
+    extra["telemetry_stream"] = os.path.relpath(tel_path, here)
     if extra:
         result["extra"] = extra
     # persist the measurement so a later capture with the relay down can

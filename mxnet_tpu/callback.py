@@ -79,6 +79,12 @@ class Speedometer:
         if elapsed <= 0 or done <= 0:
             return
         self.last_speed = done * self.batch_size / elapsed
+        # throughput rides the same telemetry stream as dispatch counts,
+        # comm bytes, retraces and health (one JSONL record per step)
+        from . import telemetry
+
+        telemetry.set_gauge("train.samples_per_sec", self.last_speed)
+        telemetry.inc("train.samples", done * self.batch_size)
         metrics = (param.eval_metric.get_name_value()
                    if param.eval_metric is not None else [])
         if not metrics:

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from . import profiler
 from . import random as _random
+from . import telemetry
 from .base import MXNetError, silence_cpu_donation_warning
 from .context import Context
 from .ndarray import NDArray
@@ -229,7 +230,23 @@ def _build_graph_fn(symbol: Symbol):
             for i, oname in enumerate(node.op.list_outputs(node.params)):
                 internal_entries.append(("%s_%s" % (node.name, oname), (id(node), i)))
 
-    return fn, order, internal_entries
+    def _walk_fn(arg_arrays, aux_arrays, rng, is_train):
+        """Plain-walk variant exposing the full env — traceable, so the
+        in-graph Monitor mode can jit one program that returns outputs,
+        new aux AND per-entry stats (always un-segmented: a monitored
+        step wants every internal entry live, which defeats remat
+        anyway, exactly like the eager monitored path)."""
+        env = {}
+        new_aux = list(aux_arrays)
+        for node in order:
+            if node.is_variable:
+                env[(id(node), 0)] = arg_arrays[arg_index[node.name]]
+            else:
+                _run_nodes([node], env, new_aux, rng, is_train)
+        outputs = tuple(env[(id(n), i)] for n, i in heads)
+        return outputs, tuple(new_aux), env
+
+    return fn, order, internal_entries, _walk_fn
 
 
 def _mirror_saveable(prim, *_, **__):
@@ -350,7 +367,8 @@ class Executor:
         # rely on XLA fusion. Recorded for introspection.
         self._group2ctx = group2ctx or {}
 
-        fn, self._order, self._internal_entries = _build_graph_fn(symbol)
+        fn, self._order, self._internal_entries, self._walk_fn = \
+            _build_graph_fn(symbol)
         self._fn = fn
         self._jit_eval = jax.jit(lambda a, x, r: fn(a, x, r, False))
         self._jit_train = jax.jit(lambda a, x, r: fn(a, x, r, True))
@@ -395,6 +413,10 @@ class Executor:
         self._pending = None  # (args, aux, rng) snapshot for lazy train fwd
         self._outputs = None
         self._monitor_cb = None
+        self._monitor_mode = "eager"
+        self._monitor_stat_fn = None
+        self._monitor_active_fn = None
+        self._mon_jits = {}  # is_train -> jitted monitored program
         self._device = self._ctx.jax_device() if self._ctx is not None else None
         # NDArrays verified resident on self._device: `_set_data` preserves
         # device placement, so one check per bound array suffices instead of
@@ -426,6 +448,7 @@ class Executor:
         if self._outputs is None:
             if self._pending is not None:
                 args, aux, rng = self._pending_live()
+                self._watch_retrace("executor.forward[train]", args, aux)
                 outs, new_aux = self._jit_train(args, aux, rng)
                 profiler.record_dispatch("executor.forward")
                 for nd, arr in zip(self.aux_arrays, new_aux):
@@ -435,8 +458,34 @@ class Executor:
                 raise MXNetError("call forward() first")
         return self._outputs
 
-    def set_monitor_callback(self, callback):
+    def set_monitor_callback(self, callback, mode="eager", stat_fn=None,
+                             active_fn=None):
+        """Install a per-output monitor hook.
+
+        mode='eager' (reference semantics): the next forward re-runs the
+        graph un-jitted and calls ``callback(name, NDArray)`` per internal
+        entry — O(n_outputs) python op dispatches plus whatever host
+        fetches the callback's stat function performs.
+
+        mode='ingraph': the stats are computed INSIDE one jitted program
+        (``stat_fn``, a traceable array->scalar function; default
+        |x|.sum()/size like the reference Monitor) and fetched as a single
+        bundle — O(1) dispatches and ONE host transfer per monitored
+        step; ``callback(name, float)`` receives the finished stat.
+
+        ``active_fn`` (ingraph mode): zero-arg predicate consulted each
+        forward — False skips the monitored program entirely, so a
+        Monitor with interval N pays the stats program on 1-in-N steps,
+        not every step."""
+        if mode not in ("eager", "ingraph"):
+            raise MXNetError("monitor mode must be 'eager' or 'ingraph', "
+                             "got %r" % mode)
         self._monitor_cb = callback
+        self._monitor_mode = mode
+        self._monitor_active_fn = active_fn
+        if stat_fn is not self._monitor_stat_fn:
+            self._monitor_stat_fn = stat_fn
+            self._mon_jits = {}
 
     # -- execution ---------------------------------------------------------
     def _gather(self, arrays):
@@ -511,8 +560,17 @@ class Executor:
         self._step += 1
         rng = jax.random.fold_in(self._base_key, self._step)
 
+        monitored = None
         if self._monitor_cb is not None:
-            self._forward_monitored(args, aux, rng, is_train)
+            if self._monitor_mode == "ingraph":
+                # interval gating: an inactive monitor (active_fn False)
+                # costs nothing — the normal jit path below runs instead
+                if self._monitor_active_fn is None \
+                        or self._monitor_active_fn():
+                    monitored = self._forward_monitored_ingraph(
+                        args, aux, rng, is_train)
+            else:
+                self._forward_monitored(args, aux, rng, is_train)
 
         if is_train and self.grad_arrays is not None:
             # Lazy training forward: the actual compute happens in the fused
@@ -522,9 +580,22 @@ class Executor:
             self._pending = (args, aux, rng)
             self._outputs = None
             return _LazyOutputs(self)
-        jit = self._jit_train if is_train else self._jit_eval
-        outs, new_aux = jit(args, aux, rng)
-        profiler.record_dispatch("executor.forward")
+        if monitored is not None:
+            # eval / non-lazy forward: the in-graph monitored program
+            # already produced this step's outputs and aux — no second
+            # forward dispatch.  (The lazy TRAINING path above cannot
+            # reuse them: backward() recomputes in the fused fwd+bwd
+            # program, so a monitored training step pays one extra
+            # forward — still far cheaper than the eager monitor's O(n)
+            # per-op python walk, and only on monitor-interval steps.)
+            outs, new_aux = monitored
+        else:
+            self._watch_retrace("executor.forward[%s]"
+                                % ("train" if is_train else "eval"),
+                                args, aux)
+            jit = self._jit_train if is_train else self._jit_eval
+            outs, new_aux = jit(args, aux, rng)
+            profiler.record_dispatch("executor.forward")
         self._pending = None
         if is_train:
             for nd, arr in zip(self.aux_arrays, new_aux):
@@ -562,6 +633,66 @@ class Executor:
         for name, key in entries:
             if key in env:
                 self._monitor_cb(name, NDArray(env[key]))
+
+    def _monitored_jit(self, is_train):
+        """Jitted (outputs, new_aux, stats) program for the in-graph
+        monitor mode: one dispatch computes every internal entry's stat
+        alongside the normal forward."""
+        fn = self._mon_jits.get(bool(is_train))
+        if fn is None:
+            stat = self._monitor_stat_fn
+            if stat is None:
+                def stat(x):  # reference Monitor's asum: |x|/size
+                    xf = jnp.abs(x.astype(jnp.float32))
+                    return jnp.sum(xf) / max(int(x.size), 1)
+            entries = self._internal_entries
+            walk = self._walk_fn
+
+            def prog(args, aux, rng, _train=bool(is_train)):
+                outs, new_aux, env = walk(args, aux, rng, _train)
+                stats = jnp.stack(
+                    [jnp.asarray(stat(env[k]), jnp.float32)
+                     for _, k in entries])
+                return outs, new_aux, stats
+
+            fn = jax.jit(prog)
+            self._mon_jits[bool(is_train)] = fn
+        return fn
+
+    def _forward_monitored_ingraph(self, args, aux, rng, is_train):
+        """In-graph monitor: ONE jitted dispatch and ONE small host
+        transfer for the whole stat bundle, vs the eager path's O(n)
+        python op dispatches + O(n_outputs) blocking `asnumpy` fetches.
+        Returns (outputs, new_aux) so the caller can reuse the forward."""
+        fn = self._monitored_jit(is_train)
+        self._watch_retrace("executor.forward_monitored[%s]"
+                            % ("train" if is_train else "eval"), args, aux)
+        outs, new_aux, stats = fn(args, aux, rng)
+        profiler.record_dispatch("executor.forward_monitored")
+        vals = np.asarray(stats)
+        profiler.record_dispatch("executor.monitor_fetch", kind="transfer")
+        cb = self._monitor_cb
+        for (name, _), v in zip(self._internal_entries, vals):
+            cb(name, float(v))
+        return outs, new_aux
+
+    def _watch_retrace(self, site, args, aux, cots=None, program=None):
+        """Feed the retrace watchdog one jitted-call signature.  Scoped by
+        the bound Symbol, so executors rebound at a new shape (reshape,
+        bucketing) are recognized as recompiles of the SAME program while
+        unrelated models stay independent."""
+        if not telemetry.retrace_enabled():
+            return
+        sig = telemetry.arrays_signature(args, self._arg_names)
+        sig += telemetry.arrays_signature(
+            aux, ["aux:%s" % n for n in self._aux_names])
+        if cots is not None:
+            sig += telemetry.arrays_signature(
+                cots, ["cot%d" % i for i in range(len(cots))])
+        meta = {"program": program} if program else None
+        telemetry.watch_jit(site, sig,
+                            scope=telemetry.watch_scope(self._symbol),
+                            meta=meta)
 
     def _out_avals(self, args, aux, rng):
         key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
@@ -609,6 +740,12 @@ class Executor:
             )
             # user-supplied cotangent buffers must survive the call
             step = self._jit_train_step_keep
+        # retrace watchdog: the fused train step is THE per-step program —
+        # a shape drift (ragged last batch, rebind) or a fall-off-donation
+        # here is the classic silent throughput cliff
+        self._watch_retrace(
+            "executor.train_step", args, aux, cots=cot,
+            program="donate" if step is self._jit_train_step else "keep")
         outs, new_aux, grads = step(args, aux, rng, cot)
         profiler.record_dispatch("executor.train_step")
         self._pending = None  # aux was donated: forbid replay on stale aux

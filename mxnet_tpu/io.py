@@ -20,10 +20,12 @@ import logging
 import os
 import struct
 import threading
+import time
 import queue as _queue
 
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError, check_shape
 from .ndarray import NDArray, array
 
@@ -371,7 +373,14 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def next(self):
+        # data-iterator wait time: how long the training loop blocked on
+        # the prefetch queue.  Near-zero means the pipeline keeps up; a
+        # step-sized wait means the loop is input-bound — the telemetry
+        # stream's "io.wait_ms" histogram separates the two without a
+        # trace viewer.
+        t0 = time.perf_counter()
         batches = self._queue.get()
+        telemetry.observe("io.wait_ms", 1e3 * (time.perf_counter() - t0))
         if batches is None:
             raise StopIteration
         if len(batches) == 1:

@@ -33,6 +33,7 @@ import pickle
 import jax.numpy as jnp
 
 from . import profiler
+from . import telemetry
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 
@@ -155,6 +156,10 @@ class KVStore:
         `update_multi` dispatch."""
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
+        telemetry.inc("kvstore.push_calls")
+        telemetry.inc("kvstore.push_bytes", sum(
+            int(getattr(v.data, "nbytes", 0))
+            for vlist in vals for v in vlist))
         merged = [NDArray(a) for a in self._merge_batch(vals)] \
             if len(keys) > 1 else [NDArray(self._merge(vals[0]))]
         # semantics of `KVStoreLocal::Push` (`kvstore_local.h:39-55`):
@@ -195,8 +200,11 @@ class KVStore:
                 src = self._store[k]
             else:
                 raise MXNetError("key %r not initialized" % k)
+            telemetry.inc("kvstore.pull_bytes",
+                          int(getattr(src.data, "nbytes", 0)) * len(olist))
             for o in olist:
                 src.copyto(o)
+        telemetry.inc("kvstore.pull_calls")
 
     def _set_updater(self, updater):
         self._updater = updater

@@ -19,6 +19,7 @@ from . import initializer as init_mod
 from . import kvstore as kvs_mod
 from . import metric as metric_mod
 from . import ndarray as nd
+from . import telemetry
 from .base import MXNetError
 from .callback import BatchEndParam
 from .context import Context, cpu, current_context
@@ -226,6 +227,9 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                             cb(p)
                     else:
                         batch_end_callback(p)
+                # one telemetry record per step (free until a sink is
+                # attached via MXNET_TELEMETRY_JSONL or add_sink)
+                telemetry.step_end(extra={"epoch": epoch, "nbatch": nbatch})
                 if epoch_size is not None and nbatch >= epoch_size:
                     do_reset = False
                     break

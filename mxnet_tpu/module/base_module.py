@@ -8,6 +8,7 @@ import time
 import numpy as np
 
 from .. import metric as metric_mod
+from .. import telemetry
 from ..base import MXNetError
 from ..callback import BatchEndParam
 from ..model import save_checkpoint
@@ -160,6 +161,9 @@ class BaseModule:
                         else [batch_end_callback]
                     for cb in cbs:
                         cb(p)
+                # one telemetry record per step (free until a sink is
+                # attached via MXNET_TELEMETRY_JSONL or add_sink)
+                telemetry.step_end(extra={"epoch": epoch, "nbatch": nbatch})
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

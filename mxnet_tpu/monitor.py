@@ -1,8 +1,19 @@
 """Monitor: tensor-stat debugging hook (reference `python/mxnet/monitor.py`).
 
-Installs a per-output callback on executors (our Executor's eager monitored
-path, the analogue of `Executor::SetMonitorCallback` /
-`graph_executor.cc:835-849`) and prints regex-filtered stats every N batches.
+Installs a per-output callback on executors and prints regex-filtered stats
+every N batches.  Two modes:
+
+* ``mode='eager'`` (reference semantics, `graph_executor.cc:835-849`): the
+  monitored forward re-runs the graph un-jitted and the stat function
+  (default |x|/size over `asnumpy`) runs host-side per output — O(n)
+  python op dispatches and O(n_outputs) blocking device->host fetches.
+  Arbitrary python stat functions work here.
+* ``mode='ingraph'``: the stat is computed INSIDE one jitted program that
+  also produces the step's normal outputs, and the whole stat bundle comes
+  back in ONE small host transfer — the O(1)-dispatch contract of the
+  fused training path survives monitoring.  The stat function must be
+  traceable (jax array -> scalar); the default is the same |x|.sum()/size
+  asum as the reference.
 """
 from __future__ import annotations
 
@@ -13,8 +24,22 @@ from .ndarray import NDArray
 
 
 class Monitor:
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 mode="eager"):
+        if mode not in ("eager", "ingraph"):
+            from .base import MXNetError
+
+            raise MXNetError("Monitor mode must be 'eager' or 'ingraph', "
+                             "got %r" % mode)
+        self.mode = mode
+        self._ingraph_stat = None
+        if mode == "ingraph":
+            # stat_func here is TRACED into the monitored program (None =
+            # the executor's default in-graph asum); values arriving at
+            # the callback are already finished host floats
+            self._ingraph_stat = stat_func
+            stat_func = None
+        if stat_func is None and mode == "eager":
             def asum_stat(x):
                 """|x|/size(x) like the reference default."""
                 import numpy as np
@@ -32,16 +57,30 @@ class Monitor:
         self.re_prog = re.compile(pattern)
         self.sort = sort
 
-        def stat_helper(name, arr):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(arr)))
+        if mode == "ingraph":
+            def stat_helper(name, value):
+                if not self.activated or not self.re_prog.match(name):
+                    return
+                self.queue.append((self.step, name, float(value)))
+        else:
+            def stat_helper(name, arr):
+                if not self.activated or not self.re_prog.match(name):
+                    return
+                self.queue.append((self.step, name, self.stat_func(arr)))
 
         self.stat_helper = stat_helper
 
     def install(self, exe):
         """Attach to an executor (`monitor.py` install)."""
-        exe.set_monitor_callback(self.stat_helper)
+        if self.mode == "ingraph":
+            # activation predicate: the monitored program runs only on
+            # tic'd (1-in-interval) batches; other steps take the normal
+            # jit path at zero extra cost
+            exe.set_monitor_callback(self.stat_helper, mode="ingraph",
+                                     stat_fn=self._ingraph_stat,
+                                     active_fn=lambda: self.activated)
+        else:
+            exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
     def tic(self):

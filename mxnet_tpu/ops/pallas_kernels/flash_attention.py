@@ -37,6 +37,22 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+def _stream_residency_fits(s, d, itemsize):
+    """Whole-stream VMEM residency model of the loop kernels, with a
+    safety margin.  The linear part is ~2 streams x 2 operands x S x d
+    double-buffered (8*S*d*itemsize).  Round-5 on-chip anchors (d=128
+    bf16): S=4096 compiles at block 512 (~10 MB scoped), S=8192 is
+    Mosaic-rejected at ANY block size with "scoped allocation 24.5M >
+    16M" — 24.5 MB is ~22% ABOVE what the linear model extrapolates
+    (8*8192*128*2 = 20 MB), so Mosaic's true scoped allocation grows
+    superlinearly in the never-measured band.  The 1.25x margin keeps
+    every admitted shape at or below the verified S=4096 anchor's
+    headroom; shapes in the extrapolated band (S=5120-6144 at d=128
+    bf16) now FALL BACK instead of risking a hard Mosaic compile error
+    with no fallback (ADVICE r5)."""
+    return (5 * 8 * s * d * itemsize) // 4 <= 12 * 1024 * 1024
+
+
 def _use_pallas(q, kv_len=None):
     if jax.default_backend() != "tpu" and not _INTERPRET:
         return False
@@ -45,17 +61,14 @@ def _use_pallas(q, kv_len=None):
     if q.shape[-1] < 32:
         return False
     # the loop kernels hold one head's full K/V (dq pass) or full Q/dO
-    # (dk/dv pass) in VMEM, double-buffered by the Mosaic pipeline: the
-    # scoped need is ~2 streams x 2 operands x S x d.  Round-5 on-chip
-    # anchors (d=128 bf16): S=4096 compiles and runs at block 512
-    # (~10 MB scoped), S=8192 is rejected by Mosaic at ANY block size
-    # ("scoped allocation 24.5M > 16M limit"), so the 4*S*d model this
-    # gate previously used was too loose by 2x.  Beyond the cap the
-    # blockwise jnp path or the grid-streamed bsd kernels take over
-    # (ring attention shards S across devices long before this matters).
+    # (dk/dv pass) in VMEM, double-buffered by the Mosaic pipeline —
+    # see `_stream_residency_fits` for the measured residency model.
+    # Beyond the cap the blockwise jnp path or the grid-streamed bsd
+    # kernels take over (ring attention shards S across devices long
+    # before this matters).
     s = kv_len if kv_len is not None else q.shape[2]
     itemsize = jnp.dtype(q.dtype).itemsize
-    return 8 * s * q.shape[-1] * itemsize <= 12 * 1024 * 1024
+    return _stream_residency_fits(s, q.shape[-1], itemsize)
 
 
 try:  # pallas is TPU-only in some builds; import lazily and gate on backend
@@ -1635,14 +1648,15 @@ def _bsd_eligible(q, num_heads):
 
 
 def _bsd_loop_fits_vmem(q, num_heads, kv_len):
-    # same double-buffered whole-stream residency model as _use_pallas
-    # (round-5 anchors: S=4096 fits, S=8192 Mosaic-OOMs at any block).
+    # same margined whole-stream residency model as _use_pallas
+    # (`_stream_residency_fits`; round-5 anchors: S=4096 fits, S=8192
+    # Mosaic-OOMs at any block, ~22% above linear extrapolation).
     # The grid-streamed kernels hold only (block, d) tiles in VMEM, so
     # this cap does not apply to them — they exist precisely for the
     # contexts that exceed it.
     d = q.shape[-1] // num_heads
     itemsize = jnp.dtype(q.dtype).itemsize
-    return 8 * kv_len * d * itemsize <= 12 * 1024 * 1024
+    return _stream_residency_fits(kv_len, d, itemsize)
 
 
 def _bsd_structure(q, num_heads, kv_len):

@@ -37,6 +37,7 @@ from .base import MXNetError, silence_cpu_donation_warning
 from .ndarray import NDArray, zeros
 from . import profiler
 from . import random as _random
+from . import telemetry
 
 __all__ = ["Optimizer", "SGD", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
            "AdaDelta", "Test", "create", "get_updater", "get_fused_updater",
@@ -302,10 +303,18 @@ class Optimizer:
             donate = not dup
 
         nscal = len(scalars[0])
+        # In-graph training-health stats (MXNET_TELEMETRY_HEALTH=1): the
+        # global grad/update/param second moments and nonfinite count are
+        # computed INSIDE the same fused program — the stats bundle is an
+        # extra small output, not an extra dispatch, and its host fetch is
+        # deferred to telemetry.step_report()/health().
+        health = telemetry.health_enabled()
+        self._watch_retrace(indices, w_arrs, donate, health)
 
-        def build(donate=donate):
+        def build(donate=donate, health=health):
             def apply(ws, gs, ss, sc, key_arr):
                 new_ws, new_ss = [], []
+                moments = jnp.zeros((4,), jnp.float32) if health else None
                 for i in range(len(ws)):
                     # same weak-float-like scalar/result dtype handling as
                     # the per-key driver in `update` — the two must stay
@@ -315,22 +324,65 @@ class Optimizer:
                     k = key_arr[i] if key_arr is not None else None
                     nw, ns = self._update_math(ws[i], gs[i], ss[i], scal,
                                                key=k)
-                    new_ws.append(nw.astype(ws[i].dtype))
+                    nw = nw.astype(ws[i].dtype)
+                    if health:
+                        gf = gs[i].astype(jnp.float32)
+                        wf = ws[i].astype(jnp.float32)
+                        df = nw.astype(jnp.float32) - wf
+                        moments = moments + jnp.stack([
+                            jnp.sum(jnp.square(gf)),
+                            jnp.sum(jnp.square(df)),
+                            jnp.sum(jnp.square(wf)),
+                            jnp.sum(~jnp.isfinite(gf)).astype(jnp.float32),
+                        ])
+                    new_ws.append(nw)
                     new_ss.append(ns)
+                if health:
+                    return new_ws, new_ss, moments
                 return new_ws, new_ss
 
             return jax.jit(apply, donate_argnums=(0, 2) if donate else ())
 
         if donate:
             silence_cpu_donation_warning()
-        fused = self._jit_for("multi_donate" if donate else "multi_keep",
-                              build)
-        new_ws, new_ss = fused(w_arrs, g_arrs, s_arrs, sc, key_arr)
+        kind = ("multi_donate" if donate else "multi_keep") + \
+            ("_health" if health else "")
+        fused = self._jit_for(kind, build)
+        out = fused(w_arrs, g_arrs, s_arrs, sc, key_arr)
+        if health:
+            new_ws, new_ss, moments = out
+            telemetry.stage_health(
+                ("grad_sq", "update_sq", "param_sq", "nonfinite"), moments)
+        else:
+            new_ws, new_ss = out
         for w, nw in zip(weights, new_ws):
             w._set_data(nw)
         for s, ns in zip(states, new_ss):
             _store_state(s, ns)
         profiler.record_dispatch("optimizer.update_multi")
+
+    def _watch_retrace(self, indices, w_arrs, donate, health):
+        """Retrace watchdog over the fused update program: a changed
+        bucket shape profile, a donation fallback, or a mutated traced
+        hyperparameter (e.g. ``opt.rescale_grad = ...`` mid-run, which
+        invalidates `_jit_for`'s cache) fires one diagnosed event.
+
+        The signature mirrors what the jit cache actually keys on —
+        POSITIONAL shapes/dtypes plus device — not the bucket's key
+        names: `_update_params` drives one same-shaped bucket per device
+        with different faked indices, and naming entries by index would
+        fire a false retrace on what is a genuine cache hit."""
+        if not telemetry.retrace_enabled():
+            return
+        sig = telemetry.arrays_signature(
+            w_arrs, ["w%d" % i for i in range(len(w_arrs))])
+        meta = {"donate": bool(donate), "health": bool(health),
+                "device": str(getattr(w_arrs[0], "device", None))
+                if w_arrs else "none"}
+        for k, v in self._trace_key():
+            meta["hp:%s" % k] = str(v)
+        telemetry.watch_jit("optimizer.update_multi", sig,
+                            scope=telemetry.watch_scope(self), meta=meta)
 
 
 @Optimizer.register

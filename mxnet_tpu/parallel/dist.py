@@ -26,6 +26,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import engine as _hengine
+from .. import telemetry
 from ..kvstore import KVStore
 from ..ndarray import NDArray, array
 
@@ -62,6 +63,10 @@ def _shard_slices(size, num_servers):
 
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=4)
+    # comm accounting: wire bytes (8-byte length frame + pickled payload),
+    # counted on both worker and server processes into their own registries
+    telemetry.inc("dist.bytes_sent", 8 + len(payload))
+    telemetry.inc("dist.msgs_sent")
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -79,6 +84,8 @@ def _recv_msg(sock):
         if not chunk:
             return None
         buf += chunk
+    telemetry.inc("dist.bytes_recv", 8 + n)
+    telemetry.inc("dist.msgs_recv")
     return pickle.loads(bytes(buf))
 
 
@@ -473,8 +480,14 @@ class DistKVStore(KVStore):
                 % (server, pool.addr[0], pool.addr[1],
                    msg.get("op"), e)) from e
         try:
+            t0 = time.perf_counter()
             _send_msg(sock, msg)
             reply = _recv_msg(sock)
+            # per-op round-trip latency: one histogram per RPC op, so a
+            # step report separates push/pull/barrier waits (a slow BSP
+            # push round is a straggler peer, not a slow network)
+            telemetry.observe("dist.rpc_ms.%s" % msg.get("op"),
+                              1e3 * (time.perf_counter() - t0))
         except OSError as e:
             try:
                 sock.close()  # connection state unknown: don't reuse

@@ -207,7 +207,7 @@ class SPMDTrainer:
                     self.aux[n] = _put_global(
                         np.ones(self.aux[n].shape, np.float32), repl)
 
-        graph_fn, _, _ = _build_graph_fn(symbol)
+        graph_fn, _, _, _ = _build_graph_fn(symbol)
         # Rematerialization knobs (the reference's tunable mirroring plan,
         # `static_graph.cc:410-560`): MXNET_BACKWARD_MIRROR_POLICY selects
         # what survives fwd->bwd (dots / attn / nothing — see

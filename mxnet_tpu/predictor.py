@@ -116,7 +116,7 @@ class Predictor:
         self._arg_index = {n: i for i, n in enumerate(arg_names)}
         self._out_shapes = out_shapes
 
-        graph_fn, self._order, _ = _build_graph_fn(symbol)
+        graph_fn, self._order, _, _ = _build_graph_fn(symbol)
 
         def infer(args, aux):
             outs, _ = graph_fn(args, aux, None, False)
@@ -177,7 +177,7 @@ class Predictor:
         if num_nodes <= 0:
             return []
         heads = Symbol([(n, 0) for n in order[:num_nodes]])
-        graph_fn, _, _ = _build_graph_fn(heads)
+        graph_fn, _, _, _ = _build_graph_fn(heads)
         # the sub-symbol's own argument/aux ordering indexes into ours
         aux_index = {n: i for i, n in
                      enumerate(self.symbol.list_auxiliary_states())}

@@ -24,6 +24,7 @@ import time
 import jax
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError
 
 _active_logdir = None
@@ -72,9 +73,14 @@ class DispatchCounts:
 
 
 def record_dispatch(site, kind="jit"):
-    """Count one framework dispatch (no-op unless `count_dispatches` is
-    active).  kind: 'jit' for an XLA program entry, 'transfer' for a
-    host<->device copy."""
+    """Count one framework dispatch.  kind: 'jit' for an XLA program
+    entry, 'transfer' for a host<->device copy.  Feeds both the scoped
+    `count_dispatches()` window (when active) and the process-wide
+    telemetry registry (always, unless MXNET_TELEMETRY=0), so the per-step
+    JSONL stream carries dispatch counts without a counting context."""
+    telemetry.inc("dispatch.jit_entries" if kind == "jit"
+                  else "dispatch.host_transfers")
+    telemetry.inc("dispatch.site.%s" % site)
     st = _dispatch
     if st is None:
         return
@@ -214,7 +220,11 @@ class StepTimer:
     def tic(self):
         now = time.perf_counter()
         if self._last is not None:
-            self._times.append(now - self._last)
+            dt = now - self._last
+            self._times.append(dt)
+            # the telemetry registry's "step.ms" histogram carries the same
+            # number into the per-step JSONL stream
+            telemetry.observe("step.ms", 1e3 * dt)
         self._last = now
 
     def summary(self):

@@ -27,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import telemetry
 from .base import MXNetError
 from .context import Context
 
@@ -146,3 +147,22 @@ def device_memory_stats(ctx=None):
     dev = ctx.jax_device()
     stats = dev.memory_stats()
     return dict(stats) if stats else {}
+
+
+def _telemetry_collector():
+    """Storage contribution to each telemetry step report: pooled-allocator
+    stats plus the runtime's live HBM counters (bytes_in_use /
+    peak_bytes_in_use on platforms that report them)."""
+    out = {"pool": Storage.get().pool_stats()}
+    try:
+        hbm = device_memory_stats()
+        if hbm:
+            out["hbm"] = {k: hbm[k] for k in
+                          ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                          if k in hbm} or hbm
+    except Exception:  # backend without memory_stats, or no device yet
+        pass
+    return out
+
+
+telemetry.register_collector("storage", _telemetry_collector, default=True)

@@ -1,0 +1,652 @@
+"""Unified training telemetry: a process-wide metrics registry with a
+per-step JSONL stream, a retrace watchdog, and in-graph health-stat staging.
+
+The reference's only continuous observability was `Monitor` tensor stats and
+`Speedometer` samples/sec (SURVEY §5.1); the rebuild's profiler tools
+(`profiler.trace`, `count_dispatches`, `ExecutionPlan`, `hlo_breakdown`) are
+point-in-time — you attach them when something is already wrong.  This module
+is the cheap always-on layer that explains throughput cliffs and numeric
+blowups after the fact:
+
+* **Registry** — counters (monotonic), gauges (last value), histograms
+  (per-step observation pools).  Instrumented chokepoints (executor jit
+  entries via `profiler.record_dispatch`, optimizer fused updates, KVStore
+  push/pull bytes, dist-PS socket traffic and RPC latency, data-iterator
+  wait time) feed it with dict-increment cost; `MXNET_TELEMETRY=0` turns
+  every call site into a no-op.
+* **Sinks** — `step_report()` rolls the registry into one JSON record per
+  training step and emits it to every attached sink (`JsonlSink` file
+  stream shipped; `MemorySink` for tests).  `MXNET_TELEMETRY_JSONL=<path>`
+  attaches a file sink automatically.  Training loops call `step_end()`,
+  which is free until a sink is attached.
+* **Retrace watchdog** — `watch_jit(site, sig)` tracks the signatures each
+  jitted chokepoint has been called with.  A NEW signature after the
+  warmup call is exactly a jit cache miss (XLA recompile); the watchdog
+  fires once per distinct signature with a diagnosis of what changed (arg
+  shape/dtype by name, donation fallback, mutated traced hyperparameter).
+  Production retrace cliffs — a data pipeline that emits a ragged last
+  batch, an `opt.rescale_grad` mutation per step — show up as named
+  events instead of silent 100x step-time spikes.
+* **Health staging** — `stage_health()` parks the small device array the
+  fused `update_multi` program computes alongside the weight update
+  (global grad-norm / update-ratio / nonfinite moments); the host fetch is
+  deferred to `step_report()`/`health()`, so enabling health stats adds
+  ZERO jit entries per step (asserted in tests/test_telemetry.py).
+
+This module imports only the standard library and numpy so every layer of
+the framework (profiler, kvstore, dist PS, io) can feed it without cycles.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "MetricsRegistry", "JsonlSink", "MemorySink",
+    "registry", "reset", "enabled", "health_enabled", "retrace_enabled",
+    "inc", "set_gauge", "observe", "record_event", "events",
+    "add_sink", "remove_sink", "register_collector",
+    "step_report", "step_end",
+    "arrays_signature", "watch_jit",
+    "stage_health", "health",
+]
+
+
+# ---------------------------------------------------------------------------
+# Env knobs (read per call: tests and debugging sessions flip them live,
+# the same contract as optimizer.fused_update_enabled)
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """Master switch: MXNET_TELEMETRY=0 no-ops every instrumentation site."""
+    return os.environ.get("MXNET_TELEMETRY", "1").lower() not in (
+        "0", "false", "no")
+
+
+def health_enabled():
+    """MXNET_TELEMETRY_HEALTH=1 computes grad-norm/update-ratio/nonfinite
+    moments inside the fused `Optimizer.update_multi` program (default off:
+    the stats are free in dispatches but not in FLOPs/HBM reads)."""
+    return enabled() and os.environ.get(
+        "MXNET_TELEMETRY_HEALTH", "0").lower() in ("1", "true", "yes")
+
+
+def retrace_enabled():
+    """MXNET_TELEMETRY_RETRACE=0 disables the retrace watchdog (signature
+    bookkeeping is O(n_args) tuple building per step)."""
+    return enabled() and os.environ.get(
+        "MXNET_TELEMETRY_RETRACE", "1").lower() not in ("0", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+# ---------------------------------------------------------------------------
+
+class MemorySink:
+    """Test sink: keeps every emitted record in `.records`."""
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+class JsonlSink:
+    """One JSON object per line, flushed per record so a crashed run keeps
+    its stream up to the last completed step."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = None
+
+    def emit(self, record):
+        if self._f is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(record, default=str) + "\n")
+        self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+# ---------------------------------------------------------------------------
+# Metric handles (thin views over the registry's dicts)
+# ---------------------------------------------------------------------------
+
+class Counter:
+    __slots__ = ("_reg", "name")
+
+    def __init__(self, reg, name):
+        self._reg, self.name = reg, name
+
+    def inc(self, n=1):
+        self._reg.inc(self.name, n)
+
+    @property
+    def value(self):
+        return self._reg._counters.get(self.name, 0)
+
+
+class Gauge:
+    __slots__ = ("_reg", "name")
+
+    def __init__(self, reg, name):
+        self._reg, self.name = reg, name
+
+    def set(self, v):
+        self._reg.set_gauge(self.name, v)
+
+    @property
+    def value(self):
+        return self._reg._gauges.get(self.name)
+
+
+class Histogram:
+    __slots__ = ("_reg", "name")
+
+    def __init__(self, reg, name):
+        self._reg, self.name = reg, name
+
+    def observe(self, v):
+        self._reg.observe(self.name, v)
+
+
+class _Watch:
+    """Per-(site, scope) retrace watchdog state.  `seen` is an insertion-
+    ordered dict used as a bounded set: a pathological workload that mints
+    a new signature every step (the exact thing the watchdog diagnoses)
+    must not also grow memory without bound."""
+
+    __slots__ = ("seen", "last", "n_total")
+    MAX_SEEN = 64
+
+    def __init__(self, sig):
+        self.seen = {sig: None}
+        self.last = sig
+        self.n_total = 1
+
+    def add(self, sig):
+        self.seen[sig] = None
+        self.n_total += 1
+        if len(self.seen) > self.MAX_SEEN:
+            del self.seen[next(iter(self.seen))]
+
+
+_scope_lock = threading.Lock()
+_scope_counter = [0]
+
+
+def watch_scope(obj, attr="_telemetry_scope"):
+    """Stable watchdog scope token for `obj`, minted once and stored on the
+    object.  Unlike raw id(), a token is never reused after GC, so a new
+    model allocated at a dead one's address cannot inherit its signature
+    history and fire a spurious retrace."""
+    tok = getattr(obj, attr, None)
+    if tok is None:
+        with _scope_lock:
+            _scope_counter[0] += 1
+            tok = _scope_counter[0]
+        try:
+            setattr(obj, attr, tok)
+        except AttributeError:  # slotted/immutable obj: fall back to id
+            return id(obj)
+    return tok
+
+
+_MAX_HIST = 65536    # per-step observation pool cap (drained every report)
+_MAX_EVENTS = 1024   # cumulative event-log cap
+
+
+class MetricsRegistry:
+    """Process-wide metric store.  All mutators are thread-safe (the dist
+    PS instrumentation runs on engine/heartbeat threads)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._hists = {}          # name -> [observations since last report]
+        self._hist_counts = {}    # name -> observed count since last report
+        #   (may exceed len(pool) when the _MAX_HIST cap truncated it)
+        self._events = []         # since last report
+        self._event_log = []      # cumulative (capped)
+        self._sinks = []
+        self._collectors = {}     # name -> fn() -> dict
+        self._watches = {}        # (site, scope) -> _Watch
+        self._pending_health = None  # (names, [device_arrays]), unfetched
+        self._health_fresh = False   # staged since the last step report
+        self._step = 0
+        self._last_counters = {}
+        self._last_time = None
+
+    # -- handles -----------------------------------------------------------
+    def counter(self, name):
+        return Counter(self, name)
+
+    def gauge(self, name):
+        return Gauge(self, name)
+
+    def histogram(self, name):
+        return Histogram(self, name)
+
+    # -- mutators ----------------------------------------------------------
+    def inc(self, name, n=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name, v):
+        with self._lock:
+            self._gauges[name] = v
+
+    def observe(self, name, v):
+        with self._lock:
+            pool = self._hists.setdefault(name, [])
+            if len(pool) < _MAX_HIST:
+                pool.append(float(v))
+            self._hist_counts[name] = self._hist_counts.get(name, 0) + 1
+
+    def record_event(self, kind, **fields):
+        ev = {"kind": kind, "time": time.time()}
+        ev.update(fields)
+        with self._lock:
+            self._counters["events.%s" % kind] = \
+                self._counters.get("events.%s" % kind, 0) + 1
+            # both buffers capped: with no sink attached, step_report never
+            # drains _events, and a per-step event source (e.g. a watchdog
+            # firing every step) must not grow memory for the process
+            # lifetime
+            self._events.append(ev)
+            if len(self._events) > _MAX_EVENTS:
+                del self._events[:len(self._events) - _MAX_EVENTS]
+            self._event_log.append(ev)
+            if len(self._event_log) > _MAX_EVENTS:
+                del self._event_log[:len(self._event_log) - _MAX_EVENTS]
+        return ev
+
+    def events(self, kind=None):
+        with self._lock:
+            log = list(self._event_log)
+        if kind is not None:
+            log = [e for e in log if e.get("kind") == kind]
+        return log
+
+    # -- sinks / collectors ------------------------------------------------
+    def add_sink(self, sink):
+        with self._lock:
+            self._sinks.append(sink)
+        return sink
+
+    def remove_sink(self, sink):
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+        close = getattr(sink, "close", None)
+        if close:
+            close()
+
+    def register_collector(self, name, fn):
+        """`fn() -> dict`, merged into each step report under `name` (e.g.
+        the storage module contributes pool/HBM stats).  Re-registering a
+        name replaces the previous collector."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    # -- health staging ----------------------------------------------------
+    def stage_health(self, names, values):
+        """Park the in-graph health moments (a small DEVICE array computed
+        by the fused update program).  The blocking host fetch happens at
+        `health()` / `step_report()`, never here — staging must not add a
+        host transfer to the update call.  Multiple stagings between
+        fetches (one fused update per device, or per KVStore bucket)
+        ACCUMULATE: the derived stats cover every update since the last
+        fetch, so a NaN on device 0 is not masked by a clean device 1."""
+        names = tuple(names)
+        with self._lock:
+            pending = self._pending_health
+            if pending is not None and pending[0] == names:
+                pending[1].append(values)
+                # bounded like every other telemetry buffer: if nothing
+                # ever drains (health on, no sink, no health() caller),
+                # keep the most recent window instead of pinning one
+                # device buffer per update call for the process lifetime
+                if len(pending[1]) > 128:
+                    del pending[1][0]
+            else:
+                self._pending_health = (names, [values])
+            self._health_fresh = True
+
+    def health(self):
+        """Derive the staged health stats:
+        {grad_norm, update_ratio, param_norm, nonfinite} or None.  The
+        device arrays are fetched ONCE and memoized — repeated calls (or
+        step reports with no update in between) pay no extra transfers."""
+        with self._lock:
+            pending, self._pending_health = self._pending_health, None
+        if pending is not None:
+            names, value_list = pending
+            summed = np.zeros(len(names), np.float64)
+            for v in value_list:  # moments are sums: accumulate on host
+                summed += np.asarray(v, np.float64)
+            vals = dict(zip(names, summed))
+            grad_sq = vals.get("grad_sq", 0.0)
+            upd_sq = vals.get("update_sq", 0.0)
+            param_sq = vals.get("param_sq", 0.0)
+            out = {
+                "grad_norm": float(np.sqrt(max(grad_sq, 0.0))),
+                "param_norm": float(np.sqrt(max(param_sq, 0.0))),
+                "update_ratio": float(np.sqrt(upd_sq / param_sq))
+                if param_sq > 0 else 0.0,
+                "nonfinite": int(vals.get("nonfinite", 0.0)),
+            }
+            with self._lock:
+                self._last_health = out
+        return getattr(self, "_last_health", None)
+
+    # -- retrace watchdog --------------------------------------------------
+    def watch_jit(self, site, sig, scope=None, meta=None):
+        """Record one call of the jitted program at `site` with signature
+        `sig` (see `arrays_signature`).  The first signature per
+        (site, scope) is the warmup compile; every NEW signature after it
+        is a jit cache miss — one retrace event fires per distinct
+        signature, with a diagnosis diffing against the previous call.
+        Returns the event dict when one fired, else None."""
+        meta_items = tuple(sorted((meta or {}).items()))
+        full = (tuple(sig), meta_items)
+        key = (site, scope)
+        with self._lock:
+            w = self._watches.get(key)
+            if w is None:
+                # bounded: transient executors/optimizers (sweeps, test
+                # suites) must not accrete signature sets forever — evict
+                # the oldest scope past the cap (insertion-ordered dict)
+                if len(self._watches) >= 512:
+                    self._watches.pop(next(iter(self._watches)))
+                self._watches[key] = _Watch(full)
+                return None
+            if full in w.seen:
+                w.last = full
+                return None
+            diagnosis = _diagnose(w.last, full)
+            w.add(full)
+            n_sigs = w.n_total
+            w.last = full
+        logging.warning("telemetry: retrace at %s (%d distinct signatures "
+                        "compiled): %s", site, n_sigs, diagnosis)
+        return self.record_event("retrace", site=site, diagnosis=diagnosis,
+                                 n_signatures=n_sigs)
+
+    # -- per-step rollup ---------------------------------------------------
+    def step_report(self, step=None, extra=None):
+        """Roll everything observed since the last report into one record,
+        emit it to every sink, and return it."""
+        now = time.time()
+        with self._lock:
+            self._step += 1
+            rec_step = self._step if step is None else step
+            all_counters = dict(self._counters)
+            deltas = {k: v - self._last_counters.get(k, 0)
+                      for k, v in all_counters.items()
+                      if v != self._last_counters.get(k, 0)}
+            self._last_counters = all_counters
+            # per-record counters carry the cumulative value of only the
+            # counters that CHANGED this step: record size stays O(active
+            # sites) instead of O(every name ever seen), and a counter's
+            # final total is still recoverable from its last appearance
+            # in the stream (tools/telemetry_report.py reads it that way)
+            counters = {k: all_counters[k] for k in deltas}
+            gauges = dict(self._gauges)
+            health_fresh, self._health_fresh = self._health_fresh, False
+            hists, drained = {}, self._hists
+            self._hists = {}
+            observed_counts, self._hist_counts = self._hist_counts, {}
+            ev, self._events = self._events, []
+            last_time, self._last_time = self._last_time, now
+            sinks = list(self._sinks)
+            collectors = dict(self._collectors)
+        for name, pool in drained.items():
+            pool.sort()
+            n = len(pool)
+            hists[name] = {
+                "count": observed_counts.get(name, n),  # true observations
+                "mean": sum(pool) / n,
+                "p50": pool[n // 2],
+                "p99": pool[min(n - 1, int(n * 0.99))],
+                "max": pool[-1],
+            }
+            if observed_counts.get(name, n) > n:
+                # the _MAX_HIST cap dropped observations: disclose that the
+                # summary stats cover only the first `sampled` of them
+                hists[name]["sampled"] = n
+        record = {
+            "type": "step",
+            "step": rec_step,
+            "time": now,
+            "counters": counters,
+            "deltas": deltas,
+            "gauges": gauges,
+            "hists": hists,
+            "events": ev,
+        }
+        if last_time is not None:
+            record["wall_ms"] = 1e3 * (now - last_time)
+        if health_fresh:
+            # deferred device fetch happens here; stale stats (no update
+            # since the last report) are NOT re-stamped into new records
+            h = self.health()
+            if h is not None:
+                record["health"] = h
+        for name, fn in collectors.items():
+            try:
+                record[name] = fn()
+            except Exception as e:  # a broken collector must not kill a step
+                record[name] = {"error": str(e)[:200]}
+        if extra:
+            record.update(extra)
+        for sink in sinks:
+            try:
+                sink.emit(record)
+            except Exception:
+                logging.exception("telemetry sink %r failed", sink)
+        return record
+
+    def close(self):
+        with self._lock:
+            sinks, self._sinks = self._sinks, []
+        for s in sinks:
+            close = getattr(s, "close", None)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# Signature building / retrace diagnosis
+# ---------------------------------------------------------------------------
+
+def arrays_signature(arrays, names=None):
+    """((name, shape, dtype), ...) signature of a list of arrays — the
+    exact information jax's jit cache keys on for each argument.  `names`
+    (optional, may be shorter) labels entries for readable diagnoses."""
+    out = []
+    for i, a in enumerate(arrays):
+        name = names[i] if names is not None and i < len(names) \
+            else "arg%d" % i
+        out.append((name, tuple(getattr(a, "shape", ())),
+                    str(getattr(a, "dtype", type(a).__name__))))
+    return tuple(out)
+
+
+def _diagnose(old, new):
+    """Human diff of two watch signatures: which args changed shape/dtype,
+    which appeared/disappeared, which meta entries (donation mode, traced
+    hyperparameters) mutated."""
+    old_args, old_meta = old
+    new_args, new_meta = new
+    lines = []
+    od = {n: (s, d) for n, s, d in old_args}
+    nd = {n: (s, d) for n, s, d in new_args}
+    for n, (s, d) in nd.items():
+        if n not in od:
+            lines.append("%s: new arg %s %s" % (n, d, s))
+        elif od[n] != (s, d):
+            os_, odt = od[n]
+            if os_ != s:
+                lines.append("%s: shape %s -> %s" % (n, os_, s))
+            if odt != d:
+                lines.append("%s: dtype %s -> %s" % (n, odt, d))
+    for n in od:
+        if n not in nd:
+            lines.append("%s: arg removed" % n)
+    if len(old_args) != len(new_args):
+        lines.append("n_args %d -> %d" % (len(old_args), len(new_args)))
+    om, nm = dict(old_meta), dict(new_meta)
+    for k, v in nm.items():
+        if k not in om:
+            lines.append("%s: new (%r)" % (k, v))
+        elif om[k] != v:
+            lines.append("%s: %r -> %r" % (k, om[k], v))
+    for k in om:
+        if k not in nm:
+            lines.append("%s: removed" % k)
+    return "; ".join(lines) if lines else "signature changed"
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton API (the hot-path surface call sites use)
+# ---------------------------------------------------------------------------
+
+_REG = None
+_REG_LOCK = threading.Lock()
+# collectors that survive `reset()` — framework modules (storage) register
+# here at import time; every fresh registry is seeded with them
+_DEFAULT_COLLECTORS = {}
+
+
+def registry():
+    """The process-wide registry (created on first use; attaches the
+    MXNET_TELEMETRY_JSONL sink when that knob is set)."""
+    global _REG
+    if _REG is None:
+        with _REG_LOCK:
+            if _REG is None:
+                reg = MetricsRegistry()
+                reg._collectors.update(_DEFAULT_COLLECTORS)
+                path = os.environ.get("MXNET_TELEMETRY_JSONL")
+                if path and enabled():
+                    reg.add_sink(JsonlSink(path))
+                _REG = reg
+    return _REG
+
+
+def reset():
+    """Drop the singleton (tests): closes sinks, clears all state.  The
+    next `registry()` call re-reads MXNET_TELEMETRY_JSONL."""
+    global _REG
+    with _REG_LOCK:
+        reg, _REG = _REG, None
+    if reg is not None:
+        reg.close()
+
+
+def inc(name, n=1):
+    if not enabled():
+        return
+    registry().inc(name, n)
+
+
+def set_gauge(name, v):
+    if not enabled():
+        return
+    registry().set_gauge(name, v)
+
+
+def observe(name, v):
+    if not enabled():
+        return
+    registry().observe(name, v)
+
+
+def record_event(kind, **fields):
+    if not enabled():
+        return None
+    return registry().record_event(kind, **fields)
+
+
+def events(kind=None):
+    if _REG is None:
+        return []
+    return _REG.events(kind)
+
+
+def add_sink(sink):
+    return registry().add_sink(sink)
+
+
+def remove_sink(sink):
+    if _REG is not None:
+        _REG.remove_sink(sink)
+
+
+def register_collector(name, fn, default=False):
+    """Merge `fn()`'s dict into every step report under `name`.  With
+    ``default=True`` the registration survives `reset()` (for framework
+    modules that register once at import) and does NOT force the
+    singleton into existence — `import mxnet_tpu` must not consume
+    MXNET_TELEMETRY_JSONL before the user's code has a chance to set it
+    (the sink attaches at first registry USE, as documented)."""
+    if default:
+        _DEFAULT_COLLECTORS[name] = fn
+        if _REG is not None:
+            _REG.register_collector(name, fn)
+        return
+    registry().register_collector(name, fn)
+
+
+def step_report(step=None, extra=None):
+    return registry().step_report(step=step, extra=extra)
+
+
+def step_end(step=None, extra=None):
+    """Training-loop hook: emit a step report IF a sink is attached, else
+    do nothing (so instrumented loops stay free until someone opts into a
+    stream via `add_sink` or MXNET_TELEMETRY_JSONL)."""
+    if not enabled():
+        return None
+    reg = registry()
+    if not reg._sinks:
+        return None
+    return reg.step_report(step=step, extra=extra)
+
+
+def watch_jit(site, sig, scope=None, meta=None):
+    if not retrace_enabled():
+        return None
+    return registry().watch_jit(site, sig, scope=scope, meta=meta)
+
+
+def stage_health(names, values):
+    if not enabled():
+        return
+    registry().stage_health(names, values)
+
+
+def health():
+    if _REG is None:
+        return None
+    return _REG.health()

@@ -93,6 +93,24 @@ def _run_checks(jax, jnp, fa, fc, verbose):
         check("flash_bwd_%s_dk" % tag, dk_p, dk_j, 3e-2)
         check("flash_bwd_%s_dv" % tag, dv_p, dv_j, 3e-2)
 
+        # End-to-end: the bwd kernels consuming the Pallas fwd's OWN
+        # o/lse residuals — the production path (ADVICE r5).  The
+        # isolated checks above feed reference residuals, so an on-chip
+        # o/lse inconsistency between the fwd kernel and what the bwd
+        # kernel assumes would slip through them.  Tolerance is loosened
+        # (1.5e-1 vs 3e-2): the fwd's tolerated ulp-level differences
+        # compound through bf16 rounding cliffs in p=exp(s-lse) — the
+        # round-5 relay campaign measured ~0.106 here on healthy kernels
+        # — while a genuine residual-contract break (wrong lse scale,
+        # stale o) lands orders of magnitude higher.
+        res_self = (q, k, v, o_p, lse_p, zero, zero)
+        dq_e, dk_e, dv_e = jax.jit(
+            lambda res, grads, c=causal: fa._flash_bwd_pallas(
+                scale, c, 128, 128, res, grads)[:3])(res_self, grads)
+        check("flash_e2e_%s_dq" % tag, dq_e, dq_j, 1.5e-1)
+        check("flash_e2e_%s_dk" % tag, dk_e, dk_j, 1.5e-1)
+        check("flash_e2e_%s_dv" % tag, dv_e, dv_j, 1.5e-1)
+
         # the opt-in dS-layout kernels (MXNET_FLASH_LAYOUT=ds; hsd is the
         # ADR-10 default — dS trades speed for unpadded-tile capacity)
         o_d, lse_d = jax.jit(

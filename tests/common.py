@@ -1,8 +1,33 @@
 """Shared test helpers: re-exported from the public `mx.test_utils`
 (single source of truth; this module exists so tests keep their historic
 `from common import ...` imports)."""
+import numpy as np
+
 from mxnet_tpu.test_utils import (  # noqa: F401
     check_numeric_gradient,
     numeric_grad,
     reldiff,
 )
+
+
+def mlp_classifier(layers=2, num_classes=4, num_hidden=16):
+    """Small relu-MLP + SoftmaxOutput fixture shared by the fused-update
+    and telemetry suites (one definition, so both suites test the same
+    model shape)."""
+    import mxnet_tpu as mx
+
+    net = mx.sym.Variable("data")
+    for i in range(layers):
+        net = mx.sym.FullyConnected(data=net, name="fc%d" % i,
+                                    num_hidden=num_hidden)
+        net = mx.sym.Activation(data=net, name="act%d" % i, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="out", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def blob_data(n=64, dim=8, seed=0, num_classes=4):
+    """Deterministic (X, y) synthetic classification batch."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    y = (np.arange(n) % num_classes).astype(np.float32)
+    return X, y

@@ -69,12 +69,14 @@ def test_tiny_head_dim_routes_to_jnp(tpu_backend, monkeypatch):
 
 def test_vmem_cap_routes_to_jnp(tpu_backend, monkeypatch):
     monkeypatch.delenv("MXNET_FLASH_IMPL", raising=False)
-    # bf16 d=128: 8 * S * 128 * 2 bytes of double-buffered whole-stream
-    # residency (round-5 on-chip anchors: S=4096 compiles at block 512,
-    # S=8192 Mosaic-OOMs at any block) — the ~12 MB cap trips above
-    # S=6144
+    # bf16 d=128: 1.25 * 8 * S * 128 * 2 bytes of margined double-buffered
+    # whole-stream residency (round-5 on-chip anchors: S=4096 compiles at
+    # block 512, S=8192 Mosaic-OOMs at any block at ~22% ABOVE linear
+    # extrapolation) — the margined ~12 MB cap admits the verified S=4096
+    # and falls back for the never-measured S=5120+ band instead of
+    # risking a hard Mosaic compile error
     assert fa._pick_impl(q_of(4096, 128), 4096) == "pallas_hsd"
-    assert fa._pick_impl(q_of(6144, 128), 6144) == "pallas_hsd"
+    assert fa._pick_impl(q_of(6144, 128), 6144) == "jnp"
     assert fa._pick_impl(q_of(8192, 128), 8192) == "jnp"
 
 
@@ -170,7 +172,8 @@ def test_auto_blocks_per_impl():
 def test_bsd_structure_auto_promotes_past_vmem_cap(tpu_backend,
                                                    monkeypatch):
     monkeypatch.delenv("MXNET_FLASH_BSD_KERNEL", raising=False)
-    # d=128 bf16: loop residency 8*S*128*2 crosses 12MB above S=6144
+    # d=128 bf16: margined loop residency 1.25*8*S*128*2 crosses 12MB
+    # above S=4915, so S=4096 stays loop and S=8192 streams
     assert fa._bsd_structure(bsd_q(4096, 768), 6, 4096) == "loop"
     assert fa._bsd_structure(bsd_q(8192, 768), 6, 8192) == "stream"
 

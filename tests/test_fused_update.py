@@ -18,24 +18,9 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from common import blob_data as _data, mlp_classifier as _mlp
 from mxnet_tpu import profiler
 from mxnet_tpu.optimizer import (SGD, Adam, get_fused_updater, get_updater)
-
-
-def _mlp(layers, num_classes=4):
-    net = mx.sym.Variable("data")
-    for i in range(layers):
-        net = mx.sym.FullyConnected(data=net, name="fc%d" % i, num_hidden=16)
-        net = mx.sym.Activation(data=net, name="act%d" % i, act_type="relu")
-    net = mx.sym.FullyConnected(data=net, name="out", num_hidden=num_classes)
-    return mx.sym.SoftmaxOutput(data=net, name="softmax")
-
-
-def _data(n=64, dim=8, seed=0):
-    rng = np.random.RandomState(seed)
-    X = rng.randn(n, dim).astype(np.float32)
-    y = (np.arange(n) % 4).astype(np.float32)
-    return X, y
 
 
 def _module_step_dispatches(layers, batch=32):

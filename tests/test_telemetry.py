@@ -1,0 +1,498 @@
+"""Unified training telemetry (ISSUE 3).
+
+Covers the acceptance criteria:
+
+* registry / sink round-trip (counters, gauges, histograms, JSONL re-read);
+* retrace watchdog — exactly one event per recompile (new jit signature
+  after warmup) with a diagnosis naming the changed shape / mutated traced
+  hyperparameter / donation mode;
+* dist-PS byte counters match the wire payload sizes exactly;
+* in-graph health stats ride the existing fused `update_multi` program:
+  jit-entry count per step is IDENTICAL with telemetry health on and off;
+* in-graph Monitor mode: one dispatch + ONE host transfer for the whole
+  stat bundle, values matching the eager reference path;
+* the MXNET_TELEMETRY=0 kill-switch.
+"""
+import json
+import os
+import pickle
+import socket
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from common import blob_data as _data, mlp_classifier as _mlp
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.optimizer import SGD, get_fused_updater
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _warm_module(layers=2, batch=32):
+    mx.random.seed(0)
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    mod = mx.mod.Module(_mlp(layers), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    b = next(iter(it))
+    mod.forward(b)
+    mod.backward()
+    mod.update()
+    return mod, b
+
+
+# ---------------------------------------------------------------------------
+# registry / sinks
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_roundtrip():
+    telemetry.inc("t.counter", 3)
+    telemetry.inc("t.counter")
+    telemetry.set_gauge("t.gauge", 2.5)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        telemetry.observe("t.hist", v)
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    rec = telemetry.step_report()
+    assert rec["counters"]["t.counter"] == 4
+    assert rec["deltas"]["t.counter"] == 4
+    assert rec["gauges"]["t.gauge"] == 2.5
+    h = rec["hists"]["t.hist"]
+    assert h["count"] == 4 and h["mean"] == 2.5 and h["max"] == 4.0
+    assert sink.records[-1] is rec
+    # histograms drain per step; counters accumulate, deltas reset
+    telemetry.inc("t.counter")
+    rec2 = telemetry.step_report()
+    assert rec2["counters"]["t.counter"] == 5
+    assert rec2["deltas"] == {"t.counter": 1}
+    assert "t.hist" not in rec2["hists"]
+
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = str(tmp_path / "stream.jsonl")
+    telemetry.add_sink(telemetry.JsonlSink(path))
+    telemetry.inc("j.count", 7)
+    telemetry.step_report(extra={"phase": "a"})
+    telemetry.step_report(extra={"phase": "b"})
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert len(recs) == 2
+    assert recs[0]["counters"]["j.count"] == 7
+    assert recs[0]["phase"] == "a" and recs[1]["phase"] == "b"
+    assert recs[0]["type"] == "step"
+
+
+def test_registry_handles():
+    reg = telemetry.registry()
+    c = reg.counter("h.c")
+    c.inc(2)
+    assert c.value == 2
+    g = reg.gauge("h.g")
+    g.set(9)
+    assert g.value == 9
+    reg.histogram("h.h").observe(1.5)
+    assert reg.step_report()["hists"]["h.h"]["count"] == 1
+
+
+def test_kill_switch_no_ops(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "0")
+    telemetry.inc("k.c")
+    telemetry.observe("k.h", 1.0)
+    telemetry.set_gauge("k.g", 1.0)
+    assert telemetry.record_event("retrace") is None
+    monkeypatch.delenv("MXNET_TELEMETRY")
+    rec = telemetry.step_report()
+    assert "k.c" not in rec["counters"]
+    assert "k.h" not in rec["hists"]
+    assert "k.g" not in rec["gauges"]
+
+
+def test_step_end_free_without_sinks():
+    telemetry.inc("s.c")
+    assert telemetry.step_end() is None  # no sink: no report built
+    telemetry.add_sink(telemetry.MemorySink())
+    assert telemetry.step_end() is not None
+
+
+# ---------------------------------------------------------------------------
+# retrace watchdog
+# ---------------------------------------------------------------------------
+
+def test_retrace_fires_once_per_recompile_with_shape_diagnosis():
+    """A forced reshape-triggered recompile produces exactly ONE retrace
+    event whose diagnosis names the changed shape (acceptance criterion)."""
+    net = _mlp()
+    arg_shapes, _, _ = net.infer_shape(data=(32, 8))
+    args = [mx.nd.zeros(s) for s in arg_shapes]
+    grads = [mx.nd.zeros(s) for s in arg_shapes]
+    exe = net.bind(mx.cpu(), args, args_grad=grads)
+    for _ in range(2):  # warmup + repeat: zero events
+        exe.forward(is_train=True)
+        exe.backward()
+    assert telemetry.events("retrace") == []
+
+    exe2 = exe.reshape(data=(64, 8))
+    exe2.forward(is_train=True)
+    exe2.backward()
+    evs = telemetry.events("retrace")
+    assert len(evs) == 1, evs
+    assert evs[0]["site"] == "executor.train_step"
+    assert "data" in evs[0]["diagnosis"]
+    assert "(64, 8)" in evs[0]["diagnosis"]
+
+    # the same signature again is a jit cache HIT: no second event
+    exe2.forward(is_train=True)
+    exe2.backward()
+    # ... and returning to the original (already-compiled) shape too
+    exe.forward(is_train=True)
+    exe.backward()
+    assert len(telemetry.events("retrace")) == 1
+
+
+def test_retrace_diagnoses_mutated_traced_hyperparameter():
+    opt = SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    upd = get_fused_updater(opt)
+    ws = [mx.nd.array(np.ones((4,), np.float32)) for _ in range(2)]
+    gs = [mx.nd.array(np.ones((4,), np.float32)) for _ in range(2)]
+    upd([0, 1], gs, ws)  # warmup compile
+    upd([0, 1], gs, ws)
+    assert telemetry.events("retrace") == []
+    opt.rescale_grad = 0.5  # invalidates the traced-constant cache
+    upd([0, 1], gs, ws)
+    evs = telemetry.events("retrace")
+    assert len(evs) == 1, evs
+    assert evs[0]["site"] == "optimizer.update_multi"
+    assert "rescale_grad" in evs[0]["diagnosis"]
+
+
+def test_retrace_no_false_positive_on_per_device_buckets():
+    """`_update_params` drives one same-shaped bucket per device with
+    different faked indices; the jit cache hits, so the watchdog must NOT
+    fire (signature keys on positional shapes/dtypes, not bucket keys)."""
+    opt = SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    upd = get_fused_updater(opt)
+    gs = [mx.nd.array(np.ones((4,), np.float32)) for _ in range(2)]
+    ws0 = [mx.nd.array(np.ones((4,), np.float32)) for _ in range(2)]
+    ws1 = [mx.nd.array(np.ones((4,), np.float32)) for _ in range(2)]
+    upd([0, 2], gs, ws0)  # device-0 bucket (even indices)
+    upd([1, 3], gs, ws1)  # device-1 bucket (odd indices): same shapes
+    assert telemetry.events("retrace") == []
+
+
+def test_retrace_watchdog_disable(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_RETRACE", "0")
+    sig_a = telemetry.arrays_signature([np.zeros((2, 2))], ["x"])
+    sig_b = telemetry.arrays_signature([np.zeros((4, 2))], ["x"])
+    assert telemetry.watch_jit("t.site", sig_a) is None
+    assert telemetry.watch_jit("t.site", sig_b) is None
+    assert telemetry.events("retrace") == []
+
+
+def test_watch_jit_meta_diffs():
+    sig = telemetry.arrays_signature([np.zeros((2, 2))], ["x"])
+    assert telemetry.watch_jit("m.site", sig,
+                               meta={"program": "donate"}) is None
+    ev = telemetry.watch_jit("m.site", sig, meta={"program": "keep"})
+    assert ev is not None and "donate" in ev["diagnosis"] \
+        and "keep" in ev["diagnosis"]
+
+
+# ---------------------------------------------------------------------------
+# dist-PS byte accounting
+# ---------------------------------------------------------------------------
+
+def test_dist_byte_counters_match_payload_sizes():
+    from mxnet_tpu.parallel.dist import _recv_msg, _send_msg
+
+    msgs = [{"op": "push", "key": 3,
+             "value": np.arange(1000, dtype=np.float32), "rank": 0},
+            {"op": "heartbeat", "rank": 1}]
+    expect = sum(8 + len(pickle.dumps(m, protocol=4)) for m in msgs)
+    a, b = socket.socketpair()
+    try:
+        for m in msgs:
+            _send_msg(a, m)
+        got = [_recv_msg(b) for _ in msgs]
+    finally:
+        a.close()
+        b.close()
+    assert got[1] == msgs[1]
+    np.testing.assert_array_equal(got[0]["value"], msgs[0]["value"])
+    reg = telemetry.registry()
+    assert reg.counter("dist.bytes_sent").value == expect
+    assert reg.counter("dist.bytes_recv").value == expect
+    assert reg.counter("dist.msgs_sent").value == len(msgs)
+    assert reg.counter("dist.msgs_recv").value == len(msgs)
+
+
+def test_local_kvstore_byte_counters():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((8, 4)))
+    kv.push(0, mx.nd.ones((8, 4)))
+    out = mx.nd.zeros((8, 4))
+    kv.pull(0, out=out)
+    reg = telemetry.registry()
+    nbytes = 8 * 4 * 4
+    assert reg.counter("kvstore.push_bytes").value == nbytes
+    assert reg.counter("kvstore.pull_bytes").value == nbytes
+
+
+# ---------------------------------------------------------------------------
+# in-graph health stats
+# ---------------------------------------------------------------------------
+
+def test_health_stats_keep_fused_dispatches_o1(monkeypatch):
+    """Acceptance: with telemetry health enabled, the warm fused step
+    issues the SAME jit-entry count as telemetry-off — the stats ride the
+    existing fused program."""
+    mod, b = _warm_module()
+    with profiler.count_dispatches() as d_off:
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+
+    monkeypatch.setenv("MXNET_TELEMETRY_HEALTH", "1")
+    mod.forward(b)
+    mod.backward()
+    mod.update()  # warm the health variant (one-time recompile)
+    with profiler.count_dispatches() as d_on:
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+    assert d_on.jit_entries == d_off.jit_entries, (
+        d_off.as_dict(), d_on.as_dict())
+
+    h = telemetry.health()
+    assert h is not None
+    assert h["grad_norm"] > 0
+    assert h["param_norm"] > 0
+    assert 0 < h["update_ratio"] < 1
+    assert h["nonfinite"] == 0
+
+
+def test_health_stats_o1_in_nparams(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_HEALTH", "1")
+
+    def entries(layers):
+        mod, b = _warm_module(layers)
+        with profiler.count_dispatches() as d:
+            mod.forward(b)
+            mod.backward()
+            mod.update()
+        return d.jit_entries
+
+    assert entries(1) == entries(6)
+
+
+def test_health_accumulates_across_stagings():
+    """One fused update per device: the moments ACCUMULATE until fetched,
+    so a NaN on device 0 is not masked by a clean device 1."""
+    names = ("grad_sq", "update_sq", "param_sq", "nonfinite")
+    telemetry.stage_health(names, np.array([4.0, 1.0, 16.0, 2.0]))
+    telemetry.stage_health(names, np.array([5.0, 3.0, 9.0, 0.0]))
+    h = telemetry.health()
+    assert h["grad_norm"] == pytest.approx(3.0)   # sqrt(4+5)
+    assert h["param_norm"] == pytest.approx(5.0)  # sqrt(16+9)
+    assert h["update_ratio"] == pytest.approx(0.4)  # sqrt(4/25)
+    assert h["nonfinite"] == 2
+
+
+def test_health_counts_nonfinite(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_HEALTH", "1")
+    opt = SGD(learning_rate=0.1, momentum=0.0, rescale_grad=1.0)
+    upd = get_fused_updater(opt)
+    ws = [mx.nd.array(np.ones((4,), np.float32))]
+    g = np.ones((4,), np.float32)
+    g[1] = np.nan
+    g[2] = np.inf
+    upd([0], [mx.nd.array(g)], ws)
+    assert telemetry.health()["nonfinite"] == 2
+
+
+def test_health_lands_in_step_report(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_HEALTH", "1")
+    _warm_module()
+    rec = telemetry.step_report()
+    assert "health" in rec and rec["health"]["grad_norm"] > 0
+    # stale stats are not re-stamped: a report with no update in between
+    # carries no health block (it would double-count nonfinite steps)
+    rec2 = telemetry.step_report()
+    assert "health" not in rec2
+    # ... but health() still serves the last known values
+    assert telemetry.health()["grad_norm"] > 0
+
+
+def test_step_report_counters_changed_only():
+    telemetry.inc("a.count", 1)
+    rec1 = telemetry.step_report()
+    assert rec1["counters"]["a.count"] == 1
+    telemetry.inc("b.count", 2)
+    rec2 = telemetry.step_report()
+    # a.count did not change this step: cumulative value rides only its
+    # last appearance (record size stays O(active sites))
+    assert "a.count" not in rec2["counters"]
+    assert rec2["counters"]["b.count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# in-graph Monitor mode
+# ---------------------------------------------------------------------------
+
+def _bound_eval_exe():
+    net = _mlp()
+    arg_shapes, _, _ = net.infer_shape(data=(16, 8))
+    rng = np.random.RandomState(1)
+    args = [mx.nd.array(rng.randn(*s).astype(np.float32))
+            for s in arg_shapes]
+    return net.bind(mx.cpu(), args)
+
+
+def test_ingraph_monitor_one_dispatch_one_transfer():
+    exe = _bound_eval_exe()
+    mon = mx.monitor.Monitor(1, pattern=".*", mode="ingraph")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)  # warm the monitored program
+    mon.toc()
+    mon.tic()
+    with profiler.count_dispatches() as d:
+        exe.forward(is_train=False)
+    res = mon.toc()
+    assert len(res) > 4  # every internal entry reported
+    # O(1): one jitted program, ONE bundle fetch — NOT O(n_outputs)
+    # blocking asnumpy calls like the eager stat path
+    assert d.jit_entries == 1, d.as_dict()
+    assert d.host_transfers == 1, d.as_dict()
+
+
+def test_ingraph_monitor_matches_eager_stats():
+    exe = _bound_eval_exe()
+    eager = mx.monitor.Monitor(1, pattern=".*")
+    eager.install(exe)
+    eager.tic()
+    exe.forward(is_train=False)
+    ref = {n: v for _, n, v in eager.toc()}
+
+    ing = mx.monitor.Monitor(1, pattern=".*", mode="ingraph")
+    ing.install(exe)
+    ing.tic()
+    exe.forward(is_train=False)
+    got = {n: v for _, n, v in ing.toc()}
+    assert set(got) == set(ref)
+    for name in ref:
+        np.testing.assert_allclose(got[name], ref[name], rtol=1e-4,
+                                   err_msg=name)
+
+
+def test_ingraph_monitor_custom_stat_and_pattern():
+    import jax.numpy as jnp
+
+    exe = _bound_eval_exe()
+    mon = mx.monitor.Monitor(
+        1, stat_func=lambda x: jnp.max(jnp.abs(x.astype(jnp.float32))),
+        pattern=".*fc0.*", mode="ingraph")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)
+    res = mon.toc()
+    assert res and all("fc0" in n for _, n, _ in res)
+    arr = exe.arg_dict["fc0_weight"].asnumpy()
+    by_name = {n: v for _, n, v in res}
+    np.testing.assert_allclose(by_name["fc0_weight"],
+                               np.abs(arr).max(), rtol=1e-5)
+
+
+def test_ingraph_monitor_inactive_steps_cost_nothing():
+    """Interval gating: a non-tic'd step takes the NORMAL jit path — no
+    monitored program, no stat fetch."""
+    exe = _bound_eval_exe()
+    mon = mx.monitor.Monitor(100, pattern=".*", mode="ingraph")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)  # batch 0: monitored (and warms both jits)
+    mon.toc()
+    mon.tic()  # batch 1 of 100: NOT activated
+    with profiler.count_dispatches() as d:
+        exe.forward(is_train=False)
+    assert mon.toc() == []
+    assert "executor.forward_monitored" not in d.by_site, d.as_dict()
+    assert d.host_transfers == 0, d.as_dict()
+
+
+def test_monitor_bad_mode_raises():
+    with pytest.raises(mx.base.MXNetError):
+        mx.monitor.Monitor(1, mode="traced")
+
+
+# ---------------------------------------------------------------------------
+# training-loop stream + report tool
+# ---------------------------------------------------------------------------
+
+def test_module_fit_emits_step_records():
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    mx.random.seed(0)
+    X, y = _data(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(1), context=mx.cpu())
+    mod.fit(it, num_epoch=1,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    steps = [r for r in sink.records if r.get("type") == "step"]
+    assert len(steps) == 4  # 128 / 32 batches
+    # the stream carries dispatch counts per step
+    assert steps[-1]["deltas"].get("dispatch.jit_entries", 0) >= 1
+    assert "storage" in steps[-1]  # collector contribution
+
+
+def test_telemetry_report_tool(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import telemetry_report
+
+    path = str(tmp_path / "t.jsonl")
+    telemetry.add_sink(telemetry.JsonlSink(path))
+    telemetry.inc("dispatch.jit_entries", 2)
+    telemetry.inc("kvstore.push_bytes", 1 << 20)
+    telemetry.observe("step.ms", 12.0)
+    telemetry.record_event("retrace", site="x", diagnosis="data: shape a->b")
+    telemetry.step_report()
+    telemetry.inc("dispatch.jit_entries", 2)
+    telemetry.observe("step.ms", 14.0)
+    telemetry.step_report()
+
+    records = telemetry_report.load(path)
+    assert len(records) == 2
+    summary = telemetry_report.summarize(records)
+    assert summary["steps"] == 2
+    assert summary["retrace_count"] == 1
+    assert summary["jit_entries_total"] == 4
+    assert summary["comm_gb"] == pytest.approx((1 << 20) / 1e9)
+    assert summary["step_ms_p50"] == pytest.approx(14.0)  # sorted[n//2]
+    text = telemetry_report.render(records)
+    assert "retrace" in text
+    assert "step" in telemetry_report.format_summary(summary)
+
+
+def test_prefetching_iter_reports_wait():
+    X, y = _data(n=64)
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, y, batch_size=32))
+    batches = 0
+    try:
+        while True:
+            it.next()
+            batches += 1
+    except StopIteration:
+        pass
+    assert batches == 2
+    rec = telemetry.step_report()
+    assert rec["hists"]["io.wait_ms"]["count"] >= batches

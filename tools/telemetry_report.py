@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL stream (mxnet_tpu.telemetry JsonlSink /
+MXNET_TELEMETRY_JSONL) into a per-step table and a run summary.
+
+    python tools/telemetry_report.py /path/to/telemetry.jsonl [--steps N]
+
+Per-step columns: step wall-clock, samples/sec gauge, jit-entry and
+host-transfer deltas, comm bytes delta (kvstore + dist PS), io wait, and
+retrace events.  The summary reports p50/p99 step ms, total retrace count
+(with diagnoses), cumulative comm GB, and total dispatches — the numbers a
+BENCH round needs to show the O(1)-dispatch contract held and nothing
+recompiled mid-run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+COMM_KEYS = ("kvstore.push_bytes", "kvstore.pull_bytes",
+             "dist.bytes_sent", "dist.bytes_recv")
+
+
+def load(path):
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a crashed run
+            if rec.get("type") == "step":
+                records.append(rec)
+    return records
+
+
+def _step_ms(rec):
+    h = rec.get("hists", {}).get("step.ms")
+    if h and h.get("count"):
+        return h["mean"]
+    return rec.get("wall_ms")
+
+
+def _comm_delta(rec):
+    d = rec.get("deltas", {})
+    return sum(int(d.get(k, 0)) for k in COMM_KEYS)
+
+
+def _fmt_bytes(n):
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if n >= div:
+            return "%.2f %s" % (n / div, unit)
+    return "%d B" % n
+
+
+def render(records, max_steps=None):
+    lines = []
+    rows = records if max_steps is None else records[-max_steps:]
+    lines.append("%6s %10s %12s %8s %8s %10s %9s %s" % (
+        "step", "step_ms", "samples/s", "jit", "xfers", "comm", "io_ms",
+        "events"))
+    for rec in rows:
+        d = rec.get("deltas", {})
+        g = rec.get("gauges", {})
+        io = rec.get("hists", {}).get("io.wait_ms", {})
+        evs = ",".join(e.get("kind", "?") for e in rec.get("events", []))
+        ms = _step_ms(rec)
+        sps = g.get("train.samples_per_sec")
+        lines.append("%6s %10s %12s %8d %8d %10s %9s %s" % (
+            rec.get("step", "?"),
+            "%.1f" % ms if ms is not None else "-",
+            "%.1f" % sps if sps is not None else "-",
+            int(d.get("dispatch.jit_entries", 0)),
+            int(d.get("dispatch.host_transfers", 0)),
+            _fmt_bytes(_comm_delta(rec)),
+            "%.1f" % io["mean"] if io.get("count") else "-",
+            evs))
+    return "\n".join(lines)
+
+
+def summarize(records):
+    if not records:
+        return {"steps": 0}
+    step_ms = sorted(ms for ms in (_step_ms(r) for r in records)
+                     if ms is not None)
+    retraces = [e for r in records for e in r.get("events", [])
+                if e.get("kind") == "retrace"]
+    # per-record counters hold cumulative values of only the counters that
+    # changed that step, so a counter's final total is its LAST appearance
+    # anywhere in the stream
+    final = {}
+    for r in records:
+        final.update(r.get("counters", {}))
+    comm = sum(int(final.get(k, 0)) for k in COMM_KEYS)
+    out = {
+        "steps": len(records),
+        "retrace_count": len(retraces),
+        "retraces": [{"site": e.get("site"),
+                      "diagnosis": e.get("diagnosis")} for e in retraces],
+        "jit_entries_total": int(final.get("dispatch.jit_entries", 0)),
+        "host_transfers_total": int(final.get("dispatch.host_transfers", 0)),
+        "comm_gb": comm / 1e9,
+    }
+    if step_ms:
+        n = len(step_ms)
+        out.update({
+            "step_ms_p50": step_ms[n // 2],
+            "step_ms_p99": step_ms[min(n - 1, int(n * 0.99))],
+            "step_ms_mean": sum(step_ms) / n,
+        })
+    healths = [r["health"] for r in records if "health" in r]
+    if healths:
+        out["last_health"] = healths[-1]
+        out["nonfinite_steps"] = sum(
+            1 for h in healths if h.get("nonfinite", 0))
+    return out
+
+
+def format_summary(summary):
+    lines = ["", "summary:"]
+    lines.append("  steps                %d" % summary.get("steps", 0))
+    if "step_ms_p50" in summary:
+        lines.append("  step ms p50/p99      %.1f / %.1f (mean %.1f)" % (
+            summary["step_ms_p50"], summary["step_ms_p99"],
+            summary["step_ms_mean"]))
+    lines.append("  jit entries          %d" %
+                 summary.get("jit_entries_total", 0))
+    lines.append("  host transfers       %d" %
+                 summary.get("host_transfers_total", 0))
+    lines.append("  comm                 %.3f GB" % summary.get("comm_gb", 0))
+    lines.append("  retraces             %d" %
+                 summary.get("retrace_count", 0))
+    for r in summary.get("retraces", []):
+        lines.append("    %s: %s" % (r["site"], r["diagnosis"]))
+    if "last_health" in summary:
+        h = summary["last_health"]
+        lines.append("  health (last step)   grad_norm=%.4g "
+                     "update_ratio=%.4g nonfinite=%d"
+                     % (h.get("grad_norm", 0), h.get("update_ratio", 0),
+                        h.get("nonfinite", 0)))
+        lines.append("  steps w/ nonfinite   %d" %
+                     summary.get("nonfinite_steps", 0))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry JSONL stream")
+    ap.add_argument("--steps", type=int, default=40,
+                    help="show at most the last N per-step rows (0 = all)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of text")
+    args = ap.parse_args(argv)
+    records = load(args.path)
+    if not records:
+        print("no step records in %s" % args.path, file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    if args.json:
+        print(json.dumps(summary, default=str))
+        return 0
+    print(render(records, max_steps=args.steps or None))
+    print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
