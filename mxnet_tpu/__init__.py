@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from . import base
 from .base import MXNetError
+from . import chaos
 from . import telemetry
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context

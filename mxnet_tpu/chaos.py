@@ -1,0 +1,195 @@
+"""Deterministic fault-injection (chaos) harness.
+
+Production fault tolerance is only trustworthy if every recovery path can
+be driven on one host, on demand, deterministically.  This module injects
+the faults the dist-PS / training-loop recovery layer (docs/
+fault_tolerance.md) claims to survive:
+
+* worker-side RPC transport failures (drops before AND after the request
+  reaches the server — the "after" half is what exercises idempotent
+  retries: the mutation landed but the ack was lost),
+* RPC delays,
+* parameter-server crash at the Nth state-mutating apply,
+* NaN/Inf gradients at the Nth fused optimizer update.
+
+Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
+
+    rpc_drop:P            with probability P an eligible worker RPC attempt
+                          fails with a transport error; each drop lands
+                          before or after the send with equal probability
+    rpc_delay:P:MS        with probability P delay an RPC attempt by MS ms
+    server_crash:N[:SID]  parameter server SID (default 0) calls
+                          os._exit(CRASH_EXIT_CODE) immediately after its
+                          Nth apply (before snapshotting it, so recovery
+                          must re-accumulate the round from retries)
+    nan_grad:N[:inf]      poison the gradients of fused-update call #N in
+                          this process with NaN (or +inf)
+
+Determinism: draws come from a ``numpy.random.RandomState`` seeded with
+``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
+(``DMLC_ROLE``/``DMLC_RANK``/``DMLC_SERVER_ID``), so a chaos run replays
+the same fault sequence every time — a recovery bug found under chaos is
+reproducible by rerunning the same command.
+
+Every hook re-reads ``MXNET_CHAOS`` per call (same live-flip contract as
+`optimizer.fused_update_enabled`); with the variable unset each hook is a
+single dict lookup and compare, cheap enough for the RPC hot path.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import zlib
+
+import numpy as np
+
+__all__ = [
+    "ChaosError", "CRASH_EXIT_CODE", "enabled", "spec", "reset",
+    "rpc_action", "maybe_crash_server", "grad_poison",
+]
+
+# distinct from generic python failures so a supervisor (tools/launch.py
+# --restart-servers) can tell an injected crash from a real bug
+CRASH_EXIT_CODE = 43
+
+
+class ChaosError(OSError):
+    """Injected transport failure.  Subclasses OSError so the dist-PS
+    worker treats it exactly like a real socket error (retry path)."""
+
+
+class _Spec:
+    """Parsed MXNET_CHAOS spec + the per-process deterministic RNG and
+    injection counters."""
+
+    def __init__(self, raw):
+        self.raw = raw
+        self.rpc_drop = 0.0
+        self.rpc_delay = (0.0, 0.0)       # (probability, milliseconds)
+        self.server_crash = None          # (apply_count, server_id)
+        self.nan_grad = None              # (call_index, np value)
+        for clause in filter(None, (c.strip() for c in raw.split(","))):
+            parts = clause.split(":")
+            kind = parts[0]
+            if kind == "rpc_drop":
+                self.rpc_drop = float(parts[1])
+            elif kind == "rpc_delay":
+                self.rpc_delay = (float(parts[1]),
+                                  float(parts[2]) if len(parts) > 2 else 50.0)
+            elif kind == "server_crash":
+                self.server_crash = (int(parts[1]),
+                                     int(parts[2]) if len(parts) > 2 else 0)
+            elif kind == "nan_grad":
+                val = np.inf if len(parts) > 2 and parts[2] == "inf" \
+                    else np.nan
+                self.nan_grad = (int(parts[1]), val)
+            else:
+                raise ValueError(
+                    "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
+        seed = int(os.environ.get("MXNET_CHAOS_SEED", "0"))
+        role = os.environ.get("DMLC_ROLE", "local")
+        rank = os.environ.get("DMLC_RANK", os.environ.get("DMLC_SERVER_ID",
+                                                          "0"))
+        mix = zlib.crc32(("%s/%s" % (role, rank)).encode())
+        self.rng = np.random.RandomState((seed + mix) & 0x7FFFFFFF)
+        self.fused_update_calls = 0
+        self.lock = threading.Lock()
+
+
+_CACHE = (None, None)   # (raw env string, _Spec)
+_CACHE_LOCK = threading.Lock()
+
+
+def spec():
+    """The parsed spec for the current MXNET_CHAOS value, or None.  Cached
+    on the raw string so tests that monkeypatch the env get a fresh parse
+    (and fresh deterministic RNG/counters) per distinct value."""
+    global _CACHE
+    raw = os.environ.get("MXNET_CHAOS")
+    if not raw:
+        return None
+    cached_raw, cached = _CACHE
+    if cached_raw == raw:
+        return cached
+    with _CACHE_LOCK:
+        cached_raw, cached = _CACHE
+        if cached_raw != raw:
+            cached = _Spec(raw)
+            _CACHE = (raw, cached)
+    return cached
+
+
+def enabled():
+    return spec() is not None
+
+
+def reset():
+    """Drop the cached spec (tests): the next hook call re-parses the env
+    and restarts the deterministic draw sequence."""
+    global _CACHE
+    with _CACHE_LOCK:
+        _CACHE = (None, None)
+
+
+# RPC ops eligible for injection: the idempotent data plane.  Heartbeats
+# are exempt (they have their own reconnect loop; starving them would turn
+# every chaos run into a watchdog false-positive test), as are the
+# terminal control ops.
+_INJECT_OPS = frozenset(("push", "pull", "init", "barrier"))
+
+
+def rpc_action(op):
+    """Decide the fate of one worker RPC attempt.  Returns None (proceed),
+    ``("drop_before", None)``, ``("drop_after", None)`` or
+    ``("delay", milliseconds)``."""
+    s = spec()
+    if s is None or op not in _INJECT_OPS:
+        return None
+    with s.lock:
+        if s.rpc_drop > 0 and s.rng.random_sample() < s.rpc_drop:
+            side = "drop_after" if s.rng.random_sample() < 0.5 \
+                else "drop_before"
+            return (side, None)
+        p, ms = s.rpc_delay
+        if p > 0 and s.rng.random_sample() < p:
+            return ("delay", ms)
+    return None
+
+
+def maybe_crash_server(apply_count, rehydrated=False):
+    """Called by the parameter server after each state-mutating apply,
+    BEFORE the round is snapshotted or acked — a crash here loses the
+    round, so recovery must rebuild it from worker retries.
+
+    ``rehydrated`` servers (respawned from a snapshot) are exempt: the
+    persisted apply_count re-reaches N right after recovery, and crashing
+    again there would loop the job forever instead of testing one
+    crash-and-recover cycle."""
+    s = spec()
+    if s is None or s.server_crash is None or rehydrated:
+        return
+    at, sid = s.server_crash
+    if int(os.environ.get("DMLC_SERVER_ID", "0")) != sid:
+        return
+    if apply_count == at:
+        logging.error("chaos: server %d crashing at apply %d "
+                      "(MXNET_CHAOS=%s)", sid, apply_count, s.raw)
+        os._exit(CRASH_EXIT_CODE)
+
+
+def grad_poison():
+    """Poison value for the CURRENT fused optimizer update call, or None.
+    Each call to this function counts one fused update in this process
+    (1-based), matching the ``nan_grad:N`` clause index."""
+    s = spec()
+    if s is None or s.nan_grad is None:
+        return None
+    with s.lock:
+        s.fused_update_calls += 1
+        at, val = s.nan_grad
+        if s.fused_update_calls == at:
+            logging.warning("chaos: poisoning gradients of fused update "
+                            "call %d with %r", at, val)
+            return val
+    return None

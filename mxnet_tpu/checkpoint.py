@@ -118,3 +118,92 @@ def restore_updater(updater, states):
     """Install loaded optimizer state into a `get_updater` closure."""
     updater.states.clear()
     updater.states.update(states)
+
+
+# ---------------------------------------------------------------------------
+# Mid-epoch auto-checkpoints (fault tolerance: docs/fault_tolerance.md)
+# ---------------------------------------------------------------------------
+#
+# Epoch-granular checkpoints lose up to a whole epoch of work to a crash.
+# `save_auto` is the training loops' periodic mid-epoch checkpoint: ONE
+# atomically-replaced file holding params, optimizer state (including the
+# per-key update counts schedulers key off), the (epoch, nbatch) cursor,
+# and the RNG state — both at save time and as of the current epoch's
+# start, so a resume can replay the epoch's data-iterator shuffle before
+# fast-forwarding to the cursor.  `fit(..., resume="auto")` restores all
+# of it, making training continue bit-for-bit after a kill -9.
+
+
+def save_auto(prefix, arg_params, aux_params, updater=None, epoch=0,
+              nbatch=0, epoch_rng=None, extra=None):
+    """Write `prefix`-auto.ckpt atomically.  ``nbatch`` is the number of
+    completed batches of ``epoch``; ``epoch_rng`` is the `random.get_state`
+    snapshot taken just before the epoch's data-iterator reset (needed to
+    replay shuffling iterators on resume)."""
+    from . import random as _random
+    from . import telemetry
+
+    state = {
+        "format": 1,
+        "arg": {k: v.asnumpy() for k, v in arg_params.items()},
+        "aux": {k: v.asnumpy() for k, v in aux_params.items()},
+        "epoch": int(epoch),
+        "nbatch": int(nbatch),
+        "rng": _random.get_state(),
+        "epoch_rng": epoch_rng,
+        "extra": dict(extra or {}),
+    }
+    if updater is not None:
+        states = getattr(updater, "states", None)
+        if states is not None:
+            state["states"] = _states_to_host(states)
+        opt = getattr(updater, "optimizer", None)
+        if opt is not None:
+            state["opt_counts"] = (dict(opt._index_update_count),
+                                   int(opt.num_update))
+            # lr is mutable at runtime (MXNET_NONFINITE_BACKOFF shrinks
+            # it); a resume must continue from the backed-off value, not
+            # the constructor's
+            state["opt_lr"] = float(opt.lr)
+    blob = pickle.dumps(state, protocol=4)
+    _atomic_write("%s-auto.ckpt" % prefix,
+                  lambda p: open(p, "wb").write(blob))
+    telemetry.inc("train.auto_checkpoints")
+    telemetry.record_event("auto_checkpoint", epoch=int(epoch),
+                           nbatch=int(nbatch))
+
+
+def load_auto(prefix):
+    """Load `prefix`-auto.ckpt, or None if absent.  arg/aux come back as
+    NDArrays, optimizer states device-resident; cursor and RNG snapshots
+    pass through for the training loop to apply."""
+    from .ndarray import array
+
+    path = "%s-auto.ckpt" % prefix
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        state = pickle.loads(f.read())
+    state["arg"] = {k: array(v) for k, v in state["arg"].items()}
+    state["aux"] = {k: array(v) for k, v in state["aux"].items()}
+    if state.get("states") is not None:
+        state["states"] = _states_from_host(state["states"])
+    return state
+
+
+def restore_auto(state, updater=None):
+    """Apply a `load_auto` result's optimizer state onto a freshly-built
+    updater: per-key states plus the update counts (schedulers and Adam
+    bias correction must resume where they left off)."""
+    if updater is None or state is None:
+        return
+    if state.get("states") is not None and hasattr(updater, "states"):
+        updater.states.clear()
+        updater.states.update(state["states"])
+    opt = getattr(updater, "optimizer", None)
+    counts = state.get("opt_counts")
+    if opt is not None and counts is not None:
+        opt._index_update_count = dict(counts[0])
+        opt.num_update = int(counts[1])
+    if opt is not None and state.get("opt_lr") is not None:
+        opt.lr = state["opt_lr"]

@@ -139,6 +139,12 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self.shuffle:
+            # re-derive the permutation from scratch: the epoch's order
+            # must be a pure function of the RNG state at reset time (an
+            # in-place shuffle composes with every PREVIOUS epoch's), so
+            # auto-resume can replay one epoch's order from one saved RNG
+            # snapshot (checkpoint.save_auto / docs/fault_tolerance.md)
+            self._order = np.arange(self.num_data)
             np.random.shuffle(self._order)
         if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor - self.num_data)

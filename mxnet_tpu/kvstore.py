@@ -200,8 +200,20 @@ class KVStore:
                 src = self._store[k]
             else:
                 raise MXNetError("key %r not initialized" % k)
+            data = src.data
+            if getattr(data, "is_deleted", None) is not None \
+                    and data.is_deleted():
+                # pull pointer-shares the store's buffer with the puller;
+                # if a fused update donated that shared buffer, surface
+                # the contract violation here instead of a raw XLA
+                # "Array has been deleted" deep inside copyto
+                raise MXNetError(
+                    "stored value for key %r was deleted — its buffer was "
+                    "shared with a puller whose updater donated it; build "
+                    "updaters with donate=False when a kvstore is "
+                    "attached (get_fused_updater(opt, donate=False))" % k)
             telemetry.inc("kvstore.pull_bytes",
-                          int(getattr(src.data, "nbytes", 0)) * len(olist))
+                          int(getattr(data, "nbytes", 0)) * len(olist))
             for o in olist:
                 src.copyto(o)
         telemetry.inc("kvstore.pull_calls")

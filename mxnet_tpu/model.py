@@ -11,14 +11,17 @@ reference (SURVEY §5.4): optimizer state can be checkpointed too.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as np
 
+from . import checkpoint as checkpoint_mod
 from . import initializer as init_mod
 from . import kvstore as kvs_mod
 from . import metric as metric_mod
 from . import ndarray as nd
+from . import random as random_mod
 from . import telemetry
 from .base import MXNetError
 from .callback import BatchEndParam
@@ -117,13 +120,18 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
                     [g[k] for _, _, g in live],
                     [a[k] for _, a, _ in live])
         return
-    for index, arg_list, grad_list in live:
-        if kvstore:
+    if kvstore:
+        for index, arg_list, grad_list in live:
             kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
+    # device-major like the fused path above (all of device k's params,
+    # then device k+1's): RNG-consuming optimizers (SGLD noise, Adam bf16
+    # stochastic rounding) draw one key per update in call order, so the
+    # MXNET_FUSED_UPDATE=0 kill-switch is only bit-for-bit at
+    # num_device > 1 if both paths consume the stream in the same order
+    for k in range(num_device):
+        for index, arg_list, grad_list in live:
+            updater(index * num_device + k, grad_list[k], arg_list[k])
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
@@ -152,16 +160,86 @@ def load_checkpoint(prefix, epoch):
     return symbol, arg_params, aux_params
 
 
+def _auto_checkpoint_config(auto_checkpoint, checkpoint_every, resume):
+    """Resolve the auto-checkpoint knobs shared by `_train_multi_device`
+    and `BaseModule.fit`: explicit fit() arguments win, the MXNET_AUTO_*
+    env tier fills the gaps (so launcher-driven jobs opt in without code
+    changes).  Returns (prefix_or_None, every, resume)."""
+    prefix = auto_checkpoint or os.environ.get("MXNET_AUTO_CHECKPOINT") \
+        or None
+    every = checkpoint_every or int(
+        os.environ.get("MXNET_AUTO_CHECKPOINT_EVERY", "0") or 0)
+    if resume is None and os.environ.get(
+            "MXNET_AUTO_RESUME", "0").lower() in ("1", "true", "yes"):
+        resume = "auto"
+    return prefix, every, resume
+
+
+def _nonfinite_backoff():
+    """MXNET_NONFINITE_BACKOFF=<factor>: after a step whose gradients were
+    nonfinite (detected via the staged in-graph health stats, one small
+    host fetch per step while enabled), multiply the optimizer lr by the
+    factor.  lr flows host-side through `_step_scalars` on every call and
+    never enters a trace, so the backoff is retrace-free — the TPU
+    analogue of a loss-scale backoff."""
+    return float(os.environ.get("MXNET_NONFINITE_BACKOFF", "0") or 0)
+
+
+def _backoff_active(backoff, optimizer, kvstore, update_on_kvstore, logger):
+    """Whether the lr backoff can actually reach the updates — mutating
+    `optimizer.lr` is inert (and claiming otherwise in logs would lie)
+    when a scheduler owns the effective lr, or when updates run on a
+    remote parameter server's pickled optimizer copy."""
+    if not backoff or optimizer is None:
+        return False
+    if getattr(optimizer, "lr_scheduler", None) is not None:
+        logger.warning(
+            "MXNET_NONFINITE_BACKOFF ignored: the optimizer has an "
+            "lr_scheduler, which (not optimizer.lr) decides the "
+            "effective lr")
+        return False
+    if update_on_kvstore and kvstore is not None \
+            and "dist" in kvstore.type:
+        logger.warning(
+            "MXNET_NONFINITE_BACKOFF ignored: updates run on the "
+            "parameter server's optimizer copy, which a worker-side lr "
+            "mutation cannot reach")
+        return False
+    return True
+
+
+def _poll_nonfinite_backoff(optimizer, backoff, logger):
+    """Per-step backoff check shared by the training loops: drain the
+    staged health stats; if any update in the window saw nonfinite
+    gradients, back the lr off once and record the event."""
+    bad = telemetry.consume_nonfinite()
+    if bad:
+        optimizer.lr *= backoff
+        logger.warning("nonfinite gradients in %d update(s): lr backed "
+                       "off to %g", bad, optimizer.lr)
+        telemetry.record_event("lr_backoff", lr=optimizer.lr, steps=bad)
+
+
 def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                         arg_params, aux_params, begin_epoch, end_epoch,
                         epoch_size, optimizer, kvstore, update_on_kvstore,
                         train_data, eval_data=None, eval_metric=None,
                         epoch_end_callback=None, batch_end_callback=None,
                         logger=None, work_load_list=None, monitor=None,
-                        eval_batch_end_callback=None):
-    """The canonical loop (`model.py:119-312`)."""
+                        eval_batch_end_callback=None, auto_checkpoint=None,
+                        checkpoint_every=0, resume=None):
+    """The canonical loop (`model.py:119-312`), hardened for faults:
+    periodic mid-epoch atomic auto-checkpoints (params, optimizer state,
+    epoch/batch cursor, RNG keys) via `checkpoint.save_auto`, exact resume
+    after kill -9 with ``resume="auto"``, and an optional lr backoff on
+    nonfinite-gradient steps (see docs/fault_tolerance.md)."""
     if logger is None:
         logger = logging
+    auto_prefix, auto_every, resume = _auto_checkpoint_config(
+        auto_checkpoint, checkpoint_every, resume)
+    backoff = _nonfinite_backoff()
+    backoff = backoff if _backoff_active(backoff, optimizer, kvstore,
+                                         update_on_kvstore, logger) else 0
     executor_manager = DataParallelExecutorManager(
         symbol=symbol, ctx=ctx, train_data=train_data,
         param_names=param_names, arg_names=arg_names, aux_names=aux_names,
@@ -169,14 +247,43 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
     )
     if monitor:
         executor_manager.install_monitor(monitor)
+
+    resume_state = None
+    resume_batch = 0
+    if auto_prefix and resume == "auto":
+        resume_state = checkpoint_mod.load_auto(auto_prefix)
+    if resume_state is not None:
+        # params restored in place so both the executors (set_params
+        # below) and a dist kvstore init (rank 0 pushes arg_params) see
+        # the checkpointed values
+        for k, v in resume_state["arg"].items():
+            if k in arg_params:
+                v.copyto(arg_params[k])
+        for k, v in resume_state["aux"].items():
+            if k in aux_params:
+                v.copyto(aux_params[k])
+        begin_epoch = resume_state["epoch"]
+        resume_batch = resume_state["nbatch"]
+        logger.info("auto-resume from %s-auto.ckpt: epoch %d, batch %d",
+                    auto_prefix, begin_epoch, resume_batch)
+        telemetry.inc("train.resumes")
+        telemetry.record_event("resume", epoch=begin_epoch,
+                               nbatch=resume_batch)
     executor_manager.set_params(arg_params, aux_params)
 
     updater = None
     if not update_on_kvstore:
         # fused multi-tensor updater: one jitted optimizer dispatch per
         # device per step instead of one per parameter; honors the
-        # MXNET_FUSED_UPDATE=0 kill-switch per call
-        updater = get_fused_updater(optimizer)
+        # MXNET_FUSED_UPDATE=0 kill-switch per call.  Donation is only
+        # safe without a kvstore: `kvstore.pull` pointer-shares the
+        # store's buffer into the pulled array, and donating a shared
+        # buffer deletes the store's copy out from under a later pull
+        updater = get_fused_updater(optimizer, donate=kvstore is None)
+        if resume_state is not None:
+            # optimizer state (momentum/EMA tables + update counts) must
+            # resume exactly, or the first post-resume steps diverge
+            checkpoint_mod.restore_auto(resume_state, updater)
     if kvstore:
         _initialize_kvstore(
             kvstore=kvstore,
@@ -187,15 +294,44 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
         )
     if update_on_kvstore:
         kvstore.set_optimizer(optimizer)
+    # the optimizer state to checkpoint: the local fused updater, or —
+    # with update_on_kvstore on an in-process store — the updater the
+    # kvstore installed.  (A DistKVStore updates on the server; its state
+    # recovers through the server snapshots, not the worker checkpoint.)
+    ckpt_updater = updater if updater is not None \
+        else getattr(kvstore, "_updater", None)
+    if update_on_kvstore and resume_state is not None:
+        checkpoint_mod.restore_auto(resume_state, ckpt_updater)
+    # only one writer per job: in dist mode every rank would otherwise
+    # clobber the same -auto.ckpt (BSP ranks hold identical params, so
+    # rank 0's file serves everyone's resume)
+    auto_writer = auto_prefix and auto_every and (
+        kvstore is None or kvstore.rank == 0)
 
+    if resume_state is not None and resume_state.get("epoch_rng"):
+        # the epoch's shuffle was drawn at the reset below; replaying it
+        # needs the RNG as it stood at the ORIGINAL epoch start
+        random_mod.set_state(resume_state["epoch_rng"])
+    epoch_rng = random_mod.get_state()
     train_data.reset()
+    if resume_state is not None:
+        # ...and everything after the reset continues from the exact
+        # checkpoint-time stream (optimizer noise, stochastic rounding)
+        random_mod.set_state(resume_state["rng"])
     for epoch in range(begin_epoch, end_epoch):
         tic = time.time()
         eval_metric.reset()
         nbatch = 0
+        skip = 0
+        if resume_state is not None and epoch == begin_epoch:
+            # fast-forward the replayed shuffle to the batch cursor
+            nbatch = skip = resume_batch
         while True:
             do_reset = True
             for data_batch in train_data:
+                if skip > 0:
+                    skip -= 1
+                    continue
                 if monitor is not None:
                     monitor.tic()
                 executor_manager.load_data_batch(data_batch)
@@ -215,6 +351,8 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                         num_device=len(ctx),
                         kvstore=kvstore,
                     )
+                if backoff:
+                    _poll_nonfinite_backoff(optimizer, backoff, logger)
                 if monitor is not None:
                     monitor.toc_print()
                 executor_manager.update_metric(eval_metric, data_batch.label)
@@ -230,11 +368,20 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                 # one telemetry record per step (free until a sink is
                 # attached via MXNET_TELEMETRY_JSONL or add_sink)
                 telemetry.step_end(extra={"epoch": epoch, "nbatch": nbatch})
+                if auto_writer and nbatch % auto_every == 0:
+                    # atomic mid-epoch checkpoint: a kill -9 any time
+                    # after this line resumes from exactly here
+                    executor_manager.copy_to(arg_params, aux_params)
+                    checkpoint_mod.save_auto(
+                        auto_prefix, arg_params, aux_params,
+                        updater=ckpt_updater, epoch=epoch, nbatch=nbatch,
+                        epoch_rng=epoch_rng)
                 if epoch_size is not None and nbatch >= epoch_size:
                     do_reset = False
                     break
             if do_reset:
                 logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+                epoch_rng = random_mod.get_state()
                 train_data.reset()
             if epoch_size is None or nbatch >= epoch_size:
                 break
@@ -242,6 +389,12 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
         logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
         executor_manager.copy_to(arg_params, aux_params)
+        if auto_writer:
+            # epoch-boundary cursor: a crash between epochs resumes at
+            # (epoch+1, 0) with the next epoch's shuffle replayable
+            checkpoint_mod.save_auto(
+                auto_prefix, arg_params, aux_params, updater=ckpt_updater,
+                epoch=epoch + 1, nbatch=0, epoch_rng=epoch_rng)
 
         if epoch_end_callback or epoch + 1 == end_epoch:
             if epoch_end_callback is not None:
@@ -449,8 +602,17 @@ class FeedForward(BASE_ESTIMATOR):
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_batch_end_callback=None):
-        """Train (`model.py:694-790`)."""
+            eval_batch_end_callback=None, auto_checkpoint=None,
+            checkpoint_every=0, resume=None):
+        """Train (`model.py:694-790`).
+
+        Fault tolerance: ``auto_checkpoint=<prefix>`` +
+        ``checkpoint_every=<batches>`` write periodic mid-epoch atomic
+        checkpoints (params, optimizer state, epoch/batch cursor, RNG);
+        ``resume="auto"`` restores the latest one exactly — a training
+        job killed mid-epoch (even kill -9) continues bit-for-bit.  The
+        MXNET_AUTO_CHECKPOINT / _EVERY / MXNET_AUTO_RESUME env vars set
+        the same knobs for unmodified scripts (docs/fault_tolerance.md)."""
         data = self._init_iter(X, y, is_train=True)
         eval_data = self._init_eval_iter(eval_data)
 
@@ -501,6 +663,8 @@ class FeedForward(BASE_ESTIMATOR):
             kvstore=kvstore, update_on_kvstore=update_on_kvstore,
             logger=logger, work_load_list=work_load_list, monitor=monitor,
             eval_batch_end_callback=eval_batch_end_callback,
+            auto_checkpoint=auto_checkpoint,
+            checkpoint_every=checkpoint_every, resume=resume,
         )
 
     sym_gen = None  # bucketing support via sym_gen, like the reference

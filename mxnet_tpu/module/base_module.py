@@ -120,36 +120,111 @@ class BaseModule:
             eval_batch_end_callback=None, initializer=None,
             arg_params=None, aux_params=None, allow_missing=False,
             force_rebind=False, force_init=False, begin_epoch=0,
-            num_epoch=None, monitor=None):
-        """Generic fit (`base_module.py:237`)."""
+            num_epoch=None, monitor=None, auto_checkpoint=None,
+            checkpoint_every=0, resume=None):
+        """Generic fit (`base_module.py:237`).
+
+        Fault tolerance (docs/fault_tolerance.md): ``auto_checkpoint=
+        <prefix>`` + ``checkpoint_every=<batches>`` write periodic
+        mid-epoch atomic checkpoints and ``resume="auto"`` restores the
+        latest one — params, optimizer state, epoch/batch cursor and RNG —
+        so a kill -9'd fit continues exactly.  MXNET_NONFINITE_BACKOFF
+        (with the MXNET_NONFINITE_GUARD skip) backs the lr off after a
+        nonfinite-gradient step."""
+        from .. import checkpoint as checkpoint_mod
         from .. import initializer as init_mod
+        from .. import random as random_mod
+        from ..model import (_auto_checkpoint_config, _backoff_active,
+                             _nonfinite_backoff, _poll_nonfinite_backoff)
 
         if num_epoch is None:
             raise MXNetError("num_epoch must be specified")
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
         optimizer_params = optimizer_params or {"learning_rate": 0.01}
+        auto_prefix, auto_every, resume = _auto_checkpoint_config(
+            auto_checkpoint, checkpoint_every, resume)
+        backoff = _nonfinite_backoff()
+        resume_state = None
+        resume_batch = 0
+        if auto_prefix and resume == "auto":
+            resume_state = checkpoint_mod.load_auto(auto_prefix)
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        # checkpointed params go in as the INITIAL values, before
+        # init_optimizer: with update_on_kvstore, _initialize_kvstore
+        # pushes this module's params into the store, and restoring only
+        # after would leave the store serving the random init
+        self.init_params(
+            initializer=initializer,
+            arg_params=resume_state["arg"] if resume_state else arg_params,
+            aux_params=resume_state["aux"] if resume_state else aux_params,
+            allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        kv = getattr(self, "_kvstore", None)
+        auto_writer = auto_prefix and auto_every and (
+            kv is None or getattr(kv, "rank", 0) == 0)
+        backoff = backoff if _backoff_active(
+            backoff, getattr(self, "_optimizer", None), kv,
+            getattr(self, "_update_on_kvstore", False), self.logger) else 0
+        # optimizer state to checkpoint: the module's local fused updater,
+        # or — with update_on_kvstore on an in-process store — the one the
+        # kvstore installed (a DistKVStore's state recovers through the
+        # server snapshots instead)
+        ckpt_updater = getattr(self, "_updater", None) \
+            or getattr(kv, "_updater", None)
+        if resume_state is not None:
+            # when the update runs locally, its optimizer state must
+            # resume too (on-kvstore updates recover through the dist-PS
+            # server snapshots instead)
+            checkpoint_mod.restore_auto(resume_state, ckpt_updater)
+            begin_epoch = resume_state["epoch"]
+            resume_batch = resume_state["nbatch"]
+            self.logger.info("auto-resume from %s-auto.ckpt: epoch %d, "
+                             "batch %d", auto_prefix, begin_epoch,
+                             resume_batch)
+            telemetry.inc("train.resumes")
+            telemetry.record_event("resume", epoch=begin_epoch,
+                                   nbatch=resume_batch)
+            if resume_state.get("epoch_rng"):
+                # replay the interrupted epoch's shuffle: restore the RNG
+                # as of the original epoch start, then reset
+                random_mod.set_state(resume_state["epoch_rng"])
+        # RNG as of this epoch's iterator order, for exact resume replay
+        epoch_rng = random_mod.get_state()
+        if auto_prefix:
+            # with checkpointing on, the first epoch's order must be the
+            # replayable reset() order (a construction-time shuffle
+            # predates fit and could not be replayed on resume); without
+            # it, keep the historical no-initial-reset behavior
+            train_data.reset()
+        if resume_state is not None:
+            # ...and everything after the reset continues from the exact
+            # checkpoint-time stream (optimizer noise, rounding draws)
+            random_mod.set_state(resume_state["rng"])
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            skip = resume_batch if (resume_state is not None
+                                    and epoch == begin_epoch) else 0
             for nbatch, data_batch in enumerate(train_data):
+                if nbatch < skip:
+                    continue
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if backoff:
+                    _poll_nonfinite_backoff(self._optimizer, backoff,
+                                            self.logger)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -164,6 +239,13 @@ class BaseModule:
                 # one telemetry record per step (free until a sink is
                 # attached via MXNET_TELEMETRY_JSONL or add_sink)
                 telemetry.step_end(extra={"epoch": epoch, "nbatch": nbatch})
+                if auto_writer and (nbatch + 1) % auto_every == 0:
+                    # atomic: a kill -9 after this line resumes from here
+                    arg_p, aux_p = self.get_params()
+                    checkpoint_mod.save_auto(
+                        auto_prefix, arg_p, aux_p, updater=ckpt_updater,
+                        epoch=epoch, nbatch=nbatch + 1,
+                        epoch_rng=epoch_rng)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
@@ -182,7 +264,14 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
+            epoch_rng = random_mod.get_state()
             train_data.reset()
+            if auto_writer:
+                # epoch-boundary cursor: a crash between epochs resumes
+                # at (epoch+1, 0) with the next epoch's shuffle replayable
+                checkpoint_mod.save_auto(
+                    auto_prefix, arg_p, aux_p, updater=ckpt_updater,
+                    epoch=epoch + 1, nbatch=0, epoch_rng=epoch_rng)
 
     def set_params(self, arg_params, aux_params):
         self.init_params(initializer=None, arg_params=arg_params,

@@ -154,8 +154,13 @@ class Module(BaseModule):
             # fused multi-tensor updater (one jitted dispatch per device
             # per update()); it honors the MXNET_FUSED_UPDATE=0
             # kill-switch per call, so installing it unconditionally keeps
-            # mid-session flips working
-            self._updater = get_fused_updater(optimizer)
+            # mid-session flips working.  Donation only without a kvstore:
+            # `kvstore.pull` pointer-shares the store's buffer into the
+            # pulled array, and donating a shared buffer deletes the
+            # store's copy — a later `kv.pull` of that key would raise
+            # "Array has been deleted"
+            self._updater = get_fused_updater(optimizer,
+                                              donate=kvstore is None)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):

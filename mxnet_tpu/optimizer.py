@@ -35,13 +35,14 @@ import jax.numpy as jnp
 
 from .base import MXNetError, silence_cpu_donation_warning
 from .ndarray import NDArray, zeros
+from . import chaos
 from . import profiler
 from . import random as _random
 from . import telemetry
 
 __all__ = ["Optimizer", "SGD", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
            "AdaDelta", "Test", "create", "get_updater", "get_fused_updater",
-           "fused_update_enabled", "register"]
+           "fused_update_enabled", "nonfinite_guard_enabled", "register"]
 
 
 def fused_update_enabled():
@@ -49,6 +50,27 @@ def fused_update_enabled():
     tests and debugging sessions can flip it without rebuilding objects."""
     return os.environ.get("MXNET_FUSED_UPDATE", "1").lower() not in (
         "0", "false", "no")
+
+
+def nonfinite_guard_enabled():
+    """MXNET_NONFINITE_GUARD=1: `update_multi` computes the bucket's global
+    nonfinite-gradient count IN-GRAPH and, when any gradient element is
+    NaN/Inf, keeps every weight and optimizer state of the bucket unchanged
+    (a skipped step) — decided inside the same fused program, so the guard
+    adds zero dispatches per step.  The skip surfaces through the staged
+    health stats (`telemetry.health()` / the step report), one step
+    deferred, where the loops count it and optionally back off the lr
+    (MXNET_NONFINITE_BACKOFF).
+
+    Host-side schedule counters (`num_update`, per-key counts) still
+    advance on a skipped step — they are computed before the device sees
+    the gradients — so optimizers whose math depends on the step count
+    (Adam bias correction) are not bit-identical to a run where the bad
+    step never happened; count-independent optimizers (SGD) are.  The
+    guard rides the fused path only: under MXNET_FUSED_UPDATE=0 per-key
+    updates cannot see the bucket-global flag and the guard is inert."""
+    return os.environ.get("MXNET_NONFINITE_GUARD", "0").lower() in (
+        "1", "true", "yes")
 
 
 def _state_arrays(state):
@@ -287,6 +309,13 @@ class Optimizer:
         s_arrs = [_state_arrays(s) for s in states]
         sc = jnp.asarray(scalars, jnp.float32)  # (n, k): one transfer
         key_arr = jnp.stack(keys) if keys[0] is not None else None
+        if chaos.enabled():
+            # fault injection (MXNET_CHAOS=nan_grad:N): poison this fused
+            # update call's gradients so the nonfinite guard below is
+            # testable end-to-end
+            poison = chaos.grad_poison()
+            if poison is not None:
+                g_arrs = [jnp.full_like(g, poison) for g in g_arrs]
 
         if donate:
             # donating the same buffer twice is invalid: optimizers whose
@@ -307,14 +336,29 @@ class Optimizer:
         # global grad/update/param second moments and nonfinite count are
         # computed INSIDE the same fused program — the stats bundle is an
         # extra small output, not an extra dispatch, and its host fetch is
-        # deferred to telemetry.step_report()/health().
-        health = telemetry.health_enabled()
-        self._watch_retrace(indices, w_arrs, donate, health)
+        # deferred to telemetry.step_report()/health().  The nonfinite
+        # guard (MXNET_NONFINITE_GUARD=1) rides the same moments: when any
+        # gradient element is NaN/Inf, every weight/state output of the
+        # bucket is jnp.where'd back to its input — the whole step skips
+        # with zero extra dispatches.
+        guard = nonfinite_guard_enabled()
+        health = telemetry.health_enabled() or guard
+        self._watch_retrace(indices, w_arrs, donate, health, guard)
 
-        def build(donate=donate, health=health):
+        def build(donate=donate, health=health, guard=guard):
             def apply(ws, gs, ss, sc, key_arr):
                 new_ws, new_ss = [], []
                 moments = jnp.zeros((4,), jnp.float32) if health else None
+                if guard:
+                    # global flag over the WHOLE bucket, computed before
+                    # any update output is formed (XLA CSEs the per-grad
+                    # isfinite reductions with the health moments below)
+                    bad = jnp.zeros((), jnp.float32)
+                    for g in gs:
+                        bad = bad + jnp.sum(
+                            ~jnp.isfinite(g.astype(jnp.float32))
+                        ).astype(jnp.float32)
+                    bad = bad > 0
                 for i in range(len(ws)):
                     # same weak-float-like scalar/result dtype handling as
                     # the per-key driver in `update` — the two must stay
@@ -325,6 +369,14 @@ class Optimizer:
                     nw, ns = self._update_math(ws[i], gs[i], ss[i], scal,
                                                key=k)
                     nw = nw.astype(ws[i].dtype)
+                    if guard:
+                        nw = jnp.where(bad, ws[i], nw)
+                        if isinstance(ns, (tuple, list)):
+                            ns = tuple(
+                                None if n is None else jnp.where(bad, o, n)
+                                for o, n in zip(ss[i], ns))
+                        elif ns is not None:
+                            ns = jnp.where(bad, ss[i], ns)
                     if health:
                         gf = gs[i].astype(jnp.float32)
                         wf = ws[i].astype(jnp.float32)
@@ -346,7 +398,7 @@ class Optimizer:
         if donate:
             silence_cpu_donation_warning()
         kind = ("multi_donate" if donate else "multi_keep") + \
-            ("_health" if health else "")
+            ("_health" if health else "") + ("_guard" if guard else "")
         fused = self._jit_for(kind, build)
         out = fused(w_arrs, g_arrs, s_arrs, sc, key_arr)
         if health:
@@ -361,7 +413,7 @@ class Optimizer:
             _store_state(s, ns)
         profiler.record_dispatch("optimizer.update_multi")
 
-    def _watch_retrace(self, indices, w_arrs, donate, health):
+    def _watch_retrace(self, indices, w_arrs, donate, health, guard=False):
         """Retrace watchdog over the fused update program: a changed
         bucket shape profile, a donation fallback, or a mutated traced
         hyperparameter (e.g. ``opt.rescale_grad = ...`` mid-run, which
@@ -377,6 +429,7 @@ class Optimizer:
         sig = telemetry.arrays_signature(
             w_arrs, ["w%d" % i for i in range(len(w_arrs))])
         meta = {"donate": bool(donate), "health": bool(health),
+                "guard": bool(guard),
                 "device": str(getattr(w_arrs[0], "device", None))
                 if w_arrs else "none"}
         for k, v in self._trace_key():
@@ -660,4 +713,5 @@ def get_fused_updater(optimizer, donate=True):
     updater.optimizer = optimizer
     updater.states = states
     updater.supports_multi = True
+    updater.donate = donate
     return updater

@@ -7,6 +7,28 @@ apply) over a plain TCP parameter server in the standard library — the role
 wiring uses the reference's `DMLC_*` env contract
 (`include/mxnet/kvstore.h:157-206`) set by `tools/launch.py`.
 
+Fault tolerance (docs/fault_tolerance.md) on top of the reference's
+fail-fast heartbeat layer:
+
+* every mutating RPC carries a per-(rank, key) sequence number plus a
+  worker incarnation token, so retries are idempotent — a push whose ack
+  was lost is recognized server-side and never double-accumulated;
+* worker transport failures (connect refusal, mid-round-trip socket
+  errors, clean server EOF) retry with capped exponential backoff
+  (`MXNET_PS_RPC_RETRIES` / `MXNET_PS_RPC_TIMEOUT`) before surfacing the
+  documented `MXNetError` contract; an exhausted server opens a short
+  circuit-breaker window so a storm of queued engine RPCs drains fast;
+* with `MXNET_PS_SNAPSHOT_DIR` set, the server atomically snapshots its
+  whole state (store, updater/optimizer state, applied sequence numbers)
+  after each applied round, and a restarted server rehydrates from the
+  snapshot — in-flight workers simply retry and reconnect;
+* BSP rounds accumulate per rank and reduce in rank order, so the merged
+  gradient is bit-identical regardless of arrival order — the property
+  that makes crash-and-retry recovery bit-for-bit reproducible.
+
+Fault injection for all of the above lives in `mxnet_tpu.chaos`
+(`MXNET_CHAOS=rpc_drop:…,server_crash:…`).
+
 For SPMD multi-chip jobs the idiomatic path is `parallel.SPMDTrainer` (XLA
 collectives over ICI/DCN); this server exists for API/test parity with the
 reference's multi-process nightly tests (`tests/nightly/dist_sync_kvstore.py`).
@@ -25,6 +47,7 @@ import zlib
 import numpy as np
 
 from ..base import MXNetError
+from .. import chaos
 from .. import engine as _hengine
 from .. import telemetry
 from ..kvstore import KVStore
@@ -89,13 +112,52 @@ def _recv_msg(sock):
     return pickle.loads(bytes(buf))
 
 
+class _TransientRPCError(Exception):
+    """Worker-side RPC failure that is safe to retry: a transport-level
+    fault (connect refusal, socket error mid-round-trip, clean server
+    EOF) on an idempotent operation.  Sequence tags make retried
+    mutations exactly-once server-side; application-level error replies
+    are NOT transient and raise `MXNetError` directly."""
+
+
+def _rpc_retries():
+    """Transient-failure retry budget per RPC (0 restores the pre-FT
+    fail-fast contract: first transport error surfaces as MXNetError)."""
+    return int(os.environ.get("MXNET_PS_RPC_RETRIES", "8"))
+
+
+def _rpc_deadline():
+    """Wall-clock budget (seconds) across one RPC's retries."""
+    return float(os.environ.get("MXNET_PS_RPC_TIMEOUT", "60"))
+
+
+# After an RPC exhausts its retry budget against one server, further RPCs
+# to that server fail immediately for this long.  Without it, a storm of
+# already-queued engine-routed push/pull ops would each burn a full retry
+# budget against a dead server before the job's abort could surface.
+_CIRCUIT_OPEN_SECS = 10.0
+
+# best-effort teardown ops: single attempt, no retries — after `stop`, a
+# `goodbye` to the now-gone server must fail fast, not burn a retry budget
+_TERMINAL_OPS = frozenset(("goodbye", "stop"))
+
+
 class ParameterServer:
     """Server process body (`kvstore_dist_server.h`): single-threaded apply
     loop (updaters may be Python), sync-mode accumulate until all workers
-    pushed, then update + reply (BSP)."""
+    pushed, then update + reply (BSP).
 
-    def __init__(self, host, port, num_workers):
+    Recovery model: BSP pushes are accumulated PER RANK and reduced in
+    rank order at round completion (bit-identical merges regardless of
+    arrival order); applied (rank, key) sequence numbers dedupe retries;
+    with `MXNET_PS_SNAPSHOT_DIR` set, state is atomically snapshotted
+    after each applied round (`MXNET_PS_SNAPSHOT_EVERY` to batch) and a
+    restarting server rehydrates instead of starting empty."""
+
+    def __init__(self, host, port, num_workers, server_id=None):
         self.num_workers = num_workers
+        self.server_id = int(os.environ.get("DMLC_SERVER_ID", "0")) \
+            if server_id is None else int(server_id)
         self.store = {}
         self.updater = None
         self.sync_mode = True
@@ -108,18 +170,47 @@ class ParameterServer:
             "MXNET_PS_HEARTBEAT_TIMEOUT", "60"))
         self._last_seen = {}
         self._dead = None  # rank that timed out, once detected
+        # BSP round state: key -> {rank: (incarnation, seq, value)}.
+        # Rank-keyed (not counted) so a retried push can never
+        # double-accumulate, and reduced in sorted-rank order so the
+        # merged bits don't depend on arrival order.
         self._accum = {}
-        self._accum_count = {}
         self._waiting = {}
         self._lock = threading.Lock()
-        self._barrier_count = 0
-        self._barrier_waiters = []
+        # idempotence ledgers: (key, rank) -> (incarnation, seq) of the
+        # last APPLIED push; rank -> (incarnation, seq) of the last
+        # completed barrier
+        self._applied = {}
+        self._barrier_applied = {}
+        self._barrier_ranks = {}   # rank -> [incarnation, seq, [events]]
+        self._apply_count = 0
+        self._opt = None
+        self._py_states = None     # python updater's {key: state} (or None)
+        snap_dir = os.environ.get("MXNET_PS_SNAPSHOT_DIR")
+        if snap_dir:
+            os.makedirs(snap_dir, exist_ok=True)
+            self._snap_path = os.path.join(snap_dir,
+                                           "ps_%d.snap" % self.server_id)
+        else:
+            self._snap_path = None
+        self._snap_every = max(1, int(os.environ.get(
+            "MXNET_PS_SNAPSHOT_EVERY", "1")))
+        self._rounds_since_snap = 0
+        self._rehydrated = False
+        if self._snap_path and os.path.exists(self._snap_path):
+            self._rehydrate()
         self._stop = False
+        self._conns = set()
+        self._listener_released = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         # pooled worker connections: more than a couple per rank is normal
         self._sock.listen(128)
+        # timed accept so `_stop`/`kill()` take effect promptly: closing a
+        # listener out from under a thread BLOCKED in accept() does not
+        # reliably stop it on Linux (the accept keeps servicing the old fd)
+        self._sock.settimeout(0.5)
         self._monitor = threading.Thread(target=self._watchdog, daemon=True)
         self._monitor.start()
 
@@ -128,13 +219,41 @@ class ParameterServer:
         while not self._stop:
             try:
                 conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 break
+            with self._lock:
+                self._conns.add(conn)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             threads.append(t)
+        # the accept loop owns the listener fd while blocked (closing it
+        # from another thread does not release the port until the accept
+        # returns); signal release so kill() can promise a free port
+        self._listener_released.set()
         for t in threads:
             t.join(timeout=1)
+
+    def kill(self):
+        """Hard-stop: close the listener and sever every live connection
+        with no goodbye protocol — the in-process equivalent of SIGKILL
+        on a server process (used by fault-tolerance tests to exercise
+        crash/rehydrate without a subprocess)."""
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        # port is only reusable once the accept loop lets go of the fd
+        self._listener_released.wait(timeout=2)
 
     def _watchdog(self):
         while not self._stop:
@@ -157,9 +276,10 @@ class ParameterServer:
                         for ev in evs:
                             ev.set()
                     self._waiting = {}
-                    for ev in self._barrier_waiters:
-                        ev.set()
-                    self._barrier_waiters = []
+                    for entry in self._barrier_ranks.values():
+                        for ev in entry[2]:
+                            ev.set()
+                    self._barrier_ranks = {}
 
     def _check_dead(self):
         if self._dead is not None:
@@ -167,6 +287,78 @@ class ParameterServer:
                              "restart from the last checkpoint"
                              % (self._dead, self.heartbeat_timeout)}
         return None
+
+    # -- recovery: snapshot / rehydrate ------------------------------------
+
+    def _rehydrate(self):
+        """Restore store + updater + idempotence ledgers from the latest
+        snapshot, so workers reconnect and retry instead of aborting."""
+        with open(self._snap_path, "rb") as f:
+            snap = pickle.loads(f.read())
+        self.store = snap["store"]
+        self._applied = snap["applied"]
+        self._barrier_applied = snap["barrier"]
+        self.sync_mode = snap["sync_mode"]
+        self._apply_count = snap["apply_count"]
+        if snap.get("optimizer") is not None:
+            self._install_optimizer(snap["optimizer"])
+            if snap.get("updater_states") and self._py_states is not None:
+                from ..checkpoint import _states_from_host
+
+                restored = _states_from_host(snap["updater_states"])
+                self._py_states.clear()
+                self._py_states.update(restored)
+        self._rehydrated = True
+        logging.warning(
+            "parameter server %d rehydrated from %s "
+            "(%d keys, apply_count=%d)", self.server_id, self._snap_path,
+            len(self.store), self._apply_count)
+        telemetry.inc("dist.server_rehydrations")
+        telemetry.record_event("server_rejoin", server=self.server_id,
+                               apply_count=self._apply_count)
+
+    def _write_snapshot(self):
+        """Atomic whole-state snapshot (call under self._lock).  Written
+        BEFORE the round's acks go out: a round the workers saw committed
+        is always recoverable, and a round lost to a crash-before-snapshot
+        was never acked, so every worker still holds it and retries."""
+        from ..checkpoint import _states_to_host
+
+        state = {
+            "store": self.store,
+            "applied": self._applied,
+            "barrier": self._barrier_applied,
+            "sync_mode": self.sync_mode,
+            "apply_count": self._apply_count,
+            # the LIVE optimizer (update counts included), not the blob it
+            # arrived as — schedulers must resume where they left off
+            "optimizer": pickle.dumps(self._opt, protocol=4)
+            if self._opt is not None else None,
+            "updater_states": _states_to_host(self._py_states)
+            if self._py_states else None,
+        }
+        tmp = "%s.tmp.%d" % (self._snap_path, os.getpid())
+        with open(tmp, "wb") as f:
+            f.write(pickle.dumps(state, protocol=4))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        self._rounds_since_snap = 0
+        telemetry.inc("dist.server_snapshots")
+
+    def _after_apply(self):
+        """Bookkeeping after one state-mutating apply (under self._lock):
+        apply counter, chaos crash hook (BEFORE the snapshot, so an
+        injected crash loses the round and recovery must rebuild it from
+        worker retries), then the due snapshot."""
+        self._apply_count += 1
+        chaos.maybe_crash_server(self._apply_count, self._rehydrated)
+        if self._snap_path:
+            self._rounds_since_snap += 1
+            if self._rounds_since_snap >= self._snap_every:
+                self._write_snapshot()
+
+    # -- optimizer install -------------------------------------------------
 
     def _native_sgd_updater(self, opt):
         """C++ SGD fast path (`native/optimizer.cc`, the reference's
@@ -213,6 +405,30 @@ class ParameterServer:
 
         return native_updater
 
+    def _install_optimizer(self, blob):
+        """Build the server updater from a pickled optimizer (RPC install
+        or snapshot rehydrate).  With snapshotting on, the native C++ SGD
+        path is skipped — its momentum tables live in C++ and cannot be
+        captured by `_write_snapshot`, so a rehydrated server would
+        silently restart momentum from zero."""
+        from ..optimizer import get_updater
+
+        opt = pickle.loads(blob)
+        updater = None if self._snap_path else self._native_sgd_updater(opt)
+        states = None
+        if updater is None:
+            u = get_updater(opt)
+            states = u.states
+
+            def updater(key, grad, weight, _u=u):
+                g, w = array(grad), array(weight)
+                _u(key, g, w)
+                weight[...] = w.asnumpy()
+
+        self.updater = updater
+        self._opt = opt
+        self._py_states = states
+
     def _apply_update(self, key, merged):
         stored = self.store[key]
         if self.updater is not None:
@@ -220,7 +436,43 @@ class ParameterServer:
         else:
             stored += merged
 
+    def _missing_key_reply(self, key):
+        return {"error": "key %r not initialized on parameter server %d "
+                         "(restarted without a snapshot covering it?); "
+                         "restart the job from the last checkpoint"
+                         % (key, self.server_id)}
+
     def _serve(self, conn):
+        # a broken connection (worker crash, chaos-injected disconnect)
+        # must only end THIS connection's thread, never leak a traceback
+        # or take server state down with it
+        try:
+            self._serve_loop(conn)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        except Exception as e:  # noqa: BLE001 - a handler bug must
+            # surface to the worker as an error reply, not a silent EOF
+            # the retry layer would hammer against forever
+            logging.exception("parameter server %d: connection handler "
+                              "crashed", self.server_id)
+            try:
+                _send_msg(conn, {"error": "parameter server %d internal "
+                                          "error: %s" % (self.server_id,
+                                                         str(e)[:200])})
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+
+    def _serve_loop(self, conn):
         while True:
             msg = _recv_msg(conn)
             if msg is None:
@@ -232,15 +484,18 @@ class ParameterServer:
                 conn.close()
                 return
             op = msg["op"]
-            if "rank" in msg:
+            rank = msg.get("rank")
+            seq = msg.get("seq")
+            inc = msg.get("inc")
+            if rank is not None:
                 with self._lock:
-                    self._last_seen[msg["rank"]] = time.time()
+                    self._last_seen[rank] = time.time()
             if op == "goodbye":
                 # worker is leaving on purpose: stop liveness-tracking it so
                 # a rank that finishes early doesn't trip the watchdog for
                 # the ranks still running
                 with self._lock:
-                    self._last_seen.pop(msg.get("rank"), None)
+                    self._last_seen.pop(rank, None)
                 _send_msg(conn, {"ok": True})
             elif op == "heartbeat":
                 err = self._check_dead()
@@ -256,57 +511,114 @@ class ParameterServer:
                     continue
                 key, val = msg["key"], np.asarray(msg["value"])
                 done = threading.Event()
+                reply = None
                 with self._lock:
-                    if not self.sync_mode:
+                    prev = self._applied.get((key, rank))
+                    same_inc = prev is not None and seq is not None \
+                        and prev[0] == inc
+                    if key not in self.store:
+                        reply = self._missing_key_reply(key)
+                    elif same_inc and seq <= prev[1]:
+                        # retry of an already-applied round: ack without
+                        # touching state (the idempotence contract)
+                        telemetry.inc("dist.dup_push_applied")
+                        done.set()
+                    elif same_inc and seq > prev[1] + 1:
+                        # rounds applied after the last snapshot were lost
+                        # in a crash; transparent recovery is impossible —
+                        # fall back to the fail-fast contract
+                        reply = {"error":
+                                 "parameter server %d lost %d applied "
+                                 "round(s) of key %r (snapshots every %d "
+                                 "rounds); restart from the last checkpoint"
+                                 % (self.server_id, seq - prev[1] - 1, key,
+                                    self._snap_every)}
+                    elif not self.sync_mode:
                         self._apply_update(key, val)
+                        if seq is not None:
+                            self._applied[(key, rank)] = (inc, seq)
+                        self._after_apply()
                         done.set()
                     else:
-                        self._accum[key] = self._accum.get(key, 0) + val
-                        self._accum_count[key] = self._accum_count.get(key, 0) + 1
+                        pend = self._accum.setdefault(key, {})
+                        if rank in pend:
+                            # retry of a push already accumulated in the
+                            # current round: just join its waiters
+                            telemetry.inc("dist.dup_push_pending")
+                        else:
+                            pend[rank] = (inc, seq, val)
                         self._waiting.setdefault(key, []).append(done)
-                        if self._accum_count[key] == self.num_workers:
-                            self._apply_update(key, self._accum[key])
+                        if len(pend) == self.num_workers:
+                            # rank-ordered reduce: the merged bits must not
+                            # depend on arrival order, or crash-and-retry
+                            # recovery could never be bit-for-bit
+                            merged = None
+                            for r in sorted(pend):
+                                v = pend[r][2]
+                                merged = v.copy() if merged is None \
+                                    else merged + v
+                            self._apply_update(key, merged)
+                            for r, (ri, rs, _) in pend.items():
+                                if rs is not None:
+                                    self._applied[(key, r)] = (ri, rs)
+                            self._after_apply()
                             for ev in self._waiting[key]:
                                 ev.set()
                             del self._accum[key]
-                            self._accum_count[key] = 0
                             self._waiting[key] = []
-                done.wait()
-                _send_msg(conn, self._check_dead() or {"ok": True})
+                if reply is None:
+                    done.wait()
+                    reply = self._check_dead() or {"ok": True}
+                _send_msg(conn, reply)
             elif op == "pull":
                 with self._lock:
-                    val = np.array(self.store[msg["key"]])
-                _send_msg(conn, {"value": val})
+                    if msg["key"] in self.store:
+                        reply = {"value": np.array(self.store[msg["key"]])}
+                    else:
+                        reply = self._missing_key_reply(msg["key"])
+                _send_msg(conn, reply)
             elif op == "barrier":
                 if self._check_dead():
                     _send_msg(conn, self._check_dead())
                     continue
                 ev = threading.Event()
                 with self._lock:
-                    self._barrier_waiters.append(ev)
-                    if len(self._barrier_waiters) == self.num_workers:
-                        for w in self._barrier_waiters:
-                            w.set()
-                        self._barrier_waiters = []
+                    prev = self._barrier_applied.get(rank)
+                    if prev is not None and seq is not None \
+                            and prev[0] == inc and seq <= prev[1]:
+                        telemetry.inc("dist.dup_barrier")
+                        ev.set()
+                    else:
+                        entry = self._barrier_ranks.setdefault(
+                            rank, [inc, seq, []])
+                        entry[0], entry[1] = inc, seq
+                        entry[2].append(ev)
+                        if len(self._barrier_ranks) == self.num_workers:
+                            for r, (ri, rs, evs) in \
+                                    self._barrier_ranks.items():
+                                if rs is not None:
+                                    self._barrier_applied[r] = (ri, rs)
+                                for e in evs:
+                                    e.set()
+                            self._barrier_ranks = {}
+                            if self._snap_path:
+                                # barriers fence init / set_optimizer
+                                # epochs: persist the ledger so a retried
+                                # barrier after a crash is deduped
+                                self._write_snapshot()
                 ev.wait()
                 _send_msg(conn, self._check_dead() or {"ok": True})
             elif op == "set_optimizer":
-                from ..optimizer import get_updater
-
-                opt = pickle.loads(msg["optimizer"])
-                updater = self._native_sgd_updater(opt)
-                if updater is None:
-                    def updater(key, grad, weight, _u=get_updater(opt)):
-                        g, w = array(grad), array(weight)
-                        _u(key, g, w)
-                        weight[...] = w.asnumpy()
-
                 with self._lock:
-                    self.updater = updater
+                    self._install_optimizer(msg["optimizer"])
+                    if self._snap_path:
+                        self._write_snapshot()
                 _send_msg(conn, {"ok": True})
             elif op == "set_sync":
                 with self._lock:
                     self.sync_mode = msg["sync"]
+                    if self._snap_path:
+                        self._write_snapshot()
                 _send_msg(conn, {"ok": True})
             elif op == "stop":
                 _send_msg(conn, {"ok": True})
@@ -364,7 +676,11 @@ class DistKVStore(KVStore):
     With DMLC_NUM_SERVER > 1 keys shard the reference way
     (`EncodeKey`, `kvstore_dist.h:230-268`): small arrays whole on one
     hashed server, big arrays range-partitioned over all servers — server
-    ``i`` listens on DMLC_PS_ROOT_PORT + i."""
+    ``i`` listens on DMLC_PS_ROOT_PORT + i.
+
+    Transport faults retry (see module docstring); each push carries a
+    per-key sequence number and this process's incarnation token so the
+    server can dedupe retries, including across its own restarts."""
 
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
@@ -373,6 +689,15 @@ class DistKVStore(KVStore):
         self.num_servers = _num_servers()
         self._addrs = [(uri, port + i) for i in range(self.num_servers)]
         self._bigarray_bound = _bigarray_bound()
+        # idempotence state: sequence numbers per pushed key / per barrier,
+        # scoped by an incarnation token so a restarted worker's fresh
+        # seq=1 is never mistaken for a stale duplicate
+        self._incarnation = "%08x.%x" % (zlib.crc32(os.urandom(8)),
+                                         os.getpid())
+        self._push_seq = {}
+        self._barrier_seq = 0
+        self._aborted = None
+        self._srv_down_until = {}
         # the server processes import jax before they bind; retry refused
         # connections until each is up (`ps::Postoffice` handshakes similarly)
         deadline = time.time() + float(
@@ -459,45 +784,106 @@ class DistKVStore(KVStore):
                     pass
 
     def _rpc(self, msg, server=0):
-        """One request/reply on a pooled per-server connection.  A BSP push
-        can block server-side until every rank's push arrives; checking a
-        connection OUT for the whole round-trip (instead of locking one
-        shared socket) means concurrent engine-routed RPCs to the same
-        server never wait on each other's acks — with async per-rank key
-        order, a shared-socket lock deadlocks ranks against each other."""
+        """One request/reply with transient-failure retries.  Transport
+        faults (connect refusal, mid-round-trip errors, clean EOF, and
+        chaos-injected drops) retry with capped exponential backoff within
+        the MXNET_PS_RPC_RETRIES / MXNET_PS_RPC_TIMEOUT budget — sequence
+        tags make the retries idempotent server-side — then surface the
+        documented MXNetError contract.  Application-level error replies
+        raise MXNetError immediately (never retried)."""
         msg.setdefault("rank", self.rank)
+        msg.setdefault("inc", self._incarnation)
+        retries = 0 if msg.get("op") in _TERMINAL_OPS else _rpc_retries()
+        deadline = time.time() + _rpc_deadline()
+        backoff = 0.05
+        attempt = 0
+        while True:
+            if self._aborted is not None:
+                raise MXNetError(
+                    "DistKVStore rank %d already aborted: %s"
+                    % (self.rank, self._aborted))
+            down_until = self._srv_down_until.get(server, 0.0)
+            if time.time() < down_until:
+                raise MXNetError(
+                    "parameter server %d at %s:%d unreachable (retry "
+                    "budget exhausted %.1fs ago; circuit open for %r)"
+                    % (server, self._pools[server].addr[0],
+                       self._pools[server].addr[1],
+                       _CIRCUIT_OPEN_SECS - (down_until - time.time()),
+                       msg.get("op")))
+            try:
+                return self._rpc_once(msg, server)
+            except _TransientRPCError as e:
+                attempt += 1
+                if attempt > retries or time.time() >= deadline:
+                    # open the circuit briefly: queued engine RPCs behind
+                    # this one fail fast instead of each burning a full
+                    # retry budget against the same dead server
+                    self._srv_down_until[server] = \
+                        time.time() + _CIRCUIT_OPEN_SECS
+                    raise MXNetError(str(e)) from e
+                telemetry.inc("dist.rpc_retries")
+                telemetry.record_event(
+                    "rpc_retry", op=msg.get("op"), server=server,
+                    attempt=attempt, error=str(e)[:120])
+                # the pool's idle connections share the failed one's fate
+                # (server restart kills them all): drop them so the retry
+                # dials fresh instead of cycling through dead sockets
+                self._pools[server].close_all()
+                time.sleep(min(backoff, max(0.0, deadline - time.time())))
+                backoff = min(backoff * 2, 2.0)
+
+    def _rpc_once(self, msg, server):
+        """A single request/reply attempt on a pooled per-server
+        connection.  A BSP push can block server-side until every rank's
+        push arrives; checking a connection OUT for the whole round-trip
+        (instead of locking one shared socket) means concurrent
+        engine-routed RPCs to the same server never wait on each other's
+        acks — with async per-rank key order, a shared-socket lock
+        deadlocks ranks against each other."""
         pool = self._pools[server]
+        op = msg.get("op")
+        act = chaos.rpc_action(op)
+        if act is not None and act[0] == "drop_before":
+            telemetry.inc("chaos.rpc_drops")
+            raise _TransientRPCError(
+                "chaos: RPC %r to server %d dropped before send"
+                % (op, server))
         try:
             sock = pool.acquire()
         except OSError as e:
-            # a dead/unreachable server must surface as MXNetError — the
-            # documented failure contract callers catch (a raw
-            # ConnectionRefusedError would blow through `except
-            # MXNetError` handlers and kill the rank with a bare
-            # traceback instead of its abort path)
-            raise MXNetError(
+            # a dead/unreachable server surfaces (after retries) as
+            # MXNetError — the documented failure contract callers catch
+            raise _TransientRPCError(
                 "cannot reach parameter server %d at %s:%d for %r: %s"
-                % (server, pool.addr[0], pool.addr[1],
-                   msg.get("op"), e)) from e
+                % (server, pool.addr[0], pool.addr[1], op, e)) from e
         try:
+            if act is not None and act[0] == "delay":
+                time.sleep(act[1] / 1e3)
             t0 = time.perf_counter()
             _send_msg(sock, msg)
+            if act is not None and act[0] == "drop_after":
+                # the request REACHED the server; losing the reply is what
+                # exercises idempotent retry (no double-accumulate)
+                telemetry.inc("chaos.rpc_drops")
+                raise chaos.ChaosError(
+                    "chaos: connection lost after %r reached server %d"
+                    % (op, server))
             reply = _recv_msg(sock)
             # per-op round-trip latency: one histogram per RPC op, so a
             # step report separates push/pull/barrier waits (a slow BSP
             # push round is a straggler peer, not a slow network)
-            telemetry.observe("dist.rpc_ms.%s" % msg.get("op"),
+            telemetry.observe("dist.rpc_ms.%s" % op,
                               1e3 * (time.perf_counter() - t0))
         except OSError as e:
             try:
                 sock.close()  # connection state unknown: don't reuse
             except OSError:
                 pass
-            raise MXNetError(
+            raise _TransientRPCError(
                 "RPC %r to parameter server %d at %s:%d failed mid-"
                 "round-trip (server died?): %s"
-                % (msg.get("op"), server, pool.addr[0], pool.addr[1],
-                   e)) from e
+                % (op, server, pool.addr[0], pool.addr[1], e)) from e
         except BaseException:
             try:
                 sock.close()
@@ -509,10 +895,10 @@ class DistKVStore(KVStore):
                 sock.close()
             except OSError:
                 pass
-            raise MXNetError(
+            raise _TransientRPCError(
                 "parameter server %d at %s:%d closed the connection "
                 "during RPC %r (server shut down?)"
-                % (server, pool.addr[0], pool.addr[1], msg.get("op")))
+                % (server, pool.addr[0], pool.addr[1], op))
         pool.release(sock)
         if isinstance(reply, dict) and "error" in reply:
             raise MXNetError(reply["error"])
@@ -570,13 +956,13 @@ class DistKVStore(KVStore):
             mutating = any(m.get("op") in ("push", "init")
                            for _, m in reqs)
             if ok_sids and mutating:
-                # Partial PUSH failure: the servers in ok_sids already
-                # accepted their shard and sit mid-BSP-round waiting for
-                # peers.  Leave LOUDLY (no goodbye): silence trips their
-                # watchdog, which fail-fast-releases every blocked
-                # BSP/barrier waiter instead of letting peer ranks hang.
-                # (A partial PULL is read-only — no server blocks on it —
-                # so it just raises and stays retryable.)
+                # Partial PUSH failure past the retry budget: the servers
+                # in ok_sids already accepted their shard and sit mid-BSP-
+                # round waiting for peers.  Leave LOUDLY (no goodbye):
+                # silence trips their watchdog, which fail-fast-releases
+                # every blocked BSP/barrier waiter instead of letting peer
+                # ranks hang.  (A partial PULL is read-only — no server
+                # blocks on it — so it just raises and stays retryable.)
                 self._abort(
                     "partial shard RPC: servers %s accepted, %s failed: %s"
                     % (ok_sids, bad_sids, errs[0]))
@@ -596,6 +982,7 @@ class DistKVStore(KVStore):
         rank dead — its fail-fast path releases all blocked BSP waiters
         (the recovery contract of `_watchdog`)."""
         logging.error("DistKVStore rank %d aborting: %s", self.rank, reason)
+        self._aborted = str(reason)
         hb = getattr(self, "_hb_stop", None)
         if hb is not None:
             hb.set()
@@ -615,13 +1002,13 @@ class DistKVStore(KVStore):
         for v in list(self._key_vars.values()):
             self._engine.wait_for_var(v)
 
-    def _push_one(self, k, merged):
+    def _push_one(self, k, merged, seq):
         merged = np.asarray(merged)  # device->host read, off-caller-thread
         reqs = []
         for sid, sl in self._route(k, merged.size):
             shard = merged if sl is None \
                 else merged.reshape(-1)[sl[0]:sl[1]]
-            reqs.append((sid, {"op": "push", "key": k,
+            reqs.append((sid, {"op": "push", "key": k, "seq": seq,
                                "value": np.ascontiguousarray(shard)}))
         self._rpc_shards(reqs)
 
@@ -629,7 +1016,11 @@ class DistKVStore(KVStore):
         """Async: the RPC (device->host grad read + socket round-trip) runs
         as a host-engine op so it overlaps the still-running backward, with
         per-key priority — the reference pushed inside an engine op the
-        same way (`kvstore_dist.h:76-95`, priority from `model.py:96-98`)."""
+        same way (`kvstore_dist.h:76-95`, priority from `model.py:96-98`).
+
+        The per-key sequence number is assigned HERE, on the caller
+        thread, so it reflects program order even though the RPC itself
+        runs (and may retry) later on an engine thread."""
         keys, _ = self._keylist(key)
         vals = self._vallist(value, len(keys))
         for k, vlist in zip(keys, vals):
@@ -641,11 +1032,14 @@ class DistKVStore(KVStore):
             # blocking device->host read still happens on the engine
             # thread.
             merged = self._merge(vlist)
+            seq = self._push_seq.get(k, 0) + 1
+            self._push_seq[k] = seq
             if not self._async_rpc:
-                self._push_one(k, merged)
+                self._push_one(k, merged, seq)
                 continue
             self._engine.push(
-                lambda k=k, merged=merged: self._push_one(k, merged),
+                lambda k=k, merged=merged, seq=seq:
+                self._push_one(k, merged, seq),
                 mutable_vars=[self._key_var(k)], priority=priority,
                 name="kv_push_%s" % (k,))
 
@@ -708,8 +1102,11 @@ class DistKVStore(KVStore):
     def barrier(self):
         # all queued async pushes/pulls must land before the barrier rpc
         self._drain()
-        # one barrier authority (server 0), like the reference's scheduler
-        self._rpc({"op": "barrier"}, server=0)
+        # one barrier authority (server 0), like the reference's scheduler;
+        # the sequence number dedupes a retried barrier whose completed
+        # round's ack was lost (peers have moved on — re-waiting would hang)
+        self._barrier_seq += 1
+        self._rpc({"op": "barrier", "seq": self._barrier_seq}, server=0)
 
     def stop_server(self):
         self._drain()
@@ -743,10 +1140,13 @@ def run_server():
     """Server-process entry (`python/mxnet/kvstore_server.py:47-68`): called
     when DMLC_ROLE=server; blocks until kStopServer.  Server ``i`` of a
     multi-server job (DMLC_SERVER_ID, set by `tools/launch.py -s N`) binds
-    DMLC_PS_ROOT_PORT + i."""
+    DMLC_PS_ROOT_PORT + i.  With MXNET_PS_SNAPSHOT_DIR set, a restarted
+    server rehydrates its state from the latest snapshot (see
+    `tools/launch.py --restart-servers` for supervised respawn)."""
     uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
     server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    server = ParameterServer(uri, port + server_id, num_workers)
+    server = ParameterServer(uri, port + server_id, num_workers,
+                             server_id=server_id)
     server.run()

@@ -40,6 +40,22 @@ def next_key():
     return sub
 
 
+def get_state():
+    """Snapshot of ALL host-visible RNG state for exact checkpoint/resume:
+    the functional root key (optimizer noise, stochastic rounding) plus
+    numpy's global generator (data-iterator shuffles).  The result is a
+    picklable dict for `checkpoint.save_auto`."""
+    return {"jax_key": np.asarray(_root()),
+            "np_state": np.random.get_state()}
+
+
+def set_state(state):
+    """Restore a `get_state` snapshot — after this, the draw sequence
+    continues exactly where the snapshot was taken."""
+    _state.key = jnp.asarray(state["jax_key"])
+    np.random.set_state(state["np_state"])
+
+
 def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, dtype=np.float32):
     """Draw from U[low, high) into a new NDArray (`mx.nd.uniform`)."""
     from .base import check_shape, np_dtype

@@ -53,7 +53,7 @@ __all__ = [
     "add_sink", "remove_sink", "register_collector",
     "step_report", "step_end",
     "arrays_signature", "watch_jit",
-    "stage_health", "health",
+    "stage_health", "health", "consume_nonfinite",
 ]
 
 
@@ -229,6 +229,7 @@ class MetricsRegistry:
         self._watches = {}        # (site, scope) -> _Watch
         self._pending_health = None  # (names, [device_arrays]), unfetched
         self._health_fresh = False   # staged since the last step report
+        self._nonfinite_pending = 0  # bad-grad updates since consume_*()
         self._step = 0
         self._last_counters = {}
         self._last_time = None
@@ -354,7 +355,30 @@ class MetricsRegistry:
             }
             with self._lock:
                 self._last_health = out
+            if out["nonfinite"]:
+                # recovery accounting: a freshly-derived window with
+                # nonfinite grads counts as one bad step (the env read
+                # dodges an optimizer import cycle; MXNET_NONFINITE_GUARD
+                # means update_multi where'd the whole bucket to a no-op)
+                skipped = os.environ.get(
+                    "MXNET_NONFINITE_GUARD", "0").lower() in (
+                        "1", "true", "yes")
+                with self._lock:
+                    self._nonfinite_pending += 1
+                self.inc("train.nonfinite_steps")
+                self.record_event("nonfinite_grads",
+                                  count=out["nonfinite"], skipped=skipped)
         return getattr(self, "_last_health", None)
+
+    def consume_nonfinite(self):
+        """Number of nonfinite-gradient updates observed since the last
+        call (draining any staged health stats first).  The training
+        loops' optional lr backoff polls this so one bad step backs off
+        exactly once."""
+        self.health()
+        with self._lock:
+            n, self._nonfinite_pending = self._nonfinite_pending, 0
+        return n
 
     # -- retrace watchdog --------------------------------------------------
     def watch_jit(self, site, sig, scope=None, meta=None):
@@ -650,3 +674,9 @@ def health():
     if _REG is None:
         return None
     return _REG.health()
+
+
+def consume_nonfinite():
+    if _REG is None:
+        return 0
+    return _REG.consume_nonfinite()

@@ -13,6 +13,15 @@ T1="timeout -k 10 870"
 if [ $# -eq 0 ]; then
     set -- tests/ -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly
+elif [ "$1" = "--chaos-smoke" ]; then
+    # fast single-host fault-tolerance smoke: the chaos-driven recovery
+    # tests (idempotent retries, snapshot/restart, nonfinite skip,
+    # auto-resume) without the slow multi-process sweeps — the quick
+    # check that the recovery layer still works (docs/fault_tolerance.md)
+    shift
+    T1=""
+    set -- tests/test_fault_tolerance.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 else
     T1=""
 fi

@@ -12,3 +12,11 @@ rewriting) lives in mxnet_tpu.test_utils.force_cpu_devices, shared with
 from mxnet_tpu.test_utils import force_cpu_devices
 
 force_cpu_devices(8)
+
+
+def pytest_configure(config):
+    # the tier-1 gate deselects these (`-m 'not slow'`); tests/nightly.sh
+    # runs them
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-running tests (nightly suite)")

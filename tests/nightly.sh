@@ -10,6 +10,13 @@ set -e
 cd "$(dirname "$0")/.."
 ./run_tests.sh tests/ -q
 
+# -- full multi-process chaos sweep (docs/fault_tolerance.md) -------------
+# The tier-1 run above already includes the fast chaos smoke and the
+# slow-marked recovery tests; MXNET_CHAOS_NIGHTLY=1 additionally enables
+# the heavyweight parameter sweeps (higher drop rates, more rounds) that
+# are skipped everywhere else.
+MXNET_CHAOS_NIGHTLY=1 ./run_tests.sh tests/test_fault_tolerance.py -q
+
 CPU_ENV="env PYTHONPATH=$(pwd) JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8"
 
 # -- real-data convergence gates (test_all.sh:44-73 check_val pattern) ----

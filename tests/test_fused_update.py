@@ -352,3 +352,91 @@ def test_ndarray_reshape_returns_independent_copy():
     b = a.reshape((2, 3))
     b[:] = np.zeros((2, 3), np.float32)
     np.testing.assert_allclose(a.asnumpy(), np.arange(6, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# ROADMAP open items (latent in PR 1), fixed in the fault-tolerance PR
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_aggregation_pull_survives_fused_update():
+    """`kvstore.pull` pointer-shares the store's buffer into the pulled
+    NDArray; a fused updater built with donate=True would donate (delete)
+    that shared buffer at the first update, and a later `kv.pull` of the
+    key raises "Array has been deleted".  The training loops build their
+    updater with donate=False whenever a kvstore is attached — this is
+    that contract, exercised directly."""
+    kv = mx.kv.create("local")
+    kv.push(0, mx.nd.ones((4, 4)) * 2)   # aggregation mode: no updater
+    w = mx.nd.ones((4, 4))
+    kv.pull(0, out=w)                    # w aliases the merge buffer
+    assert w.data is kv._merge_buf[0].data, "pull no longer aliases; " \
+        "the donate=False guard may be obsolete"
+    upd = get_fused_updater(SGD(learning_rate=0.1, momentum=0.9),
+                            donate=False)
+    upd([0], [mx.nd.ones((4, 4))], [w])
+    out = mx.nd.zeros((4, 4))
+    kv.pull(0, out=out)                  # donate=True would raise here
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_training_loops_disable_donation_with_kvstore():
+    """`Module.init_optimizer` (and `model._train_multi_device`) must
+    build the fused updater with donate=False when a kvstore is attached,
+    and keep donation on the pure-local path."""
+    mx.random.seed(0)
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+
+    def make(kvstore):
+        mod = mx.mod.Module(_mlp(1), context=[mx.cpu(0), mx.cpu(1)])
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Uniform(0.05))
+        mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        return mod
+
+    agg = make("local")   # 2 devices + small params: aggregation mode
+    assert agg._kvstore is not None and not agg._update_on_kvstore
+    assert agg._updater.donate is False
+    local = make(None)    # no kvstore: donation stays on
+    assert local._kvstore is None
+    assert local._updater.donate is True
+
+
+def test_rng_optimizer_kill_switch_parity_multi_device(monkeypatch):
+    """RNG-consuming optimizers (SGLD noise) must consume keys in the
+    SAME order on the fused and per-key paths — device-major — or the
+    MXNET_FUSED_UPDATE=0 kill-switch is not bit-for-bit at
+    num_device > 1."""
+    from mxnet_tpu.model import _update_params
+    from mxnet_tpu.optimizer import SGLD
+
+    num_dev = 2
+    shapes = [(4, 3), (5,), (2, 2)]
+
+    def run(fused):
+        monkeypatch.setenv("MXNET_FUSED_UPDATE", "1" if fused else "0")
+        mx.random.seed(11)
+        rng = np.random.RandomState(3)
+        init = [rng.randn(*s).astype(np.float32) for s in shapes]
+        gval = [[rng.randn(*s).astype(np.float32) for _ in range(num_dev)]
+                for s in shapes]
+        param_arrays = [[mx.nd.array(v, ctx=mx.cpu(d))
+                         for d in range(num_dev)] for v in init]
+        grad_arrays = [[mx.nd.array(gval[i][d], ctx=mx.cpu(d))
+                        for d in range(num_dev)]
+                       for i in range(len(shapes))]
+        upd = get_fused_updater(SGLD(learning_rate=0.05, wd=0.01))
+        for _ in range(3):
+            _update_params(param_arrays, grad_arrays, updater=upd,
+                           num_device=num_dev)
+        return [[w.asnumpy() for w in dev] for dev in param_arrays]
+
+    fused = run(True)
+    per_key = run(False)
+    for i, (fd, pd) in enumerate(zip(fused, per_key)):
+        for d, (a, b) in enumerate(zip(fd, pd)):
+            np.testing.assert_array_equal(
+                a, b, err_msg="param %d device %d" % (i, d))

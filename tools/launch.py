@@ -54,6 +54,13 @@ def main():
     ap.add_argument("-s", "--num-servers", type=int, default=1,
                     help="parameter servers; server i binds PORT+i and keys "
                          "shard over them (hash small, range big arrays)")
+    ap.add_argument("--restart-servers", type=int, default=0, metavar="N",
+                    help="supervise the parameter servers: respawn one that "
+                         "exits while workers are still running, up to N "
+                         "respawns total.  Pair with MXNET_PS_SNAPSHOT_DIR "
+                         "so the respawned server rehydrates its state and "
+                         "in-flight workers retry instead of aborting "
+                         "(docs/fault_tolerance.md)")
     ap.add_argument("--host", default=None,
                     help="address workers use to reach the parameter server "
                          "(default 127.0.0.1; required with --hostfile)")
@@ -126,9 +133,32 @@ def main():
     signal.signal(signal.SIGINT, _terminate)
     signal.signal(signal.SIGTERM, _terminate)
 
+    workers = procs[num_servers:]
+    if args.restart_servers:
+        # supervised mode: a server that dies mid-job (crash, chaos
+        # injection) is respawned with the same env; with snapshots on it
+        # rehydrates and the workers' RPC retries reconnect transparently
+        import time
+
+        restarts_left = args.restart_servers
+        while any(w.poll() is None for w in workers):
+            for sid in range(num_servers):
+                s = procs[sid]
+                if s.poll() is not None and restarts_left > 0:
+                    print("launch: server %d exited rc=%s; respawning "
+                          "(%d restart(s) left)"
+                          % (sid, s.returncode, restarts_left - 1),
+                          file=sys.stderr, flush=True)
+                    senv = dict(base_env)
+                    senv["DMLC_ROLE"] = "server"
+                    senv["DMLC_SERVER_ID"] = str(sid)
+                    procs[sid] = subprocess.Popen(server_cmd, env=senv)
+                    restarts_left -= 1
+            time.sleep(0.2)
+
     rc = 0
     # wait for workers (skip the servers: they exit on kStopServer)
-    for p in procs[num_servers:]:
+    for p in workers:
         p.wait()
         rc = rc or p.returncode
     # workers that never created a dist kvstore never send kStopServer;

@@ -21,6 +21,17 @@ import sys
 COMM_KEYS = ("kvstore.push_bytes", "kvstore.pull_bytes",
              "dist.bytes_sent", "dist.bytes_recv")
 
+# fault-tolerance accounting (docs/fault_tolerance.md): event kinds and
+# counters emitted by the recovery paths — RPC retries, skipped nonfinite
+# steps, lr backoffs, server snapshot/rejoin, auto-checkpoint/resume
+RECOVERY_EVENT_KINDS = ("rpc_retry", "nonfinite_grads", "lr_backoff",
+                        "server_rejoin", "auto_checkpoint", "resume")
+RECOVERY_COUNTERS = ("dist.rpc_retries", "dist.dup_push_applied",
+                     "dist.dup_push_pending", "dist.dup_barrier",
+                     "dist.server_snapshots", "dist.server_rehydrations",
+                     "chaos.rpc_drops", "train.nonfinite_steps",
+                     "train.auto_checkpoints", "train.resumes")
+
 
 def load(path):
     records = []
@@ -112,6 +123,18 @@ def summarize(records):
             "step_ms_p99": step_ms[min(n - 1, int(n * 0.99))],
             "step_ms_mean": sum(step_ms) / n,
         })
+    recovery = {}
+    for kind in RECOVERY_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            recovery["%s_events" % kind] = n
+    for key in RECOVERY_COUNTERS:
+        v = int(final.get(key, 0))
+        if v:
+            recovery[key] = v
+    if recovery:
+        out["recovery"] = recovery
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -136,6 +159,11 @@ def format_summary(summary):
                  summary.get("retrace_count", 0))
     for r in summary.get("retraces", []):
         lines.append("    %s: %s" % (r["site"], r["diagnosis"]))
+    recovery = summary.get("recovery")
+    if recovery:
+        lines.append("  recovery:")
+        for key in sorted(recovery):
+            lines.append("    %-24s %d" % (key, recovery[key]))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "
