@@ -446,6 +446,124 @@ def _transformer_metrics():
     return out
 
 
+def overlap_bench(batches=None, batch=None, record=True):
+    """Synthetic input-bound overlap benchmark (CPU-friendly; run with
+    ``python bench.py --overlap``).
+
+    A throttled iterator sleeps per batch for ~one measured compute-step
+    time (input time ≈ compute time, the worst case for a serial loop),
+    then one epoch is timed with MXNET_DEVICE_PREFETCH=0 (synchronous
+    in-step staging) and one with the device prefetcher on.  Steady-state
+    step time should approach max(compute, input) ≈ compute — a ~2x ceiling
+    — and the result records the measured speedup plus the telemetry
+    `io.input_wait_frac` gauge so regressions in the overlap are visible
+    in bench_results/overlap_bench.json."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import io as io_mod
+    from mxnet_tpu import telemetry
+
+    batches = batches or int(os.environ.get("OVERLAP_BATCHES", "40"))
+    batch = batch or int(os.environ.get("OVERLAP_BATCH", "256"))
+    # compute per step must dominate the loop's fixed python overhead for
+    # the overlap ceiling (2x at input==compute) to be observable
+    hidden = int(os.environ.get("OVERLAP_HIDDEN", "1024"))
+    dim, classes = 256, 8
+    rng = np.random.RandomState(0)
+    X = rng.randn(batches * batch, dim).astype(np.float32)
+    y = (np.arange(batches * batch) % classes).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=hidden)
+    net = mx.sym.Activation(data=net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=classes)
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+    class ThrottledIter(mx.io.DataIter):
+        """NDArrayIter with a fixed host-side delay per batch (stands in
+        for decode/augment/network time)."""
+
+        def __init__(self, delay):
+            super().__init__()
+            self.inner = mx.io.NDArrayIter(X, y, batch_size=batch)
+            self.batch_size = batch
+            self.delay = delay
+
+        @property
+        def provide_data(self):
+            return self.inner.provide_data
+
+        @property
+        def provide_label(self):
+            return self.inner.provide_label
+
+        def reset(self):
+            self.inner.reset()
+
+        def next(self):
+            b = self.inner.next()
+            if self.delay:
+                time.sleep(self.delay)
+            return b
+
+    def run_epoch(depth, delay):
+        mx.random.seed(0)
+        mod = mx.mod.Module(net, context=mx.cpu())
+        it = ThrottledIter(delay)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.init.Uniform(0.05))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        plan = mod._prefetch_plan()
+        feed = io_mod.DevicePrefetchIter(it, plan=plan, depth=depth) \
+            if depth else it
+
+        def epoch():
+            feed.reset()
+            for b in feed:
+                mod.forward(b)
+                mod.backward()
+                mod.update()
+            # close the timing window on the device, not at dispatch
+            for blocks in mod._exec_group.param_arrays:
+                blocks[0].wait_to_read()
+
+        epoch()  # warm: compile + thread spin-up
+        t0 = time.perf_counter()
+        epoch()
+        dt = time.perf_counter() - t0
+        io_mod.close_iter(feed)
+        return dt / batches
+
+    compute_s = run_epoch(0, 0.0)   # calibration: pure compute+load step
+    delay = compute_s               # input time ~ compute time
+    sync_s = run_epoch(0, delay)
+    overlap_s = run_epoch(4, delay)
+    wait_frac = telemetry.registry().gauge("io.input_wait_frac").value
+    result = {
+        "metric": "input_bound_overlap_speedup",
+        "value": round(sync_s / overlap_s, 3),
+        "unit": "x (throttled input ~= compute; steady-state step time "
+                "should approach max(compute, input))",
+        "compute_ms_per_step": round(1e3 * compute_s, 3),
+        "input_ms_per_step": round(1e3 * delay, 3),
+        "sync_ms_per_step": round(1e3 * sync_s, 3),
+        "overlap_ms_per_step": round(1e3 * overlap_s, 3),
+        "input_wait_frac": None if wait_frac is None
+        else round(float(wait_frac), 4),
+        "prefetch_depth": 4,
+        "batches": batches,
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "overlap_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -475,4 +593,7 @@ def _io_pipeline_ips(n=384):
 
 
 if __name__ == "__main__":
-    main()
+    if "--overlap" in sys.argv:
+        overlap_bench()
+    else:
+        main()

@@ -135,11 +135,15 @@ def restore_updater(updater, states):
 
 
 def save_auto(prefix, arg_params, aux_params, updater=None, epoch=0,
-              nbatch=0, epoch_rng=None, extra=None):
+              nbatch=0, epoch_rng=None, iter_pos=None, extra=None):
     """Write `prefix`-auto.ckpt atomically.  ``nbatch`` is the number of
     completed batches of ``epoch``; ``epoch_rng`` is the `random.get_state`
     snapshot taken just before the epoch's data-iterator reset (needed to
-    replay shuffling iterators on resume)."""
+    replay shuffling iterators on resume).  ``iter_pos`` is the
+    data-iterator cursor — batches the loop CONSUMED since that reset,
+    which differs from ``nbatch`` when `epoch_size` cuts epochs mid-pass,
+    and deliberately excludes batches still staged in a prefetch queue
+    (not consumed, so a resume replays them)."""
     from . import random as _random
     from . import telemetry
 
@@ -149,6 +153,7 @@ def save_auto(prefix, arg_params, aux_params, updater=None, epoch=0,
         "aux": {k: v.asnumpy() for k, v in aux_params.items()},
         "epoch": int(epoch),
         "nbatch": int(nbatch),
+        "iter_pos": int(nbatch if iter_pos is None else iter_pos),
         "rng": _random.get_state(),
         "epoch_rng": epoch_rng,
         "extra": dict(extra or {}),
@@ -165,6 +170,15 @@ def save_auto(prefix, arg_params, aux_params, updater=None, epoch=0,
             # it); a resume must continue from the backed-off value, not
             # the constructor's
             state["opt_lr"] = float(opt.lr)
+            # guard mode's in-graph APPLIED-step counters (they lag the
+            # host counts by the number of skipped steps): without them a
+            # resume would re-seed from the host counts and silently
+            # re-absorb the skips into Adam's bias-correction schedule
+            guard_counts = getattr(opt, "_guard_counts", None)
+            if guard_counts:
+                state["guard_counts"] = {
+                    k: np.asarray(v, np.float32)
+                    for k, v in guard_counts.items()}
     blob = pickle.dumps(state, protocol=4)
     _atomic_write("%s-auto.ckpt" % prefix,
                   lambda p: open(p, "wb").write(blob))
@@ -207,3 +221,9 @@ def restore_auto(state, updater=None):
         opt.num_update = int(counts[1])
     if opt is not None and state.get("opt_lr") is not None:
         opt.lr = state["opt_lr"]
+    if opt is not None and state.get("guard_counts"):
+        # host numpy is fine here: update_multi device_puts the carry to
+        # the weights' device on its next use
+        opt._guard_counts = {
+            tuple(k): np.asarray(v, np.float32)
+            for k, v in state["guard_counts"].items()}

@@ -397,6 +397,14 @@ class Executor:
             return outs, new_aux, grads
 
         self._train_step_fn = train_step  # un-jitted, for profiler.plan
+        # on-device metric accumulation (set_step_stat_fn): stats ride the
+        # SAME fused fwd+bwd program as extra outputs with a donated
+        # device-resident carry — zero extra dispatches per step, one
+        # blocking fetch per MXNET_METRIC_INTERVAL (pop_step_stats)
+        self._step_stat_fn = None
+        self._step_stat_n = 0
+        self._stats_acc = None
+        self._jit_stats = None   # (donate_program, keep_program)
         # The pending (aux, cot) buffers are DONATED: aux is rebound to the
         # returned new_aux right after the call and the default cotangents
         # are created per-call, so neither outlives the step.  The bound
@@ -694,6 +702,50 @@ class Executor:
                             scope=telemetry.watch_scope(self._symbol),
                             meta=meta)
 
+    def set_step_stat_fn(self, fn, n_stats=0):
+        """Install (or clear, fn=None) a traceable per-step stat function
+        ``fn(outputs, args) -> (n_stats,) float32`` that rides the fused
+        fwd+bwd program as an extra output.  The program accumulates the
+        vector into a donated device carry; nothing is fetched until
+        `pop_step_stats` — the on-device metric path
+        (docs/data_pipeline.md)."""
+        self._step_stat_fn = fn
+        self._step_stat_n = int(n_stats) if fn is not None else 0
+        self._stats_acc = None
+        self._jit_stats = None
+
+    def pop_step_stats(self):
+        """The accumulated stat carry (a device array — the caller owns
+        the blocking fetch), resetting the accumulator.  None when nothing
+        accumulated since the last pop."""
+        acc, self._stats_acc = self._stats_acc, None
+        return acc
+
+    def _stats_programs(self):
+        if self._jit_stats is None:
+            base = self._train_step_fn
+            stat_fn = self._step_stat_fn
+
+            def train_step_stats(args, aux, rng, cots, acc):
+                outs, new_aux, grads = base(args, aux, rng, cots)
+                stats = jnp.asarray(stat_fn(outs, args), jnp.float32)
+                return outs, new_aux, grads, acc + stats
+
+            silence_cpu_donation_warning()
+            self._jit_stats = (
+                jax.jit(train_step_stats, donate_argnums=(1, 3, 4)),
+                jax.jit(train_step_stats, donate_argnums=(4,)),
+            )
+        return self._jit_stats
+
+    def _stats_carry(self):
+        acc = self._stats_acc
+        if acc is None:
+            acc = jnp.zeros((self._step_stat_n,), jnp.float32)
+            if self._device is not None:
+                acc = jax.device_put(acc, self._device)
+        return acc
+
     def _out_avals(self, args, aux, rng):
         key = tuple((tuple(a.shape), str(a.dtype)) for a in args)
         if not hasattr(self, "_aval_cache"):
@@ -717,10 +769,11 @@ class Executor:
         if self._pending is None:
             raise MXNetError("call forward(is_train=True) before backward()")
         args, aux, rng = self._pending_live()
+        with_stats = False
         if out_grads is None:
             avals = self._out_avals(args, aux, rng)
             cot = tuple(jnp.ones(o.shape, o.dtype) for o in avals)
-            step = self._jit_train_step  # donates (aux, cot): both are ours
+            donate = True
             # donating the same buffer twice — aux states bound to one
             # shared array, or an aux aliasing a bound arg — is an XLA
             # error; such binds take the non-donating program (the same
@@ -728,9 +781,16 @@ class Executor:
             seen = set(map(id, args))
             for a in aux:
                 if id(a) in seen:
-                    step = self._jit_train_step_keep
+                    donate = False
                     break
                 seen.add(id(a))
+            with_stats = self._step_stat_fn is not None
+            if with_stats:
+                progs = self._stats_programs()
+                step = progs[0] if donate else progs[1]
+            else:
+                step = self._jit_train_step if donate \
+                    else self._jit_train_step_keep
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -738,15 +798,23 @@ class Executor:
                 g.data if isinstance(g, NDArray) else jnp.asarray(g)
                 for g in out_grads
             )
-            # user-supplied cotangent buffers must survive the call
+            # user-supplied cotangent buffers must survive the call; the
+            # stat carry does not ride this path (training loops never
+            # pass out_grads — custom loops keep host metrics)
+            donate = False
             step = self._jit_train_step_keep
         # retrace watchdog: the fused train step is THE per-step program —
         # a shape drift (ragged last batch, rebind) or a fall-off-donation
         # here is the classic silent throughput cliff
         self._watch_retrace(
             "executor.train_step", args, aux, cots=cot,
-            program="donate" if step is self._jit_train_step else "keep")
-        outs, new_aux, grads = step(args, aux, rng, cot)
+            program=("donate" if donate else "keep") +
+                    ("+stats" if with_stats else ""))
+        if with_stats:
+            outs, new_aux, grads, self._stats_acc = step(
+                args, aux, rng, cot, self._stats_carry())
+        else:
+            outs, new_aux, grads = step(args, aux, rng, cot)
         profiler.record_dispatch("executor.train_step")
         self._pending = None  # aux was donated: forbid replay on stale aux
         self._outputs = [NDArray(o) for o in outs]

@@ -18,8 +18,10 @@ import logging
 
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError
 from .context import cpu
+from .io import PrefetchPlan
 from .ndarray import NDArray, zeros
 
 
@@ -146,7 +148,53 @@ class DataParallelExecutorGroup:
             for i in range(len(self.aux_names))
         ]
 
+    def prefetch_plan(self):
+        """The `io.DevicePrefetchIter` staging plan for this group: batch
+        slices + target jax devices.  A staged batch carries the plan's
+        key; `load_data_batch` only fast-paths batches whose key matches
+        this group's.  Built once and cached — the same object serves both
+        the iterator and the fast-path match."""
+        plan = getattr(self, "_prefetch_plan_cache", None)
+        if plan is None:
+            plan = PrefetchPlan(self.slices,
+                                [c.jax_device() for c in self.ctx])
+            self._prefetch_plan_cache = plan
+        return plan
+
+    @property
+    def _prefetch_key(self):
+        return self.prefetch_plan().key
+
     def load_data_batch(self, data_batch):
+        parts = getattr(data_batch, "device_parts", None)
+        if parts is not None and parts.get("key") == self._prefetch_key:
+            # pre-placed device slices (DevicePrefetchIter staged them on
+            # a background thread while the previous step computed):
+            # pointer-share straight into the bound args — no second copy,
+            # no host->device transfer on the training thread.  Shapes are
+            # checked like copyto would: a ragged batch (shorter than
+            # batch_size) slices short and must fail loudly, not rebind
+            # the bound args to the wrong shape
+            pairs = [
+                (src, dst)
+                for per_dev, targets in zip(
+                    list(parts["data"]) + list(parts["label"]),
+                    list(self.data_arrays) + list(self.label_arrays))
+                for src, (_, dst) in zip(per_dev, targets)
+            ]
+            for src, dst in pairs:  # validate ALL before rebinding any
+                if src.shape != dst.shape:
+                    raise MXNetError(
+                        "staged batch slice shape %s does not match "
+                        "bound array %s (ragged batch?)"
+                        % (src.shape, dst.shape))
+            for src, dst in pairs:
+                # dtype needs no check: _set_data casts to the bound
+                # array's dtype exactly like the legacy copyto path (an
+                # int-label batch lands as the bound f32 either way)
+                dst._set_data(src.data)
+            telemetry.inc("io.device_batches")
+            return
         _load_general(data_batch.data, self.data_arrays)
         _load_general(data_batch.label, self.label_arrays)
 
@@ -159,9 +207,58 @@ class DataParallelExecutorGroup:
             e.backward()
 
     def update_metric(self, metric, labels):
+        # NOT counted as a train.host_blocking_fetches site here: eval /
+        # validation loops call this too, and the zero-sync acceptance
+        # counter tracks the TRAINING steady state only — the train loops
+        # count their own legacy-metric calls
         for e, sl in zip(self.train_execs, self.slices):
             lab = [l[sl.start:sl.stop] for l in labels]
             metric.update(lab, e.outputs)
+
+    def install_metric_stats(self, metric):
+        """Trace `metric`'s device stats into every executor's fused train
+        step (see `Executor.set_step_stat_fn`).  Returns False — leaving
+        the group on the legacy per-batch host path — when the metric (or
+        this symbol's label layout) does not support in-graph
+        accumulation."""
+        n = metric.device_stats_size()
+        if not n or not self.label_names:
+            return False
+        arg_names = self.sym.list_arguments()
+        try:
+            label_idx = [arg_names.index(name) for name in self.label_names]
+        except ValueError:
+            return False
+
+        def stat_fn(outputs, args):
+            labels = [args[i] for i in label_idx]
+            return metric.device_batch_stats(labels, list(outputs))
+
+        for e in self.train_execs:
+            e.set_step_stat_fn(stat_fn, n)
+        return True
+
+    def uninstall_metric_stats(self):
+        for e in self.train_execs:
+            e.set_step_stat_fn(None)
+
+    def fetch_metric_stats(self, metric):
+        """Fetch + fold the accumulated device stats into `metric` — the
+        loops' ONE blocking host fetch per MXNET_METRIC_INTERVAL steps.
+        Returns False when nothing was accumulated (e.g. right after a
+        previous fetch)."""
+        pending = [e.pop_step_stats() for e in self.train_execs]
+        pending = [p for p in pending if p is not None]
+        if not pending:
+            return False
+        telemetry.blocking_fetch("metric_interval")
+        total = np.zeros((metric.device_stats_size(),), np.float64)
+        for p in pending:
+            total += np.asarray(p, np.float64)
+        from . import profiler
+        profiler.record_dispatch("executor.metric_fetch", kind="transfer")
+        metric.apply_device_stats(total)
+        return True
 
 
 class DataParallelExecutorManager:
@@ -239,3 +336,15 @@ class DataParallelExecutorManager:
 
     def update_metric(self, metric, labels):
         self.curr_execgrp.update_metric(metric, labels)
+
+    def prefetch_plan(self):
+        return self.curr_execgrp.prefetch_plan()
+
+    def install_metric_stats(self, metric):
+        return self.curr_execgrp.install_metric_stats(metric)
+
+    def uninstall_metric_stats(self):
+        self.curr_execgrp.uninstall_metric_stats()
+
+    def fetch_metric_stats(self, metric):
+        return self.curr_execgrp.fetch_metric_stats(metric)

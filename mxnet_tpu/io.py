@@ -329,7 +329,13 @@ class PrefetchingIter(DataIter):
     """Double-buffered prefetch over one or more iterators
     (`python/mxnet/io.py` PrefetchingIter; C++ `src/io/iter_prefetcher.h`
     used `dmlc::ThreadedIter` — here a worker thread + bounded queue gives
-    the same pipeline overlap with host decode)."""
+    the same pipeline overlap with host decode).
+
+    The worker is started lazily (first `next()`), joined by the
+    idempotent `close()` — called from `reset`, `__del__` and the training
+    loops' finally blocks, so an early loop exit or in-loop exception no
+    longer leaks the daemon thread and its queued batches.  A closed
+    iterator revives on the next `reset()`/`next()` call."""
 
     def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
         super().__init__()
@@ -340,23 +346,58 @@ class PrefetchingIter(DataIter):
         self._capacity = capacity
         self._queue = None
         self._thread = None
-        self._start()
+        self._stop = [False]   # per-generation cell, see _start
+        self._exhausted = False
 
     def _start(self):
+        # a revival (reset() or a post-close next()) must never run a new
+        # worker concurrently with a zombie a past close() abandoned
+        # inside the inner iterator
+        self._stale = _require_workers_dead(
+            getattr(self, "_stale", []), "PrefetchingIter")
         self._queue = _queue.Queue(self._capacity)
-        self._stop = False
+        # per-GENERATION stop cell, captured by the worker closure: if a
+        # previous close() gave up on a worker stuck in a long next(), a
+        # restart must not un-stop that zombie — only its own generation's
+        # cell ever goes back to False
+        stop = self._stop = [False]
+        queue = self._queue
 
         def worker():
-            while not self._stop:
+            while not stop[0]:
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    queue.put(None)
                     return
-                self._queue.put(batches)
+                except BaseException as e:
+                    # forward errors to the consumer: a dead worker with
+                    # no sentinel would leave next() blocked forever
+                    queue.put(_WorkerError(e))
+                    return
+                queue.put(batches)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="mx-prefetch")
         self._thread.start()
+
+    def close(self):
+        """Stop and join the worker, draining queued batches (idempotent).
+        The drain is what lets a worker blocked on a full queue observe the
+        stop flag; undelivered batches are discarded — callers that need
+        the stream position use `reset()` right after."""
+        thread, self._thread = self._thread, None
+        if thread is None:
+            return
+        self._stop[0] = True
+        self._stale = _drain_and_join((thread,), (self._queue,)) + \
+            [t for t in getattr(self, "_stale", []) if t.is_alive()]
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -367,18 +408,18 @@ class PrefetchingIter(DataIter):
         return sum([it.provide_label for it in self.iters], [])
 
     def reset(self):
-        self._stop = True
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        self.close()
+        self._stale = _require_workers_dead(
+            getattr(self, "_stale", []), "PrefetchingIter")
+        self._exhausted = False
         for it in self.iters:
             it.reset()
-        self._start()
 
     def next(self):
+        if self._exhausted:
+            raise StopIteration
+        if self._thread is None:
+            self._start()
         # data-iterator wait time: how long the training loop blocked on
         # the prefetch queue.  Near-zero means the pipeline keeps up; a
         # step-sized wait means the loop is input-bound — the telemetry
@@ -387,7 +428,11 @@ class PrefetchingIter(DataIter):
         t0 = time.perf_counter()
         batches = self._queue.get()
         telemetry.observe("io.wait_ms", 1e3 * (time.perf_counter() - t0))
-        if batches is None:
+        if batches is None or isinstance(batches, _WorkerError):
+            self._exhausted = True
+            self.close()
+            if batches is not None:
+                raise batches.error
             raise StopIteration
         if len(batches) == 1:
             return batches[0]
@@ -396,6 +441,322 @@ class PrefetchingIter(DataIter):
             label=sum([b.label for b in batches], []),
             pad=batches[0].pad,
         )
+
+
+class _WorkerError:
+    """Queue marker carrying a prefetch-worker exception to the consumer
+    thread (where it is re-raised)."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error):
+        self.error = error
+
+
+def _drain_and_join(threads, queues, deadline_s=5.0):
+    """Shared shutdown protocol of the prefetch iterators: repeatedly
+    drain the queues (so a worker blocked on a full `put` can observe its
+    stop flag) while joining, giving up after the deadline — the workers
+    are daemon threads, teardown must never hang on one.  Returns the
+    threads still alive at the deadline (stuck inside the inner
+    iterator's `next()`); callers stash them so `reset()` can refuse to
+    hand the inner iterator to a new generation while an old one might
+    still be touching it."""
+    deadline = time.perf_counter() + deadline_s
+    while any(t.is_alive() for t in threads):
+        for q in queues:
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+        for t in threads:
+            t.join(timeout=0.05)
+        if time.perf_counter() > deadline:
+            break
+    return [t for t in threads if t.is_alive()]
+
+
+def _require_workers_dead(stale, what):
+    """Before a reset re-enters the inner iterator: wait out any worker a
+    past close() abandoned mid-`next()` (two threads in one iterator
+    would corrupt its cursor); a worker that still won't die is an
+    error, not a silent race."""
+    alive = [t for t in stale if t.is_alive()]
+    for t in alive:
+        t.join(timeout=30)
+    alive = [t for t in alive if t.is_alive()]
+    if alive:
+        raise MXNetError(
+            "%s.reset(): a prefetch worker is still blocked inside the "
+            "inner iterator's next(); cannot safely reset" % what)
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Device-staging prefetch (zero-host-sync training input path)
+# ---------------------------------------------------------------------------
+
+
+def device_prefetch_depth():
+    """MXNET_DEVICE_PREFETCH: queue depth of the device-staging prefetch
+    layer the training loops wrap around their data iterator (default 2;
+    `0` kill-switches back to the synchronous in-step host->device copy).
+    Read per fit() call, like the other kill-switches."""
+    raw = os.environ.get("MXNET_DEVICE_PREFETCH", "2")
+    try:
+        depth = int(raw or 0)
+    except ValueError:
+        raise MXNetError(
+            "MXNET_DEVICE_PREFETCH must be an integer queue depth, got %r"
+            % raw)
+    return max(depth, 0)
+
+
+class PrefetchPlan:
+    """Where a staged batch's per-device slices go: the executor group's
+    batch slices and jax devices.  `key` is structural — a staged batch is
+    only fast-path loaded by a group whose own key matches, so a stale
+    plan (rebound group, different ctx list) degrades to the normal copy
+    path instead of mis-placing data."""
+
+    def __init__(self, slices, devices):
+        self.slices = list(slices)
+        self.devices = list(devices)
+        self.key = self.make_key(self.slices, self.devices)
+
+    @staticmethod
+    def make_key(slices, devices):
+        return (tuple((s.start, s.stop) for s in slices),
+                tuple(str(d) for d in devices))
+
+
+class DevicePrefetchIter(DataIter):
+    """Pipeline host batches into per-device HBM while the previous step
+    computes.
+
+    The reference hid input latency with `dmlc::ThreadedIter` feeding its
+    async dependency engine; the JAX rebuild's steady-state loop still
+    paid a synchronous host->device copy inside every step
+    (`load_data_batch`).  This layer's worker thread pulls batch N+1 from
+    the inner iterator, shards it with the executor group's `PrefetchPlan`
+    (per-device slices) and `jax.device_put`s each slice, so by the time
+    the training loop asks for the batch its buffers are already
+    device-resident — `DataParallelExecutorGroup.load_data_batch`
+    pointer-shares them into the bound args with no second copy.
+
+    Without a plan it degrades to plain threaded prefetch (the batches
+    still carry host-produced arrays).  Queue depth is bounded
+    (`MXNET_DEVICE_PREFETCH`); `close()` is idempotent and joins the
+    worker; `reset()`/`next()` revive a closed iterator.
+
+    Telemetry: `io.device_wait_ms` (time the loop blocked on the queue),
+    `io.prefetch_depth` (queue occupancy at fetch), `io.input_wait_frac`
+    (blocked fraction of the inter-batch interval — ~0 when compute-bound,
+    ~1 when input-bound)."""
+
+    def __init__(self, data_iter, plan=None, depth=None):
+        super().__init__()
+        self.data_iter = data_iter
+        self.plan = plan
+        self.batch_size = data_iter.batch_size
+        if depth is None:
+            depth = device_prefetch_depth()
+        if depth <= 0:
+            # the synchronous path is the UNWRAPPED iterator (the loops
+            # gate on the depth before constructing one of these) — a
+            # direct construction under MXNET_DEVICE_PREFETCH=0 is
+            # rejected loudly rather than silently spawning threads the
+            # kill-switch promised away
+            raise MXNetError(
+                "DevicePrefetchIter needs depth >= 1; use the plain "
+                "iterator (MXNET_DEVICE_PREFETCH=0) for the synchronous "
+                "path")
+        self._depth = depth
+        self._host_queue = None
+        self._queue = None
+        self._threads = ()
+        self._stop = [False]   # per-generation cell, see _start
+        self._exhausted = False
+        self._last_return = None
+        self._skip_stage = [0]  # see set_skip_staging
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def _stage(self, batch):
+        """Shard + device-put one batch per the plan (runs on the worker
+        thread, overlapping step N's compute).  The original full-batch
+        arrays stay on the DataBatch — legacy paths (host metrics, resume
+        skip, callbacks reading labels) keep working — and the staged
+        slices ride along in `device_parts`."""
+        plan = self.plan
+        if plan is None:
+            return batch
+        import jax
+
+        whole = len(plan.slices) == 1
+
+        def shard(arrs):
+            out = []
+            for arr in arrs:
+                src = arr.data if isinstance(arr, NDArray) else arr
+                parts = []
+                for s, dev in zip(plan.slices, plan.devices):
+                    piece = src if whole and s.start == 0 \
+                        and s.stop == src.shape[0] else src[s.start:s.stop]
+                    # already resident (single-device CPU runs): skip the
+                    # no-op device_put dispatch — the staging thread's CPU
+                    # time matters on small hosts
+                    if getattr(piece, "device", None) != dev:
+                        piece = jax.device_put(piece, dev)
+                    parts.append(NDArray(piece))
+                out.append(parts)
+            return out
+
+        batch.device_parts = {
+            "key": plan.key,
+            "data": shard(batch.data),
+            "label": shard(batch.label),
+        }
+        return batch
+
+    def _start(self):
+        # two-stage pipeline: the producer pulls host batches (decode /
+        # synthetic input time), the stager shards + device-puts them —
+        # so input latency and staging overlap each other AND the compute,
+        # and steady-state step time approaches max(compute, input, stage)
+        self._stale = _require_workers_dead(
+            getattr(self, "_stale", []), "DevicePrefetchIter")
+        self._host_queue = _queue.Queue(self._depth)
+        self._queue = _queue.Queue(self._depth)
+        # per-generation stop cell (see PrefetchingIter._start): a restart
+        # must never revive a zombie worker close() gave up on
+        stop = self._stop = [False]
+        host_queue, queue = self._host_queue, self._queue
+
+        def producer():
+            while not stop[0]:
+                try:
+                    batch = self.data_iter.next()
+                except StopIteration:
+                    host_queue.put((None, None))
+                    return
+                except BaseException as e:  # surfaced on the main thread
+                    host_queue.put((e, None))
+                    return
+                host_queue.put((None, batch))
+
+        skip_stage = self._skip_stage
+
+        def stager():
+            while not stop[0]:
+                try:
+                    err, batch = host_queue.get(timeout=0.05)
+                except _queue.Empty:
+                    continue  # poll the stop flag; steady state never waits
+                if err is not None or batch is None:
+                    queue.put((err, None))
+                    return
+                if skip_stage[0] > 0:
+                    # resume fast-forward: the consumer will discard this
+                    # batch unprocessed — don't pay the shard+device_put
+                    skip_stage[0] -= 1
+                    queue.put((None, batch))
+                    continue
+                try:
+                    staged = self._stage(batch)
+                except BaseException as e:
+                    queue.put((e, None))
+                    return
+                queue.put((None, staged))
+
+        self._threads = (
+            threading.Thread(target=producer, daemon=True,
+                             name="mx-device-prefetch-in"),
+            threading.Thread(target=stager, daemon=True,
+                             name="mx-device-prefetch-stage"),
+        )
+        for t in self._threads:
+            t.start()
+
+    def close(self):
+        """Idempotent worker join + queue drain (see PrefetchingIter.close);
+        queued staged batches are discarded."""
+        threads, self._threads = self._threads, ()
+        if not threads:
+            return
+        self._stop[0] = True
+        self._stale = _drain_and_join(
+            threads, (self._host_queue, self._queue)) + \
+            [t for t in getattr(self, "_stale", []) if t.is_alive()]
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def set_skip_staging(self, n):
+        """The next `n` batches will be consumed-and-discarded (auto-resume
+        fast-forward): deliver them unstaged so the replay does not pay a
+        shard+device_put per skipped batch.  Call before iteration starts
+        (the workers spawn lazily at the first `next()`)."""
+        self._skip_stage[0] = int(n)
+
+    def reset(self):
+        self.close()
+        self._stale = _require_workers_dead(
+            getattr(self, "_stale", []), "DevicePrefetchIter")
+        self._exhausted = False
+        self._last_return = None
+        self._skip_stage[0] = 0
+        self.data_iter.reset()
+
+    def next(self):
+        if self._exhausted:
+            raise StopIteration
+        if not self._threads:
+            self._start()
+        t0 = time.perf_counter()
+        err, batch = self._queue.get()
+        now = time.perf_counter()
+        wait = now - t0
+        telemetry.observe("io.device_wait_ms", 1e3 * wait)
+        telemetry.set_gauge("io.prefetch_depth", self._queue.qsize())
+        if self._last_return is not None:
+            interval = now - self._last_return
+            telemetry.set_gauge(
+                "io.input_wait_frac",
+                wait / interval if interval > 0 else 0.0)
+        self._last_return = now
+        if err is not None:
+            self._exhausted = True
+            self.close()
+            raise err
+        if batch is None:
+            self._exhausted = True
+            self.close()
+            raise StopIteration
+        return batch
+
+
+def close_iter(data_iter):
+    """Best-effort close of a (possibly wrapped) prefetching iterator —
+    the training loops call this from their finally blocks so an aborted
+    fit never leaks a worker thread.  Only prefetch-layer iterators are
+    touched (they revive on reset); resource-owning iterators like
+    ImageRecordIter are left alone."""
+    if isinstance(data_iter, (PrefetchingIter, DevicePrefetchIter)):
+        try:
+            data_iter.close()
+        except Exception:
+            logging.exception("close of %r failed", data_iter)
 
 
 class ImageRecordIter(DataIter):

@@ -1,5 +1,16 @@
-"""Evaluation metrics (reference `python/mxnet/metric.py:127-347`)."""
+"""Evaluation metrics (reference `python/mxnet/metric.py:127-347`).
+
+On-device accumulation: metrics whose per-batch contribution is a pair of
+additive scalars (`device_stat`) can ride the fused training step program
+as extra outputs — `Executor` traces `device_batch_stats` into the step,
+accumulates (sum_metric, num_inst) in a device-resident carry, and the
+training loops fetch it once per `MXNET_METRIC_INTERVAL` steps (and at
+epoch end) via `apply_device_stats` instead of calling per-batch
+`update()` -> `asnumpy()`.  The interval <= 1 default keeps the legacy
+per-batch host path bit-for-bit."""
 from __future__ import annotations
+
+import os
 
 import numpy
 
@@ -9,6 +20,20 @@ from .ndarray import NDArray
 
 def _np(x):
     return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
+
+
+def metric_interval():
+    """MXNET_METRIC_INTERVAL: fetch cadence (in steps) of the on-device
+    metric accumulators.  <= 1 (the default) keeps the legacy per-batch
+    host `update()`; N > 1 makes the training loops accumulate metric
+    stats in-graph and block on the device at most once per N steps."""
+    raw = os.environ.get("MXNET_METRIC_INTERVAL", "1")
+    try:
+        return int(raw or 1)
+    except ValueError:
+        raise MXNetError(
+            "MXNET_METRIC_INTERVAL must be an integer step count, got %r"
+            % raw)
 
 
 class EvalMetric:
@@ -27,6 +52,39 @@ class EvalMetric:
 
     def update(self, labels, preds):
         raise NotImplementedError()
+
+    # -- on-device accumulation (rides the fused train step) ---------------
+    supports_device = False
+
+    def device_stats_size(self):
+        """Length of this metric's device-stat vector (0 = unsupported —
+        the loops then keep the per-batch host path)."""
+        return 2 if self.supports_device and self.num is None else 0
+
+    def device_stat(self, label, pred):
+        """One (label, pred) pair's additive contribution as traceable jax
+        scalars: (sum_metric_delta, num_inst_delta).  Must mirror
+        `update()`'s host arithmetic exactly (same reductions in the same
+        order) so interval-N and interval-1 runs agree."""
+        raise NotImplementedError()
+
+    def device_batch_stats(self, labels, preds):
+        """Whole-batch stat vector (traced into the fused step program)."""
+        import jax.numpy as jnp
+
+        s_total, n_total = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            s, n = self.device_stat(label, pred)
+            s_total = s_total + s
+            n_total = n_total + n
+        return jnp.stack([jnp.asarray(s_total, jnp.float32),
+                          jnp.asarray(n_total, jnp.float32)])
+
+    def apply_device_stats(self, stats):
+        """Fold a fetched stat vector into the host accumulators (the
+        deferred equivalent of the `update()` calls it covers)."""
+        self.sum_metric += float(stats[0])
+        self.num_inst += int(round(float(stats[1])))
 
     def get(self):
         if self.num is None:
@@ -49,6 +107,8 @@ class EvalMetric:
 class Accuracy(EvalMetric):
     """Classification accuracy (`metric.py:127`)."""
 
+    supports_device = True
+
     def __init__(self):
         super().__init__("accuracy")
 
@@ -60,9 +120,21 @@ class Accuracy(EvalMetric):
             self.sum_metric += float((pred_label.flat == label.flat).sum())
             self.num_inst += len(pred_label.flat)
 
+    def device_stat(self, label, pred):
+        import jax.numpy as jnp
+
+        lab = jnp.reshape(label, (-1,)).astype(jnp.int32)
+        pl = jnp.argmax(pred, axis=1) if pred.ndim > 1 \
+            else pred.astype(jnp.int32)
+        pl = jnp.reshape(pl, (-1,))
+        correct = jnp.sum(pl == lab).astype(jnp.float32)
+        return correct, float(pl.size)  # count is static: a trace constant
+
 
 class TopKAccuracy(EvalMetric):
     """Top-k accuracy (`metric.py` TopKAccuracy)."""
+
+    supports_device = True
 
     def __init__(self, top_k=1):
         super().__init__("top_k_accuracy_%d" % top_k)
@@ -74,10 +146,21 @@ class TopKAccuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _np(label).astype(numpy.int32)
             pred = _np(pred)
-            top = numpy.argsort(pred, axis=1)[:, -self.top_k:]
+            # stable sort: jax's argsort (the device_stat path) is always
+            # stable, so tied prediction values must break ties the same
+            # way here for interval-1 vs interval-N parity
+            top = numpy.argsort(pred, axis=1, kind="stable")[:, -self.top_k:]
             for i in range(len(label)):
                 self.sum_metric += float(label[i] in top[i])
             self.num_inst += len(label)
+
+    def device_stat(self, label, pred):
+        import jax.numpy as jnp
+
+        lab = jnp.reshape(label, (-1,)).astype(jnp.int32)
+        top = jnp.argsort(pred, axis=1)[:, -self.top_k:]
+        hits = jnp.sum(jnp.any(top == lab[:, None], axis=1))
+        return hits.astype(jnp.float32), float(lab.size)
 
 
 class F1(EvalMetric):
@@ -105,6 +188,8 @@ class F1(EvalMetric):
 
 
 class MAE(EvalMetric):
+    supports_device = True
+
     def __init__(self):
         super().__init__("mae")
 
@@ -114,8 +199,15 @@ class MAE(EvalMetric):
             self.sum_metric += float(numpy.abs(label.reshape(pred.shape) - pred).mean())
             self.num_inst += 1
 
+    def device_stat(self, label, pred):
+        import jax.numpy as jnp
+
+        return jnp.mean(jnp.abs(jnp.reshape(label, pred.shape) - pred)), 1.0
+
 
 class MSE(EvalMetric):
+    supports_device = True
+
     def __init__(self):
         super().__init__("mse")
 
@@ -125,8 +217,15 @@ class MSE(EvalMetric):
             self.sum_metric += float(((label.reshape(pred.shape) - pred) ** 2).mean())
             self.num_inst += 1
 
+    def device_stat(self, label, pred):
+        import jax.numpy as jnp
+
+        return jnp.mean((jnp.reshape(label, pred.shape) - pred) ** 2), 1.0
+
 
 class RMSE(EvalMetric):
+    supports_device = True
+
     def __init__(self):
         super().__init__("rmse")
 
@@ -138,9 +237,19 @@ class RMSE(EvalMetric):
             )
             self.num_inst += 1
 
+    def device_stat(self, label, pred):
+        import jax.numpy as jnp
+
+        # per-batch sqrt(mean) like the host path: each batch contributes
+        # its own RMSE, so the stat stays additive across batches
+        return jnp.sqrt(
+            jnp.mean((jnp.reshape(label, pred.shape) - pred) ** 2)), 1.0
+
 
 class CrossEntropy(EvalMetric):
     """Per-sample NLL of the labelled class (`metric.py` CrossEntropy)."""
+
+    supports_device = True
 
     def __init__(self):
         super().__init__("cross-entropy")
@@ -152,6 +261,14 @@ class CrossEntropy(EvalMetric):
             prob = pred[numpy.arange(label.shape[0]), label]
             self.sum_metric += float((-numpy.log(numpy.maximum(prob, 1e-12))).sum())
             self.num_inst += label.shape[0]
+
+    def device_stat(self, label, pred):
+        import jax.numpy as jnp
+
+        lab = jnp.reshape(label, (-1,)).astype(jnp.int32)
+        prob = pred[jnp.arange(lab.shape[0]), lab]
+        nll = jnp.sum(-jnp.log(jnp.maximum(prob, 1e-12)))
+        return nll, float(lab.shape[0])
 
 
 class Torch(EvalMetric):
@@ -215,6 +332,25 @@ class CompositeEvalMetric(EvalMetric):
     def update(self, labels, preds):
         for m in self.metrics:
             m.update(labels, preds)
+
+    def device_stats_size(self):
+        sizes = [m.device_stats_size() for m in self.metrics]
+        if not sizes or not all(sizes):
+            return 0  # one unsupported child keeps the whole composite host-side
+        return sum(sizes)
+
+    def device_batch_stats(self, labels, preds):
+        import jax.numpy as jnp
+
+        return jnp.concatenate(
+            [m.device_batch_stats(labels, preds) for m in self.metrics])
+
+    def apply_device_stats(self, stats):
+        off = 0
+        for m in self.metrics:
+            k = m.device_stats_size()
+            m.apply_device_stats(stats[off:off + k])
+            off += k
 
     def get(self):
         names, results = [], []

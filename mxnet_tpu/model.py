@@ -18,6 +18,7 @@ import numpy as np
 
 from . import checkpoint as checkpoint_mod
 from . import initializer as init_mod
+from . import io as io_mod
 from . import kvstore as kvs_mod
 from . import metric as metric_mod
 from . import ndarray as nd
@@ -248,6 +249,26 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
     if monitor:
         executor_manager.install_monitor(monitor)
 
+    raw_train_data = train_data
+    prefetch_depth = io_mod.device_prefetch_depth()
+    if prefetch_depth:
+        # device-staging prefetch (docs/data_pipeline.md): a worker thread
+        # shards and device-puts batch N+1 while step N computes;
+        # load_data_batch pointer-shares the staged slices so the steady-
+        # state step pays no host->device copy on the training thread.
+        # MXNET_DEVICE_PREFETCH=0 restores the synchronous in-step copy.
+        train_data = io_mod.DevicePrefetchIter(
+            train_data, plan=executor_manager.prefetch_plan(),
+            depth=prefetch_depth)
+    metric_interval = metric_mod.metric_interval()
+    # on-device metric accumulation: the metric's (sum, count) stats ride
+    # the fused train-step program and are fetched once per
+    # MXNET_METRIC_INTERVAL steps (and at epoch end) instead of per-batch
+    # asnumpy; interval <= 1 (or an unsupported metric) keeps the legacy
+    # per-batch host path bit-for-bit
+    device_metric = metric_interval > 1 and eval_metric is not None and \
+        executor_manager.install_metric_stats(eval_metric)
+
     resume_state = None
     resume_batch = 0
     if auto_prefix and resume == "auto":
@@ -308,119 +329,181 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
     auto_writer = auto_prefix and auto_every and (
         kvstore is None or kvstore.rank == 0)
 
-    if resume_state is not None and resume_state.get("epoch_rng"):
-        # the epoch's shuffle was drawn at the reset below; replaying it
-        # needs the RNG as it stood at the ORIGINAL epoch start
-        random_mod.set_state(resume_state["epoch_rng"])
-    epoch_rng = random_mod.get_state()
-    train_data.reset()
+    # data-iterator cursor: batches consumed by the LOOP since the last
+    # reset.  With the device prefetcher, batches staged in its queue have
+    # been pulled from the underlying stream but NOT consumed here — they
+    # deliberately do not count, so a resume replays them.  Saved with
+    # every auto-checkpoint: with `epoch_size` below a full data pass the
+    # epoch boundary is not a reset boundary, and `nbatch` alone cannot
+    # locate the mid-pass position (ROADMAP PR 3 open item).
+    resume_iter_pos = 0
     if resume_state is not None:
-        # ...and everything after the reset continues from the exact
-        # checkpoint-time stream (optimizer noise, stochastic rounding)
-        random_mod.set_state(resume_state["rng"])
-    for epoch in range(begin_epoch, end_epoch):
-        tic = time.time()
-        eval_metric.reset()
-        nbatch = 0
-        skip = 0
-        if resume_state is not None and epoch == begin_epoch:
-            # fast-forward the replayed shuffle to the batch cursor
-            nbatch = skip = resume_batch
-        while True:
-            do_reset = True
-            for data_batch in train_data:
-                if skip > 0:
-                    skip -= 1
-                    continue
-                if monitor is not None:
-                    monitor.tic()
-                executor_manager.load_data_batch(data_batch)
-                executor_manager.forward(is_train=True)
-                executor_manager.backward()
-                if update_on_kvstore:
-                    _update_params_on_kvstore(
-                        executor_manager.param_arrays,
-                        executor_manager.grad_arrays,
-                        kvstore,
-                    )
-                else:
-                    _update_params(
-                        executor_manager.param_arrays,
-                        executor_manager.grad_arrays,
-                        updater=updater,
-                        num_device=len(ctx),
-                        kvstore=kvstore,
-                    )
-                if backoff:
-                    _poll_nonfinite_backoff(optimizer, backoff, logger)
-                if monitor is not None:
-                    monitor.toc_print()
-                executor_manager.update_metric(eval_metric, data_batch.label)
-                nbatch += 1
-                if batch_end_callback is not None:
-                    p = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                      eval_metric=eval_metric)
-                    if isinstance(batch_end_callback, list):
-                        for cb in batch_end_callback:
-                            cb(p)
-                    else:
-                        batch_end_callback(p)
-                # one telemetry record per step (free until a sink is
-                # attached via MXNET_TELEMETRY_JSONL or add_sink)
-                telemetry.step_end(extra={"epoch": epoch, "nbatch": nbatch})
-                if auto_writer and nbatch % auto_every == 0:
-                    # atomic mid-epoch checkpoint: a kill -9 any time
-                    # after this line resumes from exactly here
-                    executor_manager.copy_to(arg_params, aux_params)
-                    checkpoint_mod.save_auto(
-                        auto_prefix, arg_params, aux_params,
-                        updater=ckpt_updater, epoch=epoch, nbatch=nbatch,
-                        epoch_rng=epoch_rng)
-                if epoch_size is not None and nbatch >= epoch_size:
-                    do_reset = False
-                    break
-            if do_reset:
-                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
-                epoch_rng = random_mod.get_state()
-                train_data.reset()
-            if epoch_size is None or nbatch >= epoch_size:
-                break
-        toc = time.time()
-        logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+        resume_iter_pos = int(resume_state.get("iter_pos",
+                                               resume_state["nbatch"]))
 
-        executor_manager.copy_to(arg_params, aux_params)
-        if auto_writer:
-            # epoch-boundary cursor: a crash between epochs resumes at
-            # (epoch+1, 0) with the next epoch's shuffle replayable
-            checkpoint_mod.save_auto(
-                auto_prefix, arg_params, aux_params, updater=ckpt_updater,
-                epoch=epoch + 1, nbatch=0, epoch_rng=epoch_rng)
-
-        if epoch_end_callback or epoch + 1 == end_epoch:
-            if epoch_end_callback is not None:
-                cbs = epoch_end_callback if isinstance(epoch_end_callback, list) \
-                    else [epoch_end_callback]
-                for cb in cbs:
-                    cb(epoch, symbol, arg_params, aux_params)
-
-        if eval_data:
+    def run_epochs():
+        if resume_state is not None and resume_state.get("epoch_rng"):
+            # the epoch's shuffle was drawn at the reset below; replaying
+            # it needs the RNG as it stood at the ORIGINAL epoch start
+            random_mod.set_state(resume_state["epoch_rng"])
+        epoch_rng = random_mod.get_state()
+        train_data.reset()
+        iter_pos = 0
+        if resume_iter_pos and hasattr(train_data, "set_skip_staging"):
+            # the replayed batches are consumed-and-discarded: skip their
+            # device staging so fast-forward costs no transfers
+            train_data.set_skip_staging(resume_iter_pos)
+        if resume_state is not None:
+            # ...and everything after the reset continues from the exact
+            # checkpoint-time stream (optimizer noise, stochastic rounding)
+            random_mod.set_state(resume_state["rng"])
+        steps_in_flight = 0
+        for epoch in range(begin_epoch, end_epoch):
+            tic = time.time()
             eval_metric.reset()
-            eval_data.reset()
-            for i, eval_batch in enumerate(eval_data):
-                executor_manager.load_data_batch(eval_batch)
-                executor_manager.forward(is_train=False)
-                executor_manager.update_metric(eval_metric, eval_batch.label)
-                if eval_batch_end_callback is not None:
-                    p = BatchEndParam(epoch=epoch, nbatch=i,
-                                      eval_metric=eval_metric)
-                    cbs = eval_batch_end_callback \
-                        if isinstance(eval_batch_end_callback, list) \
-                        else [eval_batch_end_callback]
+            nbatch = 0
+            skip = 0
+            if resume_state is not None and epoch == begin_epoch:
+                # fast-forward the replayed shuffle to the saved cursor
+                # (iter_pos, not nbatch: the two differ when the epoch
+                # started mid-pass)
+                nbatch = resume_batch
+                skip = resume_iter_pos
+            while True:
+                do_reset = True
+                for data_batch in train_data:
+                    iter_pos += 1
+                    if skip > 0:
+                        skip -= 1
+                        continue
+                    if monitor is not None:
+                        monitor.tic()
+                    executor_manager.load_data_batch(data_batch)
+                    executor_manager.forward(is_train=True)
+                    executor_manager.backward()
+                    if update_on_kvstore:
+                        _update_params_on_kvstore(
+                            executor_manager.param_arrays,
+                            executor_manager.grad_arrays,
+                            kvstore,
+                        )
+                    else:
+                        _update_params(
+                            executor_manager.param_arrays,
+                            executor_manager.grad_arrays,
+                            updater=updater,
+                            num_device=len(ctx),
+                            kvstore=kvstore,
+                        )
+                    if backoff:
+                        _poll_nonfinite_backoff(optimizer, backoff, logger)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if device_metric:
+                        # stats rode the fused step program; block on the
+                        # device at most once per interval
+                        steps_in_flight += 1
+                        if (nbatch + 1) % metric_interval == 0:
+                            executor_manager.fetch_metric_stats(eval_metric)
+                            steps_in_flight = 0
+                        telemetry.set_gauge("train.steps_in_flight",
+                                            steps_in_flight)
+                    else:
+                        telemetry.blocking_fetch("metric_update")
+                        executor_manager.update_metric(eval_metric,
+                                                       data_batch.label)
+                    nbatch += 1
+                    if batch_end_callback is not None:
+                        p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric)
+                        if isinstance(batch_end_callback, list):
+                            for cb in batch_end_callback:
+                                cb(p)
+                        else:
+                            batch_end_callback(p)
+                    # one telemetry record per step (free until a sink is
+                    # attached via MXNET_TELEMETRY_JSONL or add_sink)
+                    telemetry.step_end(extra={"epoch": epoch,
+                                              "nbatch": nbatch})
+                    if auto_writer and nbatch % auto_every == 0:
+                        # atomic mid-epoch checkpoint: a kill -9 any time
+                        # after this line resumes from exactly here
+                        if device_metric:
+                            executor_manager.fetch_metric_stats(eval_metric)
+                            steps_in_flight = 0
+                        executor_manager.copy_to(arg_params, aux_params)
+                        checkpoint_mod.save_auto(
+                            auto_prefix, arg_params, aux_params,
+                            updater=ckpt_updater, epoch=epoch,
+                            nbatch=nbatch, epoch_rng=epoch_rng,
+                            iter_pos=iter_pos)
+                    if epoch_size is not None and nbatch >= epoch_size:
+                        do_reset = False
+                        break
+                if do_reset:
+                    logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+                    epoch_rng = random_mod.get_state()
+                    train_data.reset()
+                    iter_pos = 0
+                if epoch_size is None or nbatch >= epoch_size:
+                    break
+            if device_metric:
+                # epoch-end drain so logged/returned metrics are complete
+                executor_manager.fetch_metric_stats(eval_metric)
+                steps_in_flight = 0
+            toc = time.time()
+            logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+
+            executor_manager.copy_to(arg_params, aux_params)
+            if auto_writer:
+                # epoch-boundary cursor: a crash between epochs resumes at
+                # (epoch+1, 0) with the next epoch's shuffle replayable;
+                # iter_pos carries the mid-pass position when epoch_size
+                # broke the pass without a reset
+                checkpoint_mod.save_auto(
+                    auto_prefix, arg_params, aux_params,
+                    updater=ckpt_updater, epoch=epoch + 1, nbatch=0,
+                    epoch_rng=epoch_rng, iter_pos=iter_pos)
+
+            if epoch_end_callback or epoch + 1 == end_epoch:
+                if epoch_end_callback is not None:
+                    cbs = epoch_end_callback \
+                        if isinstance(epoch_end_callback, list) \
+                        else [epoch_end_callback]
                     for cb in cbs:
-                        cb(p)
-            eval_data.reset()
-            for name, value in eval_metric.get_name_value():
-                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+                        cb(epoch, symbol, arg_params, aux_params)
+
+            if eval_data:
+                eval_metric.reset()
+                eval_data.reset()
+                for i, eval_batch in enumerate(eval_data):
+                    executor_manager.load_data_batch(eval_batch)
+                    executor_manager.forward(is_train=False)
+                    executor_manager.update_metric(eval_metric,
+                                                   eval_batch.label)
+                    if eval_batch_end_callback is not None:
+                        p = BatchEndParam(epoch=epoch, nbatch=i,
+                                          eval_metric=eval_metric)
+                        cbs = eval_batch_end_callback \
+                            if isinstance(eval_batch_end_callback, list) \
+                            else [eval_batch_end_callback]
+                        for cb in cbs:
+                            cb(p)
+                eval_data.reset()
+                for name, value in eval_metric.get_name_value():
+                    logger.info("Epoch[%d] Validation-%s=%f",
+                                epoch, name, value)
+
+    try:
+        run_epochs()
+    finally:
+        # join prefetch workers even on an in-loop exception (thread-leak
+        # fix; the wrapper is ours, the raw iterator revives on reset)
+        io_mod.close_iter(train_data)
+        if raw_train_data is not train_data:
+            io_mod.close_iter(raw_train_data)
+        if device_metric:
+            executor_manager.uninstall_metric_stats()
 
 
 class FeedForward(BASE_ESTIMATOR):

@@ -133,6 +133,7 @@ class BaseModule:
         nonfinite-gradient step."""
         from .. import checkpoint as checkpoint_mod
         from .. import initializer as init_mod
+        from .. import io as io_mod
         from .. import random as random_mod
         from ..model import (_auto_checkpoint_config, _backoff_active,
                              _nonfinite_backoff, _poll_nonfinite_backoff)
@@ -167,6 +168,24 @@ class BaseModule:
                             optimizer_params=optimizer_params)
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+
+        # zero-sync steady state (docs/data_pipeline.md): device-staging
+        # input prefetch + on-device metric accumulation, where the module
+        # type supports them (Module exposes the hooks; other types keep
+        # the legacy paths).  MXNET_DEVICE_PREFETCH=0 and
+        # MXNET_METRIC_INTERVAL=1 restore today's loop bit-for-bit.
+        raw_train_data = train_data
+        prefetch_depth = io_mod.device_prefetch_depth()
+        plan = None
+        if prefetch_depth and hasattr(self, "_prefetch_plan"):
+            plan = self._prefetch_plan()
+        if plan is not None:
+            train_data = io_mod.DevicePrefetchIter(
+                train_data, plan=plan, depth=prefetch_depth)
+        metric_interval = metric_mod.metric_interval()
+        device_metric = bool(
+            metric_interval > 1 and hasattr(self, "_metric_stats_install")
+            and self._metric_stats_install(eval_metric))
 
         kv = getattr(self, "_kvstore", None)
         auto_writer = auto_prefix and auto_every and (
@@ -209,69 +228,103 @@ class BaseModule:
             # ...and everything after the reset continues from the exact
             # checkpoint-time stream (optimizer noise, rounding draws)
             random_mod.set_state(resume_state["rng"])
+        if resume_batch and hasattr(train_data, "set_skip_staging"):
+            # replayed batches are consumed-and-discarded: skip their
+            # device staging so fast-forward costs no transfers
+            train_data.set_skip_staging(resume_batch)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            skip = resume_batch if (resume_state is not None
-                                    and epoch == begin_epoch) else 0
-            for nbatch, data_batch in enumerate(train_data):
-                if nbatch < skip:
-                    continue
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                if backoff:
-                    _poll_nonfinite_backoff(self._optimizer, backoff,
-                                            self.logger)
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    p = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                      eval_metric=eval_metric)
-                    cbs = batch_end_callback \
-                        if isinstance(batch_end_callback, list) \
-                        else [batch_end_callback]
+        try:
+            steps_in_flight = 0
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                skip = resume_batch if (resume_state is not None
+                                        and epoch == begin_epoch) else 0
+                for nbatch, data_batch in enumerate(train_data):
+                    if nbatch < skip:
+                        continue
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    if backoff:
+                        _poll_nonfinite_backoff(self._optimizer, backoff,
+                                                self.logger)
+                    if device_metric:
+                        # metric stats rode the fused step program; block
+                        # on the device at most once per interval
+                        steps_in_flight += 1
+                        if (nbatch + 1) % metric_interval == 0:
+                            self._metric_stats_fetch(eval_metric)
+                            steps_in_flight = 0
+                        telemetry.set_gauge("train.steps_in_flight",
+                                            steps_in_flight)
+                    else:
+                        telemetry.blocking_fetch("metric_update")
+                        self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric)
+                        cbs = batch_end_callback \
+                            if isinstance(batch_end_callback, list) \
+                            else [batch_end_callback]
+                        for cb in cbs:
+                            cb(p)
+                    # one telemetry record per step (free until a sink is
+                    # attached via MXNET_TELEMETRY_JSONL or add_sink)
+                    telemetry.step_end(extra={"epoch": epoch,
+                                              "nbatch": nbatch})
+                    if auto_writer and (nbatch + 1) % auto_every == 0:
+                        # atomic: a kill -9 after this line resumes here
+                        arg_p, aux_p = self.get_params()
+                        checkpoint_mod.save_auto(
+                            auto_prefix, arg_p, aux_p, updater=ckpt_updater,
+                            epoch=epoch, nbatch=nbatch + 1,
+                            epoch_rng=epoch_rng)
+                if device_metric:
+                    # epoch-end drain: logged metrics cover every batch
+                    self._metric_stats_fetch(eval_metric)
+                    steps_in_flight = 0
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f",
+                                     epoch, name, val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
+                arg_p, aux_p = self.get_params()
+                self.set_params(arg_p, aux_p)
+                if epoch_end_callback is not None:
+                    cbs = epoch_end_callback \
+                        if isinstance(epoch_end_callback, list) \
+                        else [epoch_end_callback]
                     for cb in cbs:
-                        cb(p)
-                # one telemetry record per step (free until a sink is
-                # attached via MXNET_TELEMETRY_JSONL or add_sink)
-                telemetry.step_end(extra={"epoch": epoch, "nbatch": nbatch})
-                if auto_writer and (nbatch + 1) % auto_every == 0:
-                    # atomic: a kill -9 after this line resumes from here
-                    arg_p, aux_p = self.get_params()
+                        cb(epoch, self.symbol, arg_p, aux_p)
+                if eval_data:
+                    res = self.score(
+                        eval_data, eval_metric,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                epoch_rng = random_mod.get_state()
+                train_data.reset()
+                if auto_writer:
+                    # epoch-boundary cursor: a crash between epochs
+                    # resumes at (epoch+1, 0) with the next epoch's
+                    # shuffle replayable
                     checkpoint_mod.save_auto(
                         auto_prefix, arg_p, aux_p, updater=ckpt_updater,
-                        epoch=epoch, nbatch=nbatch + 1,
-                        epoch_rng=epoch_rng)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
-            arg_p, aux_p = self.get_params()
-            self.set_params(arg_p, aux_p)
-            if epoch_end_callback is not None:
-                cbs = epoch_end_callback if isinstance(epoch_end_callback, list) \
-                    else [epoch_end_callback]
-                for cb in cbs:
-                    cb(epoch, self.symbol, arg_p, aux_p)
-            if eval_data:
-                res = self.score(eval_data, eval_metric,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
-            epoch_rng = random_mod.get_state()
-            train_data.reset()
-            if auto_writer:
-                # epoch-boundary cursor: a crash between epochs resumes
-                # at (epoch+1, 0) with the next epoch's shuffle replayable
-                checkpoint_mod.save_auto(
-                    auto_prefix, arg_p, aux_p, updater=ckpt_updater,
-                    epoch=epoch + 1, nbatch=0, epoch_rng=epoch_rng)
+                        epoch=epoch + 1, nbatch=0, epoch_rng=epoch_rng)
+        finally:
+            # join prefetch workers even on an in-loop exception
+            # (thread-leak fix; prefetch iterators revive on reset)
+            io_mod.close_iter(train_data)
+            if raw_train_data is not train_data:
+                io_mod.close_iter(raw_train_data)
+            if device_metric:
+                self._metric_stats_uninstall()
 
     def set_params(self, arg_params, aux_params):
         self.init_params(initializer=None, arg_params=arg_params,

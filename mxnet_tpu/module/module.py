@@ -200,6 +200,22 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
+    # -- zero-sync loop hooks (BaseModule.fit; docs/data_pipeline.md) ------
+    def _prefetch_plan(self):
+        """Staging plan for io.DevicePrefetchIter (None before bind)."""
+        if self._exec_group is None:
+            return None
+        return self._exec_group.prefetch_plan()
+
+    def _metric_stats_install(self, eval_metric):
+        return self._exec_group.install_metric_stats(eval_metric)
+
+    def _metric_stats_fetch(self, eval_metric):
+        return self._exec_group.fetch_metric_stats(eval_metric)
+
+    def _metric_stats_uninstall(self):
+        self._exec_group.uninstall_metric_stats()
+
     def get_params(self):
         arg = {k: v.copy() for k, v in self._arg_params.items()}
         aux = {k: v.copy() for k, v in self._aux_params.items()}

@@ -74,6 +74,10 @@ def _use_pallas(q, kv_len=None):
 try:  # pallas is TPU-only in some builds; import lazily and gate on backend
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    # pre-rename jax spells CompilerParams "TPUCompilerParams"; a local
+    # alias covers both without mutating jax's namespace
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
@@ -187,7 +191,7 @@ def _flash_fwd_pallas(q, k, v, q_off, k_off, scale, causal,
         ],
         # every program is independent (the K loop is inside the kernel):
         # let Mosaic parallelize/pipeline freely across the whole grid
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * h * sq_p * skv_p * d,
@@ -419,7 +423,7 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
                                    lambda i, j, k_, qo, ko: (i, j, k_, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=6 * b * h * sq_p * skv_p * d,
@@ -460,7 +464,7 @@ def _flash_bwd_pallas(scale, causal, block_q, block_k, res, grads):
             jax.ShapeDtypeStruct((b, h, skv_p, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, skv_p, d), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=8 * b * h * sq_p * skv_p * d,
@@ -663,7 +667,7 @@ def _flash_fwd_pallas_ds(q, k, v, q_off, k_off, scale, causal,
             jax.ShapeDtypeStruct((b, h, d, sq_p), q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, sq_p), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -841,7 +845,7 @@ def _flash_bwd_pallas_ds(scale, causal, block_q, block_k, res, grads):
             scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, d, sq_p), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -887,7 +891,7 @@ def _flash_bwd_pallas_ds(scale, causal, block_q, block_k, res, grads):
             jax.ShapeDtypeStruct((b, h, d, skv_p), k.dtype),
             jax.ShapeDtypeStruct((b, h, d, skv_p), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -1024,7 +1028,7 @@ def _flash_fwd_pallas_bsd(q, k, v, q_off, k_off, scale, causal,
             jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
             jax.ShapeDtypeStruct((b, num_heads, sq_p, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * num_heads * sq_p * skv_p * d,
@@ -1205,7 +1209,7 @@ def _flash_bwd_pallas_bsd(scale, causal, block_q, block_k, num_heads,
                                    lambda i, j, k_, qo, ko: (i, k_, j)),
         ),
         out_shape=jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=6 * b * num_heads * sq_p * skv_p * d,
@@ -1246,7 +1250,7 @@ def _flash_bwd_pallas_bsd(scale, causal, block_q, block_k, num_heads,
             jax.ShapeDtypeStruct((b, skv_p, e), k.dtype),
             jax.ShapeDtypeStruct((b, skv_p, e), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * 3),
         cost_estimate=pl.CostEstimate(
             flops=8 * b * num_heads * sq_p * skv_p * d,
@@ -1382,7 +1386,7 @@ def _flash_fwd_pallas_bsd_gs(q, k, v, q_off, k_off, scale, causal,
             jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
             jax.ShapeDtypeStruct((b, num_heads, sq_p, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -1556,7 +1560,7 @@ def _flash_bwd_pallas_bsd_gs(scale, causal, block_q, block_k, num_heads,
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((b, sq_p, e), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -1602,7 +1606,7 @@ def _flash_bwd_pallas_bsd_gs(scale, causal, block_q, block_k, num_heads,
             jax.ShapeDtypeStruct((b, skv_p, e), k.dtype),
             jax.ShapeDtypeStruct((b, skv_p, e), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         cost_estimate=pl.CostEstimate(

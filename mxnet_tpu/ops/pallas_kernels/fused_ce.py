@@ -49,6 +49,10 @@ _NEG_INF = -1e30
 try:  # pallas is TPU-only in some builds
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    # pre-rename jax spells CompilerParams "TPUCompilerParams"; a local
+    # alias covers both without mutating jax's namespace
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
@@ -149,7 +153,7 @@ def _fwd_pallas(x, w, b, label, grad_scale, ignore_label, use_ignore,
     nll, lse = pl.pallas_call(
         kernel,
         grid=(num_j, num_i),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda j, i: (i, 0)),

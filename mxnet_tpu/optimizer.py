@@ -139,6 +139,7 @@ class Optimizer:
         state = self.__dict__.copy()
         state["sym"] = None
         state.pop("_jit_cache", None)
+        state.pop("_guard_counts", None)  # device arrays; rebuilt lazily
         return state
 
     # -- multipliers (optimizer.py:124-170) -------------------------------
@@ -263,6 +264,39 @@ class Optimizer:
         (over the whole parameter list)."""
         raise NotImplementedError()
 
+    # -- in-graph step counter (MXNET_NONFINITE_GUARD + count-dependent
+    # optimizers) ----------------------------------------------------------
+    #
+    # The guard skips bad steps ON DEVICE, but host-side `_update_count`
+    # has already advanced by the time the device decides — so an
+    # optimizer whose math folds the step count into its scalars (Adam
+    # bias correction) would see skipped steps in its schedule.  When
+    # `_counts_in_graph()` is True and the guard is armed, `update_multi`
+    # carries a per-key device step counter through the fused program
+    # (donated, zero extra dispatches): it only advances on applied steps,
+    # and `_traced_step_scalars` re-derives the count-dependent
+    # coefficients from it in-graph.  Host counts still advance (they feed
+    # checkpointing and lr schedulers) and the traced fold runs in f32
+    # rather than host f64 — guard-mode Adam is within a few ulp of the
+    # unguarded path, and a run with k skipped steps is bit-identical to
+    # one where those steps never happened.
+
+    def _counts_in_graph(self):
+        """Whether guard mode should carry the device step counter (only
+        optimizers whose scalars depend on the update count need it)."""
+        return False
+
+    def _step_scalars_base(self, index):
+        """Count-INDEPENDENT scalar prefix for the traced-count path (the
+        count-dependent fold moves into `_traced_step_scalars`).  Must
+        still bump the host counts like `_step_scalars`."""
+        return self._step_scalars(index)
+
+    def _traced_step_scalars(self, scalars, t):
+        """Fold the traced step counter `t` (f32 scalar) into the scalar
+        row in-graph.  Default: count-independent, pass through."""
+        return scalars
+
     def update(self, index, weight, grad, state):
         scalars = tuple(float(s) for s in self._step_scalars(index))
         key = _random.next_key() if self._needs_key() else None
@@ -300,9 +334,29 @@ class Optimizer:
         indices = list(indices)
         if not indices:
             return
+        guard = nonfinite_guard_enabled()
+        health = telemetry.health_enabled() or guard
+        tcount = guard and self._counts_in_graph()
+        tc = None
+        if tcount:
+            # per-bucket device step counter: initialized from the host
+            # counts as they stand BEFORE this call's bump, then carried
+            # (donated) through the fused program, advancing only on
+            # applied (non-skipped) steps
+            counts_map = getattr(self, "_guard_counts", None)
+            if counts_map is None:
+                counts_map = self._guard_counts = {}
+            ckey = tuple(indices)
+            tc = counts_map.get(ckey)
+            if tc is None:
+                tc = jnp.asarray(
+                    [self._index_update_count.get(i, 0) for i in indices],
+                    jnp.float32)
         scalars, keys = [], []
         for i in indices:
-            scalars.append(tuple(float(s) for s in self._step_scalars(i)))
+            row = self._step_scalars_base(i) if tcount \
+                else self._step_scalars(i)
+            scalars.append(tuple(float(s) for s in row))
             keys.append(_random.next_key() if self._needs_key() else None)
         w_arrs = [w.data for w in weights]
         g_arrs = [g.data for g in grads]
@@ -340,13 +394,13 @@ class Optimizer:
         # guard (MXNET_NONFINITE_GUARD=1) rides the same moments: when any
         # gradient element is NaN/Inf, every weight/state output of the
         # bucket is jnp.where'd back to its input — the whole step skips
-        # with zero extra dispatches.
-        guard = nonfinite_guard_enabled()
-        health = telemetry.health_enabled() or guard
-        self._watch_retrace(indices, w_arrs, donate, health, guard)
+        # with zero extra dispatches.  Count-dependent optimizers
+        # additionally carry the in-graph step counter (`tc`, donated) so
+        # a skipped step does not advance their schedule.
+        self._watch_retrace(indices, w_arrs, donate, health, guard, tcount)
 
-        def build(donate=donate, health=health, guard=guard):
-            def apply(ws, gs, ss, sc, key_arr):
+        def build(donate=donate, health=health, guard=guard, tcount=tcount):
+            def apply(ws, gs, ss, sc, key_arr, tc):
                 new_ws, new_ss = [], []
                 moments = jnp.zeros((4,), jnp.float32) if health else None
                 if guard:
@@ -359,12 +413,23 @@ class Optimizer:
                             ~jnp.isfinite(g.astype(jnp.float32))
                         ).astype(jnp.float32)
                     bad = bad > 0
+                t_new = None
+                if tcount:
+                    t_new = tc + jnp.where(bad, 0.0, 1.0)
                 for i in range(len(ws)):
                     # same weak-float-like scalar/result dtype handling as
                     # the per-key driver in `update` — the two must stay
                     # bit-for-bit identical per parameter
-                    scal = tuple(sc[i, j].astype(ws[i].dtype)
-                                 for j in range(nscal))
+                    if tcount:
+                        # fold the traced step counter in f32 first, then
+                        # cast like the host-side fold would have
+                        scal = tuple(sc[i, j] for j in range(nscal))
+                        scal = self._traced_step_scalars(scal, t_new[i])
+                        scal = tuple(jnp.asarray(s).astype(ws[i].dtype)
+                                     for s in scal)
+                    else:
+                        scal = tuple(sc[i, j].astype(ws[i].dtype)
+                                     for j in range(nscal))
                     k = key_arr[i] if key_arr is not None else None
                     nw, ns = self._update_math(ws[i], gs[i], ss[i], scal,
                                                key=k)
@@ -389,18 +454,31 @@ class Optimizer:
                         ])
                     new_ws.append(nw)
                     new_ss.append(ns)
+                out = [new_ws, new_ss]
                 if health:
-                    return new_ws, new_ss, moments
-                return new_ws, new_ss
+                    out.append(moments)
+                if tcount:
+                    out.append(t_new)
+                return tuple(out)
 
-            return jax.jit(apply, donate_argnums=(0, 2) if donate else ())
+            dargs = (0, 2) if donate else ()
+            if tcount:
+                dargs = dargs + (5,)  # the count carry is always ours
+            return jax.jit(apply, donate_argnums=dargs)
 
         if donate:
             silence_cpu_donation_warning()
         kind = ("multi_donate" if donate else "multi_keep") + \
-            ("_health" if health else "") + ("_guard" if guard else "")
+            ("_health" if health else "") + ("_guard" if guard else "") + \
+            ("_tcount" if tcount else "")
         fused = self._jit_for(kind, build)
-        out = fused(w_arrs, g_arrs, s_arrs, sc, key_arr)
+        if tcount:
+            dev = getattr(w_arrs[0], "device", None)
+            if dev is not None and getattr(tc, "device", None) != dev:
+                tc = jax.device_put(tc, dev)
+        out = list(fused(w_arrs, g_arrs, s_arrs, sc, key_arr, tc))
+        if tcount:
+            counts_map[ckey] = out.pop()
         if health:
             new_ws, new_ss, moments = out
             telemetry.stage_health(
@@ -413,7 +491,8 @@ class Optimizer:
             _store_state(s, ns)
         profiler.record_dispatch("optimizer.update_multi")
 
-    def _watch_retrace(self, indices, w_arrs, donate, health, guard=False):
+    def _watch_retrace(self, indices, w_arrs, donate, health, guard=False,
+                       tcount=False):
         """Retrace watchdog over the fused update program: a changed
         bucket shape profile, a donation fallback, or a mutated traced
         hyperparameter (e.g. ``opt.rescale_grad = ...`` mid-run, which
@@ -429,7 +508,7 @@ class Optimizer:
         sig = telemetry.arrays_signature(
             w_arrs, ["w%d" % i for i in range(len(w_arrs))])
         meta = {"donate": bool(donate), "health": bool(health),
-                "guard": bool(guard),
+                "guard": bool(guard), "tcount": bool(tcount),
                 "device": str(getattr(w_arrs[0], "device", None))
                 if w_arrs else "none"}
         for k, v in self._trace_key():
@@ -554,6 +633,27 @@ class Adam(Optimizer):
         coef2 = 1 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
         return (lr_t, wd)
+
+    # Under MXNET_NONFINITE_GUARD the bias-correction count moves in-graph
+    # (the fused program's donated step counter, which does NOT advance on
+    # skipped steps): a run with k guarded-away steps is bit-identical to
+    # one where those steps never happened.  The traced fold runs in f32
+    # (vs the host path's f64), so guard-mode Adam differs from unguarded
+    # Adam by a few ulp — see docs/fault_tolerance.md.
+    def _counts_in_graph(self):
+        return True
+
+    def _step_scalars_base(self, index):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)  # host mirror: checkpoints / schedulers
+        return (lr, wd)
+
+    def _traced_step_scalars(self, scalars, t):
+        lr, wd = scalars
+        coef1 = 1 - self.beta1 ** t
+        coef2 = 1 - self.beta2 ** t
+        return (lr * jnp.sqrt(coef2) / coef1, wd)
 
     def _update_math(self, w, g, state, scalars, key=None):
         lr_t, wd = scalars

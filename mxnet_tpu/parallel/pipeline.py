@@ -77,7 +77,11 @@ class PipelineParallel:
         # this stage this tick.
         T = M + S - 1
         zero = jnp.zeros_like(x_mb[0])
-        total0 = jnp.zeros((), jnp.float32)
+        # (1,)-shaped accumulator, not a scalar: older jax's shard_map
+        # autodiff mis-specs a rank-0 scan carry inside manual axes
+        # (_SpecError on float32[] under value_and_grad); a length-1 axis
+        # sidesteps it with identical math
+        total0 = jnp.zeros((1,), jnp.float32)
         # carries flow through ppermute/psum, so they are device-varying
         # over the pipe axis; the init must carry the same type.  pcast
         # replaced the deprecated pvary in jax 0.9.
@@ -99,7 +103,8 @@ class PipelineParallel:
             y = jnp.where(active, y, jnp.zeros_like(y))
             # last stage: account loss for its (t - (S-1))th microbatch
             lbl = labels_mb[jnp.clip(mb_idx, 0, M - 1)]
-            total = total + jnp.where(active, loss_at_last(y, lbl), 0.0)
+            total = total + jnp.reshape(
+                jnp.where(active, loss_at_last(y, lbl), 0.0), (1,))
             # rotate activations one stage forward
             buf = jax.lax.ppermute(y, ax, fwd_perm)
             return (buf, total), ()
@@ -107,7 +112,7 @@ class PipelineParallel:
         (buf, total), _ = jax.lax.scan(
             tick, (zero, total0), jnp.arange(T))
         # total is only nonzero on the last stage; share it
-        total = jax.lax.psum(total, ax)
+        total = jax.lax.psum(total[0], ax)
         return total / M
 
     def loss(self, params_stacked, x, labels):

@@ -54,6 +54,7 @@ __all__ = [
     "step_report", "step_end",
     "arrays_signature", "watch_jit",
     "stage_health", "health", "consume_nonfinite",
+    "blocking_fetch",
 ]
 
 
@@ -338,6 +339,11 @@ class MetricsRegistry:
         with self._lock:
             pending, self._pending_health = self._pending_health, None
         if pending is not None:
+            # this np.asarray over device arrays IS a blocking fetch on
+            # whichever thread drains the stats — count it with the
+            # training loops' other sync points
+            self.inc("train.host_blocking_fetches")
+            self.inc("train.host_blocking_fetches.health")
             names, value_list = pending
             summed = np.zeros(len(names), np.float64)
             for v in value_list:  # moments are sums: accumulate on host
@@ -662,6 +668,19 @@ def watch_jit(site, sig, scope=None, meta=None):
     if not retrace_enabled():
         return None
     return registry().watch_jit(site, sig, scope=scope, meta=meta)
+
+
+def blocking_fetch(site):
+    """Record one blocking host<-device fetch on the TRAINING hot path
+    (per-batch metric update, interval metric fetch, health drain).  The
+    `train.host_blocking_fetches` counter is the zero-sync loop's
+    acceptance metric: in steady state it must advance at most once per
+    MXNET_METRIC_INTERVAL steps (tests/test_prefetch_metrics.py)."""
+    if not enabled():
+        return
+    reg = registry()
+    reg.inc("train.host_blocking_fetches")
+    reg.inc("train.host_blocking_fetches.%s" % site)
 
 
 def stage_health(names, values):

@@ -8,6 +8,8 @@ so users can gradient-check their own custom operators and symbols).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .base import MXNetError
@@ -142,16 +144,79 @@ def check_numeric_gradient(sym, location, grad_nodes=None, rtol=1e-2,
     return exe
 
 
+_AOT_MOSAIC_PROBE = None  # cached per process: True / error string
+
+
+def _probe_aot_mosaic():
+    """Whether the local libtpu can AOT-compile a Mosaic kernel for the
+    abstract v5e topology.
+
+    Some jaxlib/libtpu pairs CHECK-abort (SIGABRT, not a python
+    exception) inside `backend_compile` when handed Mosaic programs for a
+    compile-only topology client — an abort would take the whole pytest
+    process down, so the probe compiles a representative kernel in a
+    SUBPROCESS first."""
+    import subprocess
+    import sys
+
+    code = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+os.environ["MXNET_FLASH_IMPL"] = "pallas_hsd"
+sys.path.insert(0, %r)
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+mesh = Mesh(np.array(topo.devices[:1]), ("data",))
+from mxnet_tpu.ops.pallas_kernels.flash_attention import flash_attention
+sh = jax.ShapeDtypeStruct((1, 2, 128, 128), jnp.bfloat16)
+f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True),
+            in_shardings=(NamedSharding(mesh, P()),) * 3,
+            out_shardings=NamedSharding(mesh, P()))
+f.lower(sh, sh, sh).compile()
+print("MOSAIC_AOT_OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=300,
+                              env=env)
+    except Exception as e:  # timeout / spawn failure
+        return "probe failed: %s" % str(e)[:160]
+    if "MOSAIC_AOT_OK" in proc.stdout:
+        return True
+    return "probe subprocess exited rc=%s: %s" % (
+        proc.returncode, (proc.stderr or proc.stdout)[-300:])
+
+
 def aot_v5e_mesh():
     """One-device Mesh over an abstract v5e topology (AOT target compile
     with no live device — ADR-11).  The single source of the topology
     recipe for both CI (tests/test_aot_compile.py) and the perf campaign
     (scripts/diag_round5.py); raises MXNetError when the jaxlib/libtpu
-    pair cannot build compile-only TPU clients."""
+    pair cannot build compile-only TPU clients (including the
+    CHECK-abort case the subprocess probe detects)."""
+    global _AOT_MOSAIC_PROBE
+
     import jax  # noqa: F401  (topologies needs initialized jax)
     from jax.experimental import topologies
     from jax.sharding import Mesh
 
+    # Compile-only client: libtpu still queries the GCP instance-metadata
+    # service at init, and off-TPU (CI containers) each lookup retries for
+    # minutes before giving up — skip the queries so init is instant.
+    # setdefault leaves real TPU VMs (where the runtime wires the
+    # metadata) untouched.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    if _AOT_MOSAIC_PROBE is None:
+        _AOT_MOSAIC_PROBE = _probe_aot_mosaic()
+    if _AOT_MOSAIC_PROBE is not True:
+        raise MXNetError("no AOT TPU topology support: %s"
+                         % _AOT_MOSAIC_PROBE)
     try:
         topo = topologies.get_topology_desc(platform="tpu",
                                             topology_name="v5e:2x2")

@@ -55,4 +55,19 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
     TBENCH_BATCH=8 TBENCH_VOCAB=128 TBENCH_STEPS=2 TBENCH_REPS=1 \
     TBENCH_DTYPE=float32 \
     python bench.py
+
+# -- input-pipeline overlap gate (docs/data_pipeline.md) ------------------
+# throttled-iterator synthetic: the device prefetcher must beat the
+# synchronous loop when input time ~ compute time (ISSUE-5 acceptance is
+# >= 1.5x on quiet hardware; gate at 1.3x for shared-CI noise); artifact
+# lands in bench_results/overlap_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu python bench.py --overlap \
+    | tee /tmp/nightly_overlap.log
+python - <<'PY'
+import json
+rec = json.loads(open("/tmp/nightly_overlap.log").read().strip().splitlines()[-1])
+assert rec["value"] and rec["value"] >= 1.3, \
+    "overlap gate failed: speedup %s < 1.3" % rec["value"]
+print("overlap gate passed: %sx" % rec["value"])
+PY
 echo "nightly: all gates passed"
