@@ -276,6 +276,24 @@ def main():
             else:
                 extra["transformer_error"] = str(e)[:200]
     extra["pallas_parity"] = pallas_parity
+    # head FLOPs/bytes accounting (round 6): the closed-form cost of the
+    # dense / 5-pass / single-pass head structures at the flagship LM
+    # shape, persisted so every bench round carries the head story
+    # mechanically (scripts/ce_roofline.py owns the model)
+    try:
+        sys.path.insert(0, os.path.join(here, "scripts"))
+        import ce_roofline
+
+        tokens = (int(os.environ.get("TBENCH_BATCH", "32"))
+                  * int(os.environ.get("TBENCH_SEQ", "1024")))
+        extra["ce_head_breakdown"] = ce_roofline.write_breakdown(
+            n_tokens=tokens,
+            d=int(os.environ.get("TBENCH_EMBED", "768")),
+            vocab=int(os.environ.get("TBENCH_VOCAB", "32768")))["head"]
+        extra["ce_head_breakdown_artifact"] = \
+            "bench_results/ce_head_breakdown.json"
+    except Exception as e:  # pragma: no cover — never cost the headline
+        extra["ce_head_breakdown_error"] = str(e)[:160]
     telemetry.step_report(extra={"phase": "end"})
     extra["telemetry_stream"] = os.path.relpath(tel_path, here)
     if extra:

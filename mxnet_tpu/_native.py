@@ -143,11 +143,74 @@ def _load():
         lib.mxtpu_sgd_destroy.argtypes = [H]
     except AttributeError:
         pass
+
+    try:  # sgd momentum export/import (snapshot support; newer builds)
+        fp = ctypes.POINTER(ctypes.c_float)
+        lib.mxtpu_sgd_keys.restype = ctypes.c_int64
+        lib.mxtpu_sgd_keys.argtypes = [H, ctypes.POINTER(ctypes.c_int),
+                                       ctypes.c_int64]
+        lib.mxtpu_sgd_state_size.restype = ctypes.c_int64
+        lib.mxtpu_sgd_state_size.argtypes = [H, ctypes.c_int]
+        lib.mxtpu_sgd_get_state.restype = ctypes.c_int
+        lib.mxtpu_sgd_get_state.argtypes = [H, ctypes.c_int, fp,
+                                            ctypes.c_int64]
+        lib.mxtpu_sgd_set_state.restype = ctypes.c_int
+        lib.mxtpu_sgd_set_state.argtypes = [H, ctypes.c_int, fp,
+                                            ctypes.c_int64]
+    except AttributeError:
+        pass
     return lib
 
 
 def has_sgd() -> bool:
     return LIB is not None and hasattr(LIB, "mxtpu_sgd_create")
+
+
+def has_sgd_state() -> bool:
+    """Momentum export/import (snapshot-capturable native SGD)."""
+    return LIB is not None and hasattr(LIB, "mxtpu_sgd_get_state")
+
+
+def sgd_export_state(handle):
+    """{key_id: np.float32 momentum table} of a native SGD handle."""
+    import ctypes
+
+    import numpy as np
+
+    check(has_sgd_state(), "sgd_export_state")
+    n = LIB.mxtpu_sgd_keys(handle, None, 0)
+    check(n >= 0, "sgd_keys")
+    if n == 0:
+        return {}
+    ids = (ctypes.c_int * n)()
+    got = LIB.mxtpu_sgd_keys(handle, ids, n)
+    check(got == n, "sgd_keys")
+    out = {}
+    fp = ctypes.POINTER(ctypes.c_float)
+    for kid in list(ids):
+        size = LIB.mxtpu_sgd_state_size(handle, kid)
+        check(size >= 0, "sgd_state_size")
+        buf = np.empty(size, np.float32)
+        check(LIB.mxtpu_sgd_get_state(
+            handle, kid, buf.ctypes.data_as(fp), size) == 0,
+            "sgd_get_state")
+        out[int(kid)] = buf
+    return out
+
+
+def sgd_import_state(handle, states):
+    """Install {key_id: float32 array} momentum tables into a handle."""
+    import ctypes
+
+    import numpy as np
+
+    check(has_sgd_state(), "sgd_import_state")
+    fp = ctypes.POINTER(ctypes.c_float)
+    for kid, arr in states.items():
+        a = np.ascontiguousarray(arr, np.float32)
+        check(LIB.mxtpu_sgd_set_state(
+            handle, int(kid), a.ctypes.data_as(fp), a.size) == 0,
+            "sgd_set_state")
 
 
 def has_u8_loader() -> bool:

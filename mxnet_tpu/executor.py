@@ -61,12 +61,38 @@ def _mirror_segments(order):
     stored); anything truthy keeps it inside a remat segment even where
     the step count would cut one.
 
-    Returns None when MXNET_BACKWARD_MIRROR_STEP is unset, else a list of
-    (nodes, remat) runs covering `order` in topo sequence.
+    ``MXNET_BACKWARD_MIRROR_STEP=block`` segments on transformer-block
+    NAME boundaries instead of a count: every run of ops whose names share
+    a ``layer<k>_`` prefix becomes one remat segment (exactly per-layer
+    remat for `models/transformer.py`, the bwd residual-stream fusion
+    lever from the round-6 roofline), ops outside any layer prefix
+    (embed, head, final LN) stay stored boundaries.  Per-node
+    `force_mirroring` attrs are a count-mode feature and are ignored in
+    block mode.
+
+    Returns None when MXNET_BACKWARD_MIRROR_STEP is unset (or block mode
+    finds no layer-prefixed nodes), else a list of (nodes, remat) runs
+    covering `order` in topo sequence.
     """
     step_env = os.environ.get("MXNET_BACKWARD_MIRROR_STEP", "")
     if not step_env:
         return None
+    if step_env.lower() == "block":
+        import re
+
+        groups = []  # (layer tag or None, [nodes])
+        for node in order:
+            if node.is_variable:
+                continue
+            m = re.match(r"(layer\d+)_", node.name or "")
+            tag = m.group(1) if m else None
+            if groups and groups[-1][0] == tag:
+                groups[-1][1].append(node)
+            else:
+                groups.append((tag, [node]))
+        if not any(tag is not None for tag, _ in groups):
+            return None  # not a layer-structured graph: no-op
+        return [(nodes, tag is not None) for tag, nodes in groups]
     step = max(int(step_env), 1)
 
     def boundary_attr(node):
@@ -272,12 +298,19 @@ def _mirror_policy():
       ``attn``    save only attention-op outputs (`checkpoint_name` tag
                   "attn_out"), remat projections/FFN/LN — the transformer
                   memory policy
+      ``streams`` save attention outputs AND activation-fn outputs
+                  (tags "attn_out"/"act_out"): the round-6 bwd
+                  residual-stream fusion — the LN/projection/gelu-input
+                  streams the roofline flagged as re-read in backward are
+                  recomputed from the two anchors instead of stored, at
+                  +1 cheap VPU pass each (the FFN up-projection, the one
+                  MXU-heavy input, stays anchored by "act_out")
       ``nothing`` save nothing inside the step, recompute the whole
                   forward in backward
 
     MXNET_BACKWARD_DO_MIRROR=1 with no POLICY keeps meaning ``dots``.
     Returns a jax.checkpoint policy or None (XLA's default).  Segment
-    (step-k) remat is separate — see `_mirror_segments`.
+    (step-k / per-block) remat is separate — see `_mirror_segments`.
     """
     pol = os.environ.get("MXNET_BACKWARD_MIRROR_POLICY", "").lower()
     if pol == "none":
@@ -292,11 +325,14 @@ def _mirror_policy():
         return _mirror_saveable
     if pol in ("attn", "attn_out"):
         return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if pol == "streams":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "act_out")
     if pol == "nothing":
         return jax.checkpoint_policies.nothing_saveable
     raise MXNetError(
         "MXNET_BACKWARD_MIRROR_POLICY must be one of none/dots/attn/"
-        "nothing, got %r" % pol)
+        "streams/nothing, got %r" % pol)
 
 
 def _as_list(arrays, names, what, allow_missing=False):

@@ -163,8 +163,12 @@ def get_transformer_lm(vocab_size, seq_len, num_layers=2, num_heads=4,
     label = sym.Variable("softmax_label")
     label_flat = sym.Reshape(data=label, shape=(-1,), name="label_flat")
     if fused_head:
+        # no_bias follows use_bias like every other projection (the dense
+        # branch always honored it; the fused head used to ignore it, so
+        # the PaLM-style no-bias preset grew a head bias back)
         return sym.FusedSoftmaxCE(data=xf, label=label_flat,
-                                  num_hidden=vocab_size, name="pred")
+                                  num_hidden=vocab_size, name="pred",
+                                  no_bias=not use_bias)
     logits = sym.FullyConnected(data=xf, num_hidden=vocab_size,
                                 name="pred", no_bias=not use_bias)
     return sym.SoftmaxOutput(data=logits, label=label_flat, name="softmax")

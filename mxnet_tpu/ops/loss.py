@@ -11,6 +11,8 @@ over a composed graph yields the same gradients as the reference executor.
 """
 from __future__ import annotations
 
+import logging
+import os
 from functools import partial
 
 import numpy as np
@@ -206,6 +208,17 @@ class FusedSoftmaxCE(OpDef):
 
     Weight/bias naming matches FullyConnected ((num_hidden, features) /
     (num_hidden,)), so checkpoints are interchangeable with the dense head.
+
+    **Vocab sharding** (`MXNET_CE_SHARD=1`): when the op is traced under a
+    scoped mesh (`parallel.mesh.MeshContext` — `SPMDTrainer` scopes its
+    step trace) whose "model" axis has size > 1 dividing ``num_hidden``,
+    the head runs inside `shard_map`: the weight/bias are consumed in
+    V/tp slices over "model", each shard folds its local online-softmax
+    stats, and the logsumexp reduce rides the mesh (pmax+psum over ICI) —
+    the in-program form of the reference PS's range-partitioned big
+    arrays (`kvstore_dist.h:230-268`).  Tokens stay sharded over the
+    remaining mesh axes when they divide.  `MXNET_CE_SHARD=0` (default)
+    keeps the replicated-weight path bit-for-bit.
     """
 
     name = "FusedSoftmaxCE"
@@ -241,21 +254,82 @@ class FusedSoftmaxCE(OpDef):
         shapes.append((d[0],))
         return shapes, [(d[0],)], []
 
+    @staticmethod
+    def _shard_plan(n_tokens, num_hidden):
+        """(mesh, token_axes) for the vocab-sharded path, or None.
+
+        Engaged by MXNET_CE_SHARD=1 plus a scoped mesh (MeshContext) with
+        a >1 "model" axis dividing the vocab; tokens additionally shard
+        over the non-"model" axes when their product divides n_tokens."""
+        if os.environ.get("MXNET_CE_SHARD", "0") != "1":
+            return None
+        from ..parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is None or "model" not in mesh.axis_names:
+            return None
+        tp = mesh.shape["model"]
+        if tp <= 1:
+            return None
+        if num_hidden % tp != 0:
+            logging.warning(
+                "MXNET_CE_SHARD=1 but num_hidden=%d does not divide over "
+                "the %d-way model axis; falling back to the replicated "
+                "head", num_hidden, tp)
+            return None
+        token_axes = tuple(a for a in mesh.axis_names if a != "model"
+                           and mesh.shape[a] > 1)
+        sz = int(np.prod([mesh.shape[a] for a in token_axes] or [1]))
+        if token_axes and n_tokens % sz != 0:
+            token_axes = ()  # replicate tokens rather than fail the bind
+        return mesh, token_axes
+
     def apply(self, octx, params, inputs, aux):
-        from .pallas_kernels.fused_ce import fused_softmax_ce
+        from .pallas_kernels.fused_ce import (fused_softmax_ce,
+                                              fused_softmax_ce_sharded)
 
         x = inputs[0].reshape(inputs[0].shape[0], -1)
         w = inputs[1]
         b = None if params["no_bias"] else inputs[2]
         label = inputs[-1]
-        nll = fused_softmax_ce(
-            x, w, b, label,
+        kwargs = dict(
             grad_scale=params["grad_scale"],
             ignore_label=params["ignore_label"],
             use_ignore=params["use_ignore"],
             block_n=params["block_n"],
             block_v=params["block_v"],
         )
+        plan = self._shard_plan(x.shape[0], params["num_hidden"])
+        if plan is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import shard_map
+
+            mesh, token_axes = plan
+            tok = token_axes if token_axes else None
+            if b is None:
+                def body(x_, w_, lbl_):
+                    # local zero bias derived from the local weight slice
+                    return fused_softmax_ce_sharded(x_, w_, None, lbl_,
+                                                    "model", **kwargs)
+
+                fn = shard_map(body, mesh=mesh,
+                               in_specs=(P(tok, None), P("model", None),
+                                         P(tok)),
+                               out_specs=P(tok))
+                nll = fn(x, w, label)
+            else:
+                def body(x_, w_, b_, lbl_):
+                    return fused_softmax_ce_sharded(x_, w_, b_, lbl_,
+                                                    "model", **kwargs)
+
+                fn = shard_map(body, mesh=mesh,
+                               in_specs=(P(tok, None), P("model", None),
+                                         P("model"), P(tok)),
+                               out_specs=P(tok))
+                nll = fn(x, w, b, label)
+            return [nll], []
+        nll = fused_softmax_ce(x, w, b, label, **kwargs)
         return [nll], []
 
 

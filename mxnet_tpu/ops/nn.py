@@ -76,7 +76,11 @@ class Activation(OpDef):
         act = params["act_type"]
         if act not in self._FNS:
             raise MXNetError("Activation: unknown act_type %r" % act)
-        return [self._FNS[act](inputs[0])], []
+        from jax.ad_checkpoint import checkpoint_name
+
+        # remat anchor for MXNET_BACKWARD_MIRROR_POLICY=streams: identity
+        # outside jax.checkpoint (like the attention op's "attn_out" tag)
+        return [checkpoint_name(self._FNS[act](inputs[0]), "act_out")], []
 
 
 register(Activation)

@@ -301,13 +301,22 @@ class ParameterServer:
         self.sync_mode = snap["sync_mode"]
         self._apply_count = snap["apply_count"]
         if snap.get("optimizer") is not None:
-            self._install_optimizer(snap["optimizer"])
+            # a snapshot whose momentum lives in updater_states was
+            # written by a Python-updater incarnation: installing the
+            # native path here (library upgraded since the crash?) would
+            # silently drop that momentum, so pin the Python updater
+            self._install_optimizer(
+                snap["optimizer"],
+                force_python=bool(snap.get("updater_states"))
+                and not snap.get("native_sgd"))
             if snap.get("updater_states") and self._py_states is not None:
                 from ..checkpoint import _states_from_host
 
                 restored = _states_from_host(snap["updater_states"])
                 self._py_states.clear()
                 self._py_states.update(restored)
+            if snap.get("native_sgd"):
+                self._import_native_state(snap["native_sgd"])
         self._rehydrated = True
         logging.warning(
             "parameter server %d rehydrated from %s "
@@ -336,6 +345,9 @@ class ParameterServer:
             if self._opt is not None else None,
             "updater_states": _states_to_host(self._py_states)
             if self._py_states else None,
+            # native C++ SGD momentum tables, keyed by kvstore key (the
+            # int ids are handle-local and not stable across restarts)
+            "native_sgd": self._export_native_state(),
         }
         tmp = "%s.tmp.%d" % (self._snap_path, os.getpid())
         with open(tmp, "wb") as f:
@@ -359,6 +371,42 @@ class ParameterServer:
                 self._write_snapshot()
 
     # -- optimizer install -------------------------------------------------
+
+    def _export_native_state(self):
+        """{kvstore key: momentum table} of the live native SGD handle,
+        or None (no native updater / no momentum yet).  Called under
+        self._lock from `_write_snapshot`."""
+        from .. import _native
+
+        h = getattr(self, "_native_opt_handle", None)
+        if not h or not _native.has_sgd_state():
+            return None
+        by_id = _native.sgd_export_state(h)
+        if not by_id:
+            return None
+        id_to_key = {kid: key
+                     for key, kid in self._native_key_ids.items()}
+        return {id_to_key[kid]: arr for kid, arr in by_id.items()
+                if kid in id_to_key}
+
+    def _import_native_state(self, states):
+        """Install snapshot momentum tables into the (just-reinstalled)
+        native SGD handle, assigning ids through the same setdefault path
+        the updater uses so later pushes agree on the mapping."""
+        from .. import _native
+
+        h = getattr(self, "_native_opt_handle", None)
+        if not h or not _native.has_sgd_state():
+            logging.warning(
+                "parameter server %d: snapshot carries native SGD "
+                "momentum but no native handle is live (library "
+                "downgraded?) — momentum restarts from zero",
+                self.server_id)
+            return
+        key_ids = self._native_key_ids
+        _native.sgd_import_state(
+            h, {key_ids.setdefault(key, len(key_ids)): arr
+                for key, arr in states.items()})
 
     def _native_sgd_updater(self, opt):
         """C++ SGD fast path (`native/optimizer.cc`, the reference's
@@ -387,6 +435,9 @@ class ParameterServer:
         self._native_opt_handle = h
         fp = ctypes.POINTER(ctypes.c_float)
         key_ids = {}  # kvstore keys may be str; C side wants stable ints
+        # exposed for _write_snapshot/_rehydrate: the momentum tables live
+        # in C++ keyed by these ids (see _native.sgd_export_state)
+        self._native_key_ids = key_ids
 
         def native_updater(key, grad, weight, _h=h):
             kid = key_ids.setdefault(key, len(key_ids))
@@ -405,18 +456,34 @@ class ParameterServer:
 
         return native_updater
 
-    def _install_optimizer(self, blob):
+    def _install_optimizer(self, blob, force_python=False):
         """Build the server updater from a pickled optimizer (RPC install
-        or snapshot rehydrate).  With snapshotting on, the native C++ SGD
-        path is skipped — its momentum tables live in C++ and cannot be
-        captured by `_write_snapshot`, so a rehydrated server would
-        silently restart momentum from zero."""
+        or snapshot rehydrate).  The native C++ SGD path now composes
+        with snapshotting: `native/optimizer.cc` exports/imports its
+        momentum tables (`mxtpu_sgd_get/set_state`), so `_write_snapshot`
+        captures them and `_rehydrate` restores them.  Only a library
+        built WITHOUT the state entry points (older .so) still forces the
+        Python updater when snapshots are on — momentum silently
+        restarting from zero after a crash is worse than the slow path.
+        ``force_python`` pins the Python updater regardless (rehydrate
+        from a snapshot whose momentum is in Python-updater form)."""
+        from .. import _native
         from ..optimizer import get_updater
 
         opt = pickle.loads(blob)
-        updater = None if self._snap_path else self._native_sgd_updater(opt)
+        updater = None if force_python or (
+            self._snap_path and not _native.has_sgd_state()) \
+            else self._native_sgd_updater(opt)
         states = None
         if updater is None:
+            # falling back to the Python updater: a handle left by a
+            # previous native install would leak its C++ tables AND keep
+            # feeding _export_native_state stale momentum in snapshots
+            prev = getattr(self, "_native_opt_handle", None)
+            if prev:
+                _native.LIB.mxtpu_sgd_destroy(prev)
+                self._native_opt_handle = None
+                self._native_key_ids = {}
             u = get_updater(opt)
             states = u.states
 

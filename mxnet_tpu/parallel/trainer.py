@@ -30,6 +30,24 @@ from ..executor import _build_graph_fn, _mirror_policy
 from ..ndarray import NDArray
 from ..optimizer import stochastic_round_bf16
 from .. import random as _random
+from .mesh import MeshContext
+
+
+def _ce_head_params(symbol):
+    """(weight_name, bias_name|None, num_hidden) of the symbol's
+    FusedSoftmaxCE head, or None — the params MXNET_CE_SHARD shards over
+    the "model" axis."""
+    from ..symbol import _topo_order
+
+    for node in _topo_order(symbol._heads):
+        if node.is_variable or node.op.name != "FusedSoftmaxCE":
+            continue
+        wname = node.inputs[1][0].name
+        bname = None
+        if not node.params.get("no_bias"):
+            bname = node.inputs[2][0].name
+        return wname, bname, int(node.params["num_hidden"])
+    return None
 
 
 def _put_global(arr, sharding):
@@ -160,6 +178,24 @@ class SPMDTrainer:
         initializer = initializer or Uniform(0.07)
         repl = NamedSharding(mesh, P())
 
+        # MXNET_CE_SHARD=1: store the FusedSoftmaxCE head weight/bias (and
+        # their optimizer moments, via _param_sharding below) sharded over
+        # the "model" axis — the op itself picks up the scoped mesh at
+        # trace time (ops/loss.py) and runs the vocab-sharded kernels, so
+        # the V x d table never exists replicated on any chip
+        if (os.environ.get("MXNET_CE_SHARD", "0") == "1"
+                and "model" in mesh.axis_names
+                and mesh.shape["model"] > 1):
+            head = _ce_head_params(symbol)
+            if head is not None and head[2] % mesh.shape["model"] == 0:
+                wname, bname, _ = head
+                param_sharding = dict(param_sharding or {})
+                param_sharding.setdefault(
+                    wname, NamedSharding(mesh, P("model", None)))
+                if bname is not None:
+                    param_sharding.setdefault(
+                        bname, NamedSharding(mesh, P("model")))
+
         def place(value_or_shape, np_dtype, sh):
             if abstract:
                 shape = value_or_shape if isinstance(value_or_shape, tuple) \
@@ -207,7 +243,14 @@ class SPMDTrainer:
                     self.aux[n] = _put_global(
                         np.ones(self.aux[n].shape, np.float32), repl)
 
-        graph_fn, _, _, _ = _build_graph_fn(symbol)
+        _raw_graph_fn, _, _, _ = _build_graph_fn(symbol)
+
+        def graph_fn(args, aux_list, rng, is_train):
+            # scope the mesh over the trace so mesh-aware ops (the
+            # MXNET_CE_SHARD vocab-sharded head) can see it; pure python
+            # context, zero cost in the compiled program
+            with MeshContext(mesh):
+                return _raw_graph_fn(args, aux_list, rng, is_train)
         # Rematerialization knobs (the reference's tunable mirroring plan,
         # `static_graph.cc:410-560`): MXNET_BACKWARD_MIRROR_POLICY selects
         # what survives fwd->bwd (dots / attn / nothing — see
@@ -370,12 +413,29 @@ class SPMDTrainer:
         Drive from an `lr_scheduler.FactorScheduler` etc. per epoch."""
         self.lr = float(lr)
 
+    def _watch_retrace(self, site, dev_batch):
+        """Feed the retrace watchdog this step's jit-cache key (shapes/
+        dtypes of the batch leaves — params/momenta/aux are donated and
+        never change shape).  A steady-state loop with the sharded CE
+        head must show ZERO retraces here; the nightly gates on it."""
+        from .. import telemetry
+
+        if not telemetry.retrace_enabled():
+            return
+        names = sorted(dev_batch)
+        sig = telemetry.arrays_signature([dev_batch[n] for n in names],
+                                         names)
+        telemetry.watch_jit(site, sig,
+                            scope=telemetry.watch_scope(self.symbol))
+
     def step(self, batch):
         """One fused train step.  Returns the graph outputs."""
         self._nstep += 1
         rng = jax.random.fold_in(self._base_key, self._nstep)
+        dev_batch = self.shard_batch(batch)
+        self._watch_retrace("trainer.step", dev_batch)
         self.params, self.momenta, self.aux, outs = self._step(
-            self.params, self.momenta, self.aux, self.shard_batch(batch),
+            self.params, self.momenta, self.aux, dev_batch,
             rng, jnp.float32(self.lr)
         )
         return outs

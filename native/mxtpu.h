@@ -110,5 +110,16 @@ MXTPU_API void mxtpu_sgd_set_lr(mxtpu_handle opt, float lr);
 MXTPU_API int mxtpu_sgd_update(mxtpu_handle opt, int key, float* weight,
                                const float* grad, int64_t n);
 MXTPU_API void mxtpu_sgd_destroy(mxtpu_handle opt);
+/* Momentum-state export/import so dist-PS snapshots can capture and
+ * rehydrate the C++ tables (fault tolerance composes with the native
+ * updater).  keys: write up to cap ids into out, return the total count
+ * (cap=0 sizes the buffer); state_size: floats held for key (0 = none);
+ * get/set: copy the table out/in (get requires the exact size). */
+MXTPU_API int64_t mxtpu_sgd_keys(mxtpu_handle opt, int* out, int64_t cap);
+MXTPU_API int64_t mxtpu_sgd_state_size(mxtpu_handle opt, int key);
+MXTPU_API int mxtpu_sgd_get_state(mxtpu_handle opt, int key, float* out,
+                                  int64_t n);
+MXTPU_API int mxtpu_sgd_set_state(mxtpu_handle opt, int key,
+                                  const float* data, int64_t n);
 
 #endif  /* MXTPU_H_ */

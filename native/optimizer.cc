@@ -127,3 +127,74 @@ MXTPU_API void mxtpu_sgd_destroy(mxtpu_handle opt) {
   std::lock_guard<std::mutex> lk(g_mu);
   g_opts.erase(opt);
 }
+
+/* -- momentum state export/import (server snapshot support) --------------
+ *
+ * The parameter server's atomic snapshots (`parallel/dist.py
+ * _write_snapshot`) must capture the momentum tables this updater keeps
+ * in C++ — before these entry points existed, enabling snapshots forced
+ * the server back onto the Python updater (ROADMAP carried item).
+ */
+
+namespace {
+SgdOpt* find_opt(mxtpu_handle opt) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_opts.find(opt);
+  return it == g_opts.end() ? nullptr : it->second.get();
+}
+}  // namespace
+
+MXTPU_API int64_t mxtpu_sgd_keys(mxtpu_handle opt, int* out, int64_t cap) {
+  SgdOpt* o = find_opt(opt);
+  if (!o) {
+    mxtpu_err() = "sgd_keys: bad handle";
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(o->mu);
+  int64_t n = 0;
+  for (const auto& kv : o->mom) {
+    if (out && n < cap) out[n] = kv.first;
+    ++n;
+  }
+  return n;  // count of keys with momentum state (call with cap=0 to size)
+}
+
+MXTPU_API int64_t mxtpu_sgd_state_size(mxtpu_handle opt, int key) {
+  SgdOpt* o = find_opt(opt);
+  if (!o) {
+    mxtpu_err() = "sgd_state_size: bad handle";
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(o->mu);
+  auto it = o->mom.find(key);
+  return it == o->mom.end() ? 0 : (int64_t)it->second.size();
+}
+
+MXTPU_API int mxtpu_sgd_get_state(mxtpu_handle opt, int key, float* out,
+                                  int64_t n) {
+  SgdOpt* o = find_opt(opt);
+  if (!o) {
+    mxtpu_err() = "sgd_get_state: bad handle";
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(o->mu);
+  auto it = o->mom.find(key);
+  if (it == o->mom.end() || (int64_t)it->second.size() != n) {
+    mxtpu_err() = "sgd_get_state: no state of that size for key";
+    return -1;
+  }
+  std::copy(it->second.begin(), it->second.end(), out);
+  return 0;
+}
+
+MXTPU_API int mxtpu_sgd_set_state(mxtpu_handle opt, int key,
+                                  const float* data, int64_t n) {
+  SgdOpt* o = find_opt(opt);
+  if (!o) {
+    mxtpu_err() = "sgd_set_state: bad handle";
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(o->mu);
+  o->mom[key].assign(data, data + n);
+  return 0;
+}

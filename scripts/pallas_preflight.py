@@ -230,6 +230,28 @@ def _run_checks(jax, jnp, fa, fc, verbose):
     check("fused_ce_bwd_dw", dw_p, dw_j, 3e-2)
     check("fused_ce_bwd_db", db_p, db_j, 3e-2)
 
+    # ---- round-6 single-pass structure: stats+residual fwd + row-scaled
+    # dW/dx backwards (MXNET_CE_SINGLE_PASS=1, the default) -------------
+    lse_sp, a_sp, dxp_sp = jax.jit(lambda x, w, b, l: fc._fwd_sp_pallas(
+        x, w, b, l, 256, 1024))(x, w, b, lbl)
+    lse_sj, a_sj, dxp_sj = jax.jit(lambda x, w, b, l: fc._fwd_sp_jnp(
+        x, w, b, l, 1024))(x, w, b, lbl)
+    check("fused_ce_sp_fwd_lse", lse_sp, lse_sj, 1e-3)
+    check("fused_ce_sp_fwd_picked", a_sp, a_sj, 1e-2)
+    check("fused_ce_sp_fwd_dxp", dxp_sp, dxp_sj, 3e-2)
+    r = jnp.asarray(rng.rand(N).astype(np.float32))
+    dwr_p, dbr_p = jax.jit(lambda *t: fc._bwd_dw_rs_pallas(
+        *t, 256, 1024))(x, w, b, lbl, lse_sj, r)
+    dwr_j, dbr_j = jax.jit(lambda *t: fc._bwd_dw_rs_jnp(
+        *t, 1024))(x, w, b, lbl, lse_sj, r)
+    check("fused_ce_rs_bwd_dw", dwr_p, dwr_j, 3e-2)
+    check("fused_ce_rs_bwd_db", dbr_p, dbr_j, 3e-2)
+    dxr_p = jax.jit(lambda *t: fc._bwd_dx_rs_pallas(
+        *t, 256, 1024))(x, w, b, lbl, lse_sj, r)
+    dxr_j = jax.jit(lambda *t: fc._bwd_dx_rs_jnp(
+        *t, 1024))(x, w, b, lbl, lse_sj, r)
+    check("fused_ce_rs_bwd_dx", dxr_p, dxr_j, 3e-2)
+
     status = "pass" if not failures else "FAIL: " + "; ".join(failures)
     out = {"status": status}
     out.update(checks)

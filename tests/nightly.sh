@@ -19,6 +19,19 @@ MXNET_CHAOS_NIGHTLY=1 ./run_tests.sh tests/test_fault_tolerance.py -q
 
 CPU_ENV="env PYTHONPATH=$(pwd) JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8"
 
+# -- round-6 fused-CE gates ----------------------------------------------
+# (1) interpret-mode single-pass CE parity: the REAL Pallas kernel bodies
+# of the round-6 single-pass + row-scaled backward structures, executed
+# through the Pallas interpreter against the jnp fallbacks (a kernel-body
+# regression must not ride to the chip preflight to be caught)
+./run_tests.sh tests/test_pallas_interpret.py -q -k fused_ce
+# (2) sharded-CE steady state: a fixed-shape training loop with
+# MXNET_CE_SHARD=1 must log ZERO trainer.step retrace events after
+# warmup (the retrace watchdog is the witness), and the sharded/single-
+# pass grad-parity suite must hold
+./run_tests.sh tests/test_fused_ce.py -q \
+    -k "zero_steady_state_retraces or sharded or single_pass"
+
 # -- real-data convergence gates (test_all.sh:44-73 check_val pattern) ----
 MNIST_DIR=$(mktemp -d)/mnist
 $CPU_ENV python tools/make_mnist.py --out "$MNIST_DIR" --train 8000 --test 2000

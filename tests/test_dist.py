@@ -391,12 +391,16 @@ def test_server_death_mid_async_storm_aborts_loudly():
         # peers.  Which loud path fires depends on where the kill lands:
         # mid-multi-shard-push -> the partial rank logs "aborting"
         # (dist.py _abort); between pushes -> both ranks surface the RPC
-        # failure directly at the sync point ("failed mid-round-trip").
-        # Both are loud (no goodbye, heartbeats stop, watchdog releases
-        # peers); a quiet exit would have tripped the detected-failure
-        # or hang assertions above.
+        # failure directly at the sync point ("failed mid-round-trip");
+        # between completed rounds -> the NEXT op's connect is refused
+        # and surfaces as "cannot reach parameter server" (dist.py
+        # _rpc_call's connect-time contract).  All are loud (no goodbye,
+        # heartbeats stop, watchdog releases peers); a quiet exit would
+        # have tripped the detected-failure or hang assertions above.
         assert ("aborting" in all_out
-                or "failed mid-round-trip" in all_out), all_out[-3000:]
+                or "failed mid-round-trip" in all_out
+                or "cannot reach parameter server" in all_out), \
+            all_out[-3000:]
     finally:
         for p in servers + workers:
             if p.poll() is None:

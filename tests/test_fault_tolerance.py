@@ -259,6 +259,61 @@ def test_server_crash_rehydrate_matches_uninterrupted(monkeypatch,
     np.testing.assert_array_equal(ref, rec)
 
 
+def test_native_sgd_updater_composes_with_snapshots(monkeypatch, tmp_path):
+    """ROADMAP carried item (PR 3): snapshots used to force the Python
+    updater because the C++ momentum tables were not capturable.  With
+    `mxtpu_sgd_get/set_state` the native fast path must (a) actually
+    engage while snapshotting, (b) land its momentum in the snapshot
+    keyed by kvstore key, and (c) survive a crash/rehydrate bit-for-bit
+    against an uninterrupted native run."""
+    import pickle
+
+    from mxnet_tpu import _native
+
+    if not _native.has_sgd_state():
+        pytest.skip("native lib lacks sgd state export (make -C native)")
+
+    def run(snapdir, crash_after=None):
+        monkeypatch.setenv("MXNET_PS_SNAPSHOT_DIR", snapdir)
+        port = _free_port()
+        ps = _start_server(port)
+        kv = _connect_kv(monkeypatch, port, MXNET_PS_RPC_RETRIES="40",
+                         MXNET_PS_RPC_TIMEOUT="60")
+        kv.init(3, mx.nd.ones((4,)))
+        kv.set_optimizer(SGD(learning_rate=0.1, momentum=0.9,
+                             rescale_grad=1.0))
+        # the whole point: the native C++ path is live DESPITE snapshots
+        assert getattr(ps, "_native_opt_handle", None), \
+            "native SGD updater was not engaged with snapshotting on"
+        rounds = 6
+        if crash_after is None:
+            out = _momentum_rounds(kv, 3, rounds)
+        else:
+            out = _momentum_rounds(kv, 3, crash_after)
+            snap_file = os.path.join(snapdir, "ps_0.snap")
+            with open(snap_file, "rb") as f:
+                snap = pickle.loads(f.read())
+            assert snap.get("native_sgd"), \
+                "snapshot missing the native momentum tables"
+            assert 3 in snap["native_sgd"]
+            assert snap["native_sgd"][3].shape == (4,)
+            ps.kill()
+            kv._pools[0].close_all()
+            ps2 = _start_server(port)
+            out = _momentum_rounds(kv, 3, rounds, start_round=crash_after)
+            assert getattr(ps2, "_native_opt_handle", None), \
+                "rehydrated server fell back to the Python updater"
+        kv.barrier()
+        kv.pull(3, out=out)
+        final = out.asnumpy().copy()
+        kv.stop_server()
+        return final
+
+    ref = run(str(tmp_path / "ref"))
+    rec = run(str(tmp_path / "rec"), crash_after=3)
+    np.testing.assert_array_equal(ref, rec)
+
+
 def test_restarted_server_without_snapshot_fails_fast(monkeypatch,
                                                       tmp_path):
     """Without a covering snapshot a restarted server cannot recover

@@ -152,9 +152,13 @@ def test_symbol_op_shapes_and_executor():
                                    err_msg="grad mismatch for %s" % k)
 
 
-def test_transformer_fused_head_grads_match_dense():
+@pytest.mark.parametrize("single_pass", ["0", "1"])
+def test_transformer_fused_head_grads_match_dense(monkeypatch, single_pass):
     """End-to-end: get_transformer_lm(fused_head=True) must produce the
-    same parameter gradients as the dense-head model."""
+    same parameter gradients as the dense-head model — under BOTH the
+    round-5 5-pass recompute structure (MXNET_CE_SINGLE_PASS=0) and the
+    round-6 single-pass structure."""
+    monkeypatch.setenv("MXNET_CE_SINGLE_PASS", single_pass)
     from mxnet_tpu import models
 
     vocab, seq, batch = 19, 6, 4
@@ -292,6 +296,225 @@ def test_bias_none_and_int_labels_under_grad():
     g = jax.grad(lambda x_: jnp.sum(
         fused_softmax_ce(x_, wj, None, li, block_v=8)))(xj)
     assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------------------
+# round 6: single-pass structure + vocab sharding
+# ---------------------------------------------------------------------------
+
+
+def _vjp_all(fn, x, w, b):
+    out, vjp = jax.vjp(fn, x, w, b)
+    dx, dw, db = vjp(jnp.ones_like(out))
+    return tuple(np.asarray(t) for t in (out, dx, dw, db))
+
+
+def _ignore_case(n=24, d=16, v=40):
+    x, w, b, label = _make(n=n, d=d, v=v)
+    label[3] = label[7] = 5.0  # exercised ignore rows
+    return (jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+            jnp.asarray(label))
+
+
+def test_single_pass_matches_five_pass(monkeypatch):
+    """MXNET_CE_SINGLE_PASS=1 (store the p@W residual, 4 logit passes)
+    must reproduce the 5-pass structure's loss AND gradients, including
+    grad_scale and ignore_label; =0 is the bit-for-bit kill-switch (same
+    code path as round 5)."""
+    xj, wj, bj, lj = _ignore_case()
+    kw = dict(grad_scale=1.7, ignore_label=5.0, use_ignore=True, block_v=8)
+
+    def run(flag):
+        monkeypatch.setenv("MXNET_CE_SINGLE_PASS", flag)
+        return _vjp_all(
+            lambda x_, w_, b_: fused_softmax_ce(x_, w_, b_, lj, **kw),
+            xj, wj, bj)
+
+    ref = run("0")
+    got = run("1")
+    # the non-vjp forward shares the stats implementation: bit-identical
+    nll0 = np.asarray(fused_softmax_ce(xj, wj, bj, lj, **kw))
+    monkeypatch.setenv("MXNET_CE_SINGLE_PASS", "0")
+    np.testing.assert_array_equal(
+        nll0, np.asarray(fused_softmax_ce(xj, wj, bj, lj, **kw)))
+    for name, a, g in zip(("nll", "dx", "dw", "db"), ref, got):
+        np.testing.assert_allclose(g, a, rtol=1e-5, atol=1e-6,
+                                   err_msg="single-pass %s" % name)
+    # kill-switch really is the round-5 entry point
+    from mxnet_tpu.ops.pallas_kernels.fused_ce import _fused_ce
+
+    direct = _vjp_all(
+        lambda x_, w_, b_: _fused_ce(x_, w_, b_, lj, 1.7, 5.0, True,
+                                     512, 8), xj, wj, bj)
+    for name, a, g in zip(("nll", "dx", "dw", "db"), ref, direct):
+        np.testing.assert_array_equal(a, g,
+                                      err_msg="kill-switch %s" % name)
+
+
+def test_single_pass_out_of_range_labels(monkeypatch):
+    """Out-of-range labels (label -1 — the MXNet padding convention —
+    WITHOUT use_ignore, or label >= vocab) match no onehot column in the
+    5-pass structure, so the single-pass dx must not subtract any W row
+    for them either."""
+    x, w, b, label = _make(n=24, d=16, v=40)
+    label[0] = -1.0
+    label[5] = 40.0
+    xj, wj, bj, lj = (jnp.asarray(t) for t in (x, w, b, label))
+    kw = dict(grad_scale=1.3, use_ignore=False, block_v=8)
+
+    def run(flag):
+        monkeypatch.setenv("MXNET_CE_SINGLE_PASS", flag)
+        return _vjp_all(
+            lambda x_, w_, b_: fused_softmax_ce(x_, w_, b_, lj, **kw),
+            xj, wj, bj)
+
+    ref = run("0")
+    got = run("1")
+    for name, a, g in zip(("nll", "dx", "dw", "db"), ref, got):
+        np.testing.assert_allclose(g, a, rtol=1e-5, atol=1e-6,
+                                   err_msg="out-of-range %s" % name)
+
+
+@pytest.mark.parametrize("single_pass", ["0", "1"])
+def test_sharded_matches_dense_on_cpu_mesh(monkeypatch, single_pass):
+    """fused_softmax_ce_sharded inside shard_map (tokens over "data",
+    vocab over "model") vs the unsharded op: losses and every gradient,
+    with grad_scale + ignore_label, under both backward structures."""
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.ops.pallas_kernels.fused_ce import \
+        fused_softmax_ce_sharded
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.mesh import shard_map
+
+    monkeypatch.setenv("MXNET_CE_SINGLE_PASS", single_pass)
+    xj, wj, bj, lj = _ignore_case(n=24, d=16, v=40)
+    kw = dict(grad_scale=1.7, ignore_label=5.0, use_ignore=True, block_v=8)
+    ref = _vjp_all(
+        lambda x_, w_, b_: fused_softmax_ce(x_, w_, b_, lj, **kw),
+        xj, wj, bj)
+
+    mesh = make_mesh(shape=(2, 4), axis_names=("data", "model"))
+
+    def sharded(x_, w_, b_):
+        def body(xs, ws, bs, ys):
+            return fused_softmax_ce_sharded(xs, ws, bs, ys, "model", **kw)
+
+        return shard_map(body, mesh=mesh,
+                         in_specs=(P("data", None), P("model", None),
+                                   P("model"), P("data")),
+                         out_specs=P("data"))(x_, w_, b_, lj)
+
+    got = _vjp_all(sharded, xj, wj, bj)
+    for name, a, g in zip(("nll", "dx", "dw", "db"), ref, got):
+        np.testing.assert_allclose(g, a, rtol=1e-4, atol=1e-5,
+                                   err_msg="sharded %s" % name)
+
+
+def test_ce_shard_trainer_trajectory_matches_replicated(monkeypatch):
+    """MXNET_CE_SHARD=1 end-to-end: an SPMDTrainer on a (data, model)
+    mesh (head weight stored in V/tp slices, lse reduce on the mesh)
+    must walk the same parameter trajectory as the replicated-head
+    single-device trainer."""
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    vocab, seq, batch = 24, 8, 16
+    rng = np.random.RandomState(0)
+    bd = {"data": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+          "softmax_label": rng.randint(0, vocab, (batch, seq)).astype(
+              np.float32)}
+
+    def traj(mesh_shape, axes, shard):
+        monkeypatch.setenv("MXNET_CE_SHARD", "1" if shard else "0")
+        mx.random.seed(0)
+        net = models.get_transformer_lm(
+            vocab_size=vocab, seq_len=seq, num_layers=1, num_heads=2,
+            num_embed=16, fused_head=True)
+        mesh = make_mesh(shape=mesh_shape, axis_names=axes)
+        tr = SPMDTrainer(net, mesh,
+                         data_shapes={"data": (batch, seq),
+                                      "softmax_label": (batch, seq)},
+                         lr=1e-2, optimizer="sgd", momentum=0.9, wd=0.0)
+        if shard:
+            # the head really is stored sharded (momenta included)
+            from jax.sharding import PartitionSpec as P
+
+            spec = tr._param_sharding["pred_weight"].spec
+            assert spec == P("model", None), spec
+        for _ in range(3):
+            tr.step(bd)
+        arg, _ = tr.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    ref = traj((1,), ("data",), False)
+    got = traj((2, 4), ("data", "model"), True)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_single_pass_dispatch_count_unchanged(monkeypatch):
+    """The single-pass structure changes kernels, not dispatch topology:
+    one fused fwd+bwd program per train step either way
+    (profiler.count_dispatches, the PR-1 O(1) contract)."""
+    from mxnet_tpu import profiler
+
+    v, d, n = 21, 10, 12
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    net = mx.sym.FusedSoftmaxCE(data=data, label=label, num_hidden=v,
+                                name="pred")
+    rng = np.random.RandomState(3)
+    counts = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("MXNET_CE_SINGLE_PASS", flag)
+        args = {"data": mx.nd.array(rng.randn(n, d).astype(np.float32)),
+                "softmax_label": mx.nd.array(
+                    rng.randint(0, v, (n,)).astype(np.float32)),
+                "pred_weight": mx.nd.array(
+                    rng.randn(v, d).astype(np.float32) * 0.2),
+                "pred_bias": mx.nd.array(np.zeros(v, np.float32))}
+        g = {k: mx.nd.zeros(a.shape) for k, a in args.items()}
+        exe = net.bind(mx.cpu(), args, args_grad=g)
+        exe.forward(is_train=True)
+        exe.backward()  # warm: compile outside the counted window
+        exe.forward(is_train=True)
+        with profiler.count_dispatches() as dcount:
+            exe.backward()
+        counts[flag] = dcount.jit_entries
+    assert counts["0"] == counts["1"] == 1, counts
+
+
+def test_ce_shard_zero_steady_state_retraces(monkeypatch):
+    """With the sharded head enabled, a fixed-shape training loop must
+    not recompile after warmup: the retrace watchdog (fed by
+    SPMDTrainer.step) records zero 'trainer.step' retrace events."""
+    from mxnet_tpu import models, telemetry
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    monkeypatch.setenv("MXNET_CE_SHARD", "1")
+    vocab, seq, batch = 24, 8, 16
+    rng = np.random.RandomState(0)
+    bd = {"data": rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+          "softmax_label": rng.randint(0, vocab, (batch, seq)).astype(
+              np.float32)}
+    mx.random.seed(0)
+    net = models.get_transformer_lm(vocab_size=vocab, seq_len=seq,
+                                    num_layers=1, num_heads=2,
+                                    num_embed=16, fused_head=True)
+    mesh = make_mesh(shape=(4, 2), axis_names=("data", "model"))
+    tr = SPMDTrainer(net, mesh,
+                     data_shapes={"data": (batch, seq),
+                                  "softmax_label": (batch, seq)},
+                     lr=1e-2, optimizer="sgd")
+    before = len([e for e in telemetry.events("retrace")
+                  if e.get("site") == "trainer.step"])
+    for _ in range(4):
+        tr.step(bd)
+    after = [e for e in telemetry.events("retrace")
+             if e.get("site") == "trainer.step"]
+    assert len(after) == before, after[before:]
 
 
 def test_fused_ce_inside_shard_map():

@@ -36,7 +36,14 @@ def test_dryrun_multichip_scales(n):
         capture_output=True, text=True, timeout=560, env=_fresh_env(n),
         cwd=ROOT)
     assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
-    assert "dp/tp/sp/pp/ep all compiled and executed" in proc.stdout
+    assert "dp/tp/sp/pp/ep all compiled, executed and oracle-checked" \
+        in proc.stdout
+    # round-6 numeric oracles: every mode prints (and gates on) its
+    # sharded-vs-replica max-abs-diff — compiling is no longer passing
+    for mode in ("dp+tp", "lm_ce_shard", "sp", "pp", "ep"):
+        assert ("dryrun_multichip %s oracle: max_abs_diff=" % mode) \
+            in proc.stdout, (mode, proc.stdout[-1500:])
+    assert "vocab-sharded fused CE head" in proc.stdout
 
 
 MULTIHOST_WORKER = textwrap.dedent("""
