@@ -582,6 +582,134 @@ def overlap_bench(batches=None, batch=None, record=True):
     return result
 
 
+def serve_bench(record=True):
+    """Poisson-traffic serving benchmark (``python bench.py --serve``).
+
+    Drives the continuous-batching engine (mxnet_tpu/serving) with
+    Poisson arrivals of random-token prompts and records the latency
+    distribution (p50/p99 + time-to-first-token), throughput
+    (tok/s/chip), batch occupancy, queue depth, and — the shape
+    discipline the engine promises — the number of steady-state
+    recompiles after warmup (must be 0: every serving launch feeds the
+    retrace watchdog, and warmup pre-AOT-compiles the whole bucket set).
+    Artifact: bench_results/serve_bench.json.
+
+    CPU-mesh friendly: the default geometry is small; SERVE_* knobs
+    scale it up for on-chip runs (see docs/serving.md).
+    """
+    import jax
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.serving import ReplicaRouter, TransformerKVModel
+
+    n_requests = int(os.environ.get("SERVE_REQUESTS", "48"))
+    rate = float(os.environ.get("SERVE_RATE", "16"))  # req/s offered
+    n_replicas = int(os.environ.get("SERVE_REPLICAS", "1"))
+    vocab = int(os.environ.get("SERVE_VOCAB", "512"))
+    seq = int(os.environ.get("SERVE_SEQ", "128"))
+    layers = int(os.environ.get("SERVE_LAYERS", "2"))
+    heads = int(os.environ.get("SERVE_HEADS", "4"))
+    embed = int(os.environ.get("SERVE_EMBED", "128"))
+    prompt_max = int(os.environ.get("SERVE_PROMPT_MAX", "24"))
+    max_new = int(os.environ.get("SERVE_NEW", "16"))
+    rng = np.random.RandomState(int(os.environ.get("SERVE_SEED", "0")))
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tel_path = os.path.join(here, "bench_results", "telemetry_serve.jsonl")
+    try:
+        os.remove(tel_path)
+    except OSError:
+        pass
+    telemetry.add_sink(telemetry.JsonlSink(tel_path))
+
+    model = TransformerKVModel(vocab, seq, num_layers=layers,
+                               num_heads=heads, num_embed=embed)
+    params = model.init_params(rng)
+    n_replicas = min(n_replicas, len(jax.devices()))
+    router = ReplicaRouter.from_mesh(model, params, n_replicas=n_replicas)
+    t0 = time.perf_counter()
+    buckets = router.warmup()[0]
+    warmup_s = time.perf_counter() - t0
+    telemetry.step_report(extra={"phase": "serve_warmup"})
+    reg = telemetry.registry()
+    compiles_after_warmup = reg.counter("serve.aot.compiles").value
+
+    prompts = [list(rng.randint(0, vocab,
+                                size=int(rng.randint(1, prompt_max + 1))))
+               for _ in range(n_requests)]
+    router.start()
+    depth_samples = []
+    reqs = []
+    t_start = time.perf_counter()
+    try:
+        for p in prompts:
+            reqs.append(router.submit(p, max_new_tokens=max_new))
+            depth_samples.append(router.depth())
+            if rate > 0:
+                time.sleep(rng.exponential(1.0 / rate))
+        deadline = float(os.environ.get("SERVE_TIMEOUT", "600"))
+        for r in reqs:
+            try:
+                r.result(timeout=max(1.0, deadline -
+                                     (time.perf_counter() - t_start)))
+            except MXNetError:
+                pass  # r.error / r.done carry it into the accounting below
+    finally:
+        router.stop()
+    elapsed = time.perf_counter() - t_start
+
+    lat = sorted(r.latency_ms for r in reqs if r.latency_ms is not None)
+    ttft = sorted(r.ttft_ms for r in reqs if r.ttft_ms is not None)
+    n_tokens = sum(len(r.tokens) for r in reqs)
+    rows = sum(e.stats["decode_rows"] for e in router.engines)
+    padded = sum(e.stats["decode_padded"] for e in router.engines)
+    steady_retraces = [e for e in telemetry.events("retrace")
+                       if str(e.get("site", "")).startswith("serving.")]
+    compiles_after_run = reg.counter("serve.aot.compiles").value
+    telemetry.step_report(extra={"phase": "serve_end"})
+
+    def pct(xs, q):
+        return None if not xs else round(xs[min(len(xs) - 1,
+                                                int(len(xs) * q))], 2)
+
+    result = {
+        "metric": "serve_tokens_per_sec_per_chip",
+        "value": round(n_tokens / elapsed / n_replicas, 2),
+        "unit": "tok/s/chip (continuous batching, %d replicas, greedy, "
+                "vocab=%d L=%d E=%d S=%d)" % (n_replicas, vocab, layers,
+                                              embed, seq),
+        "requests": n_requests,
+        "completed": sum(1 for r in reqs if r.done and r.error is None),
+        "errors": ([r.error for r in reqs if r.error is not None] +
+                   ["timeout" for r in reqs if not r.done])[:5],
+        "offered_rate_req_s": rate,
+        "elapsed_s": round(elapsed, 3),
+        "latency_ms": {"p50": pct(lat, 0.50), "p99": pct(lat, 0.99),
+                       "max": round(lat[-1], 2) if lat else None},
+        "ttft_ms": {"p50": pct(ttft, 0.50), "p99": pct(ttft, 0.99)},
+        "tokens_generated": n_tokens,
+        "batch_occupancy": round(rows / max(rows + padded, 1), 4),
+        "queue_depth": {"mean": round(float(np.mean(depth_samples)), 2),
+                        "max": int(np.max(depth_samples))},
+        "buckets": buckets,
+        "aot_compiles_warmup": compiles_after_warmup,
+        "steady_state_recompiles": (compiles_after_run -
+                                    compiles_after_warmup),
+        "steady_state_retrace_events": len(steady_retraces),
+        "warmup_s": round(warmup_s, 3),
+        "backend": jax.default_backend(),
+        "telemetry_stream": os.path.relpath(tel_path, here),
+    }
+    if record:
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -613,5 +741,7 @@ def _io_pipeline_ips(n=384):
 if __name__ == "__main__":
     if "--overlap" in sys.argv:
         overlap_bench()
+    elif "--serve" in sys.argv:
+        serve_bench()
     else:
         main()

@@ -60,6 +60,7 @@ from . import kvstore
 from . import kvstore as kv
 from . import predictor
 from .predictor import Predictor
+from . import serving
 from . import storage
 from . import checkpoint
 from . import profiler

@@ -27,6 +27,7 @@ compile it.
 from __future__ import annotations
 
 import os
+import threading
 import weakref
 
 import numpy as np
@@ -333,6 +334,52 @@ def _mirror_policy():
     raise MXNetError(
         "MXNET_BACKWARD_MIRROR_POLICY must be one of none/dots/attn/"
         "streams/nothing, got %r" % pol)
+
+
+class AotCache:
+    """Keyed store of AOT-compiled executables (jit(...).lower().compile())
+    with telemetry hit/compile accounting.
+
+    The Predictor compiles one executable per instance; the serving engine
+    (mxnet_tpu/serving) compiles one per (batch, seq) bucket and MUST hit
+    this cache for every steady-state call — `<name>.compiles` advancing
+    after warmup is the same signal the retrace watchdog diagnoses, made
+    countable.  Thread-safe: replica engines build caches from worker
+    threads."""
+
+    def __init__(self, name="aot"):
+        self._name = name
+        self._cache = {}
+        self._lock = threading.Lock()
+
+    def get(self, key, build=None):
+        """The executable for `key`, building (and counting a compile) via
+        `build()` on first use.  `build=None` probes without compiling."""
+        with self._lock:
+            ent = self._cache.get(key)
+        if ent is not None:
+            telemetry.inc("%s.hits" % self._name)
+            return ent
+        if build is None:
+            return None
+        ent = build()
+        with self._lock:
+            winner = self._cache.setdefault(key, ent)
+        # two threads can race build() for the same key; only the insert
+        # that won counts as a compile, so `<name>.compiles` stays exactly
+        # the number of cached executables (the zero-recompile gates
+        # compare against it)
+        telemetry.inc("%s.compiles" % self._name
+                      if winner is ent else "%s.hits" % self._name)
+        return winner
+
+    def keys(self):
+        with self._lock:
+            return list(self._cache)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._cache)
 
 
 def _as_list(arrays, names, what, allow_missing=False):

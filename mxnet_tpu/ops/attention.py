@@ -116,6 +116,107 @@ class DotProductAttention(OpDef):
 register(DotProductAttention, aliases=("Attention",))
 
 
+def decode_attention(q, k_cache, v_cache, pos, num_heads, *, scale=None):
+    """Single-token attention over a per-sequence K/V cache (serving decode
+    step).
+
+    The autoregressive counterpart of `flash_attention`: at decode time the
+    query is ONE token per sequence and K/V live in a pre-filled cache, so
+    recomputing the (S x S) score matrix per generated token — what running
+    the full-sequence kernel every step would do — is O(S^2) work for O(S)
+    new information.  This reads the cache once: O(S) per token.
+
+    q:        (batch, embed)        — current-token query projections
+    k_cache:  (batch, S_max, embed) — keys,   rows 0..pos[b] valid
+    v_cache:  (batch, S_max, embed) — values, rows 0..pos[b] valid
+    pos:      (batch,) int          — each row's current position; the
+              row's own K/V must already be written at ``pos[b]`` (the
+              query attends to itself and the past, matching the training
+              kernels' causal mask at that position)
+    Returns (batch, embed).
+
+    Continuous batching gives every row its OWN position, so the validity
+    mask is per-row (`j <= pos[b]`), not a shared triangle.  jnp body only:
+    one (b, h, S) score row per token is a gather + two small matmuls —
+    XLA fuses it fine, and serving decode is HBM-bound on the cache read
+    (a dedicated Pallas kernel would buy little; the prefill side is where
+    the flash kernels earn their keep).  f32 softmax statistics regardless
+    of cache dtype, like the training kernels.
+    """
+    b, s, e = k_cache.shape
+    if e % num_heads != 0:
+        raise MXNetError(
+            "decode_attention: embed %d not divisible by num_heads %d"
+            % (e, num_heads))
+    hd = e // num_heads
+    if scale is None:
+        scale = 1.0 / float(hd) ** 0.5
+    qh = q.reshape(b, num_heads, hd)
+    kh = k_cache.reshape(b, s, num_heads, hd)
+    vh = v_cache.reshape(b, s, num_heads, hd)
+    # scores (b, h, s) in f32: one row of the attention matrix per head
+    scores = jnp.einsum(
+        "bhd,bshd->bhs", qh.astype(jnp.float32), kh.astype(jnp.float32),
+        preferred_element_type=jnp.float32) * scale
+    valid = (jnp.arange(s, dtype=jnp.int32)[None, :]
+             <= pos.astype(jnp.int32)[:, None])  # (b, s)
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p, vh.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, e).astype(q.dtype)
+
+
+class DecodeAttention(OpDef):
+    """Symbol-level wrapper of `decode_attention` so KV-cache decode graphs
+    can be expressed with the op registry (query (batch, embed), caches
+    (batch, S_max, embed), pos (batch,))."""
+
+    name = "DecodeAttention"
+    params = {
+        "num_heads": Param(int, required=True),
+        "scale": Param(float, default=None),
+    }
+
+    def list_arguments(self, params):
+        return ["query", "key_cache", "value_cache", "pos"]
+
+    def infer_shape(self, params, in_shapes):
+        q, kc, vc, pos = in_shapes
+        if kc is None and vc is not None:
+            kc = vc
+        if vc is None and kc is not None:
+            vc = kc
+        for name, shp, rank in (("query", q, 2), ("key_cache", kc, 3),
+                                ("value_cache", vc, 3), ("pos", pos, 1)):
+            if shp is not None and len(shp) != rank:
+                raise MXNetError(
+                    "DecodeAttention: %s must be rank %d, got %s"
+                    % (name, rank, shp))
+        if kc is not None and vc is not None and kc != vc:
+            raise MXNetError(
+                "DecodeAttention: key_cache %s and value_cache %s must "
+                "match" % (kc, vc))
+        if q is not None and kc is not None and (
+                q[0] != kc[0] or q[-1] != kc[-1]):
+            raise MXNetError(
+                "DecodeAttention: query %s and key_cache %s must agree on "
+                "(batch, embed)" % (q, kc))
+        out = tuple(q) if q is not None else None
+        if q is not None and pos is None:
+            pos = (q[0],)
+        return [q, kc, vc, pos], [out], []
+
+    def apply(self, octx, params, inputs, aux):
+        q, kc, vc, pos = inputs
+        out = decode_attention(q, kc, vc, pos.astype(jnp.int32),
+                               params["num_heads"], scale=params["scale"])
+        return [out], []
+
+
+register(DecodeAttention)
+
+
 class LayerNorm(OpDef):
     """Layer normalization over the last axis (transformer-era counterpart
     of `src/operator/batch_norm-inl.h`; no running stats, so it is SPMD- and

@@ -34,10 +34,14 @@ class Predictor:
     """AOT-compiled inference session (`MXPredCreate` analogue)."""
 
     def __init__(self, symbol, params, input_shapes, ctx=None,
-                 output_index=None, dtype=np.float32):
+                 output_index=None, dtype=np.float32, input_types=None):
         """symbol: Symbol | json str | path to -symbol.json.
         params: dict name->array | path to .params file (arg:/aux: keys).
-        input_shapes: dict name -> shape for all non-parameter inputs."""
+        input_shapes: dict name -> shape for all non-parameter inputs.
+        input_types: optional dict name -> dtype overriding `dtype` for
+        individual inputs (token-id inputs to an Embedding LM want int32
+        placeholders — an f32 id above 2**24 silently rounds to the wrong
+        row)."""
         if isinstance(symbol, str):
             if symbol.lstrip().startswith("{"):
                 symbol = _sym_loads(symbol)
@@ -52,6 +56,8 @@ class Predictor:
         self.ctx = ctx if ctx is not None else cpu()
         self._device = self.ctx.jax_device()
         self._dtype = dtype
+        self._input_types = {n: np.dtype(t)
+                             for n, t in (input_types or {}).items()}
 
         if isinstance(params, str):
             loaded = nd.load(params)
@@ -72,6 +78,16 @@ class Predictor:
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         self._input_names = [n for n in arg_names if n not in arg_params]
+
+        unknown_types = [n for n in self._input_types
+                         if n not in self._input_names]
+        if unknown_types:
+            # a typo'd key would otherwise leave its placeholder at the
+            # default dtype — the silent-corruption mode input_types exists
+            # to prevent
+            raise MXNetError(
+                "Predictor: input_types names %s which are not inputs "
+                "(inputs: %s)" % (unknown_types, self._input_names))
 
         known = {n: tuple(s) for n, s in input_shapes.items()
                  if n in self._input_names}
@@ -105,8 +121,9 @@ class Predictor:
             else:
                 # placeholder until set_input; committed to ctx's device so
                 # the AOT compile below and every forward stay on ctx
-                self._arg_arrays.append(
-                    jax.device_put(jnp.zeros(s, dtype), self._device))
+                self._arg_arrays.append(jax.device_put(
+                    jnp.zeros(s, self._input_types.get(n, dtype)),
+                    self._device))
         self._aux_arrays = []
         for n, s in zip(aux_names, aux_shapes):
             if n not in aux_params:
@@ -128,6 +145,7 @@ class Predictor:
             self._arg_arrays, self._aux_arrays).compile()
         self._graph_fn = graph_fn
         self._outputs = None
+        self._partial_cache = {}  # num_nodes -> (heads Symbol, graph_fn)
 
     # -- MXPred* surface --------------------------------------------------
     def set_input(self, name, array):
@@ -143,8 +161,11 @@ class Predictor:
             raise MXNetError(
                 "Predictor: input %s has shape %s, expected %s"
                 % (name, a.shape, tuple(expected)))
+        # the PLACEHOLDER's dtype is the contract the compiled executable
+        # was lowered against — forcing self._dtype here used to cast
+        # int32 token ids to f32, corrupting Embedding rows past 2**24
         self._arg_arrays[i] = jax.device_put(
-            a.astype(self._dtype, copy=False), self._device)
+            a.astype(self._arg_arrays[i].dtype, copy=False), self._device)
         self._outputs = None
 
     def forward(self, **inputs):
@@ -176,8 +197,15 @@ class Predictor:
         num_nodes = min(num_nodes, len(order))
         if num_nodes <= 0:
             return []
-        heads = Symbol([(n, 0) for n in order[:num_nodes]])
-        graph_fn, _, _, _ = _build_graph_fn(heads)
+        # the sub-graph plan is cached per prefix length: rebuilding it on
+        # every call made stepping a debugger through n nodes O(n^2)
+        cached = self._partial_cache.get(num_nodes)
+        if cached is None:
+            heads = Symbol([(n, 0) for n in order[:num_nodes]])
+            graph_fn, _, _, _ = _build_graph_fn(heads)
+            cached = (heads, graph_fn)
+            self._partial_cache[num_nodes] = cached
+        heads, graph_fn = cached
         # the sub-symbol's own argument/aux ordering indexes into ours
         aux_index = {n: i for i, n in
                      enumerate(self.symbol.list_auxiliary_states())}
@@ -210,7 +238,8 @@ class Predictor:
 
         input_avals = tuple(
             jax.ShapeDtypeStruct(
-                self._arg_arrays[self._arg_index[n]].shape, self._dtype)
+                self._arg_arrays[self._arg_index[n]].shape,
+                self._arg_arrays[self._arg_index[n]].dtype)
             for n in self._input_names)
         params_avals = (
             tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
@@ -226,10 +255,13 @@ class Predictor:
         # matching the reference's inert JSON+binary deploy format.
         meta = {
             "format": "mxnet_tpu_predictor",
-            "version": 1,
+            "version": 2,
             "input_names": self._input_names,
             "input_shapes": {
                 n: list(self._arg_arrays[self._arg_index[n]].shape)
+                for n in self._input_names},
+            "input_dtypes": {
+                n: np.dtype(self._arg_arrays[self._arg_index[n]].dtype).name
                 for n in self._input_names},
             "dtype": np.dtype(self._dtype).name,
             "out_shapes": [list(s) for s in self._out_shapes],
@@ -256,6 +288,11 @@ class ExportedPredictor:
     def __init__(self, path, ctx=None):
         from jax import export as jax_export
 
+        # ctx was accepted-and-ignored before: params stayed on the
+        # default device, so "load onto tpu(0)" silently served from CPU.
+        # Place the deserialized params like Predictor places its binds.
+        self.ctx = ctx if ctx is not None else cpu()
+        self._device = self.ctx.jax_device()
         with np.load(path, allow_pickle=False) as payload:
             meta = json.loads(bytes(payload["meta_json"]).decode("utf-8"))
             if meta.get("format") != "mxnet_tpu_predictor":
@@ -264,14 +301,19 @@ class ExportedPredictor:
                     % path)
             self._fn = jax_export.deserialize(
                 bytearray(payload["stablehlo"].tobytes()))
-            args = tuple(jnp.asarray(payload["arg_%d" % i])
+            args = tuple(jax.device_put(payload["arg_%d" % i], self._device)
                          for i in range(meta["n_args"]))
-            aux = tuple(jnp.asarray(payload["aux_%d" % i])
+            aux = tuple(jax.device_put(payload["aux_%d" % i], self._device)
                         for i in range(meta["n_aux"]))
         self._input_names = meta["input_names"]
         self._input_shapes = {n: tuple(s)
                               for n, s in meta["input_shapes"].items()}
         self._dtype = np.dtype(meta["dtype"])
+        # version-1 artifacts predate per-input dtypes: every input was
+        # exported at the predictor dtype, so falling back to it is exact
+        self._input_dtypes = {
+            n: np.dtype(meta.get("input_dtypes", {}).get(n, self._dtype))
+            for n in self._input_names}
         self._out_shapes = [tuple(s) for s in meta["out_shapes"]]
         self._params = (args, aux)
         self._outputs = None
@@ -289,7 +331,7 @@ class ExportedPredictor:
                 % (name, a.shape, self._input_shapes[name]))
         if not hasattr(self, "_staged"):
             self._staged = {}
-        self._staged[name] = a.astype(self._dtype, copy=False)
+        self._staged[name] = a.astype(self._input_dtypes[name], copy=False)
         self._outputs = None
 
     def forward(self, **inputs):
@@ -304,10 +346,15 @@ class ExportedPredictor:
         staged = dict(getattr(self, "_staged", {}))
         staged.update(inputs)
         vals = tuple(
-            jnp.asarray(
-                getattr(staged[n], "asnumpy", lambda n=n: staged[n])())
+            jax.device_put(
+                np.asarray(
+                    getattr(staged[n], "asnumpy", lambda n=n: staged[n])(),
+                    self._input_dtypes[n]),
+                self._device)
             if n in staged
-            else jnp.zeros(self._input_shapes[n], self._dtype)
+            else jax.device_put(
+                jnp.zeros(self._input_shapes[n], self._input_dtypes[n]),
+                self._device)
             for n in self._input_names)
         self._outputs = self._fn.call(vals, self._params)
         return self
@@ -362,20 +409,25 @@ def _create_for_c_api(symbol_json, param_bytes, input_names, input_shapes,
 
 
 def _set_input_from_buffer(pred, key, buf):
-    """MXPredSetInput body: raw little-endian f32 bytes.  Works for both
-    Predictor and ExportedPredictor handles."""
+    """MXPredSetInput body: raw little-endian bytes in the input's dtype
+    (the reference ABI is f32-only; int-placeholder inputs — LM token ids
+    — read their buffers as the placeholder dtype instead of reinterpreting
+    the bits as floats).  Works for both Predictor and ExportedPredictor
+    handles."""
     if key not in pred._input_names:
         raise MXNetError(
             "%r is not an input (inputs: %s)" % (key, pred._input_names))
     if hasattr(pred, "_arg_index"):
-        shape = tuple(pred._arg_arrays[pred._arg_index[key]].shape)
+        arr_like = pred._arg_arrays[pred._arg_index[key]]
+        shape, dt = tuple(arr_like.shape), np.dtype(arr_like.dtype)
     else:
         shape = pred._input_shapes[key]
-    arr = np.frombuffer(buf, np.float32)
+        dt = pred._input_dtypes[key]
+    arr = np.frombuffer(buf, dt)
     if arr.size != int(np.prod(shape)):
         raise MXNetError(
-            "input %s: got %d floats, expected %d (shape %s)"
-            % (key, arr.size, int(np.prod(shape)), shape))
+            "input %s: got %d %s elements, expected %d (shape %s)"
+            % (key, arr.size, dt.name, int(np.prod(shape)), shape))
     pred.set_input(key, arr.reshape(shape))
 
 

@@ -1,0 +1,24 @@
+"""Continuous-batching TPU serving engine.
+
+The `Predictor` (predictor.py) is the faithful `MXPredCreate` analogue:
+one AOT-compiled launch per request, one shape, host-blocking.  This
+package is the production path on top of it (ROADMAP item 1):
+
+* `decode.TransformerKVModel` — prefill + single-token KV-cache decode
+  functions for `models/transformer.py` graphs (same parameter names, so
+  training checkpoints serve directly).
+* `engine.ServingEngine` — request queue + iteration-level continuous
+  batcher (Orca, OSDI '22): sequences admit/retire at step granularity,
+  padded and bucketed onto a small fixed set of pre-AOT-compiled
+  (batch, seq) shapes so steady state has zero recompiles (asserted via
+  the telemetry retrace watchdog).
+* `engine.ReplicaRouter` — least-depth dispatch over per-device engine
+  replicas (the mesh scale-out path).
+
+See docs/serving.md.
+"""
+from .decode import TransformerKVModel
+from .engine import ServeRequest, ServingEngine, ReplicaRouter
+
+__all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
+           "ReplicaRouter"]

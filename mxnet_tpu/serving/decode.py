@@ -1,0 +1,232 @@
+"""KV-cache prefill/decode functions for `models/transformer.py` graphs.
+
+The training/Predictor path runs the full-sequence graph: every forward
+recomputes attention over all S positions.  Autoregressive serving wants
+two different programs:
+
+* **prefill** — one pass over the (padded) prompt that produces the
+  per-layer K/V projections *as outputs* so they can be written into a
+  persistent cache, plus the logits of the LAST real token (the first
+  sampling decision).  Attention itself runs through the same
+  `flash_attention` kernels as training, so prefill numerics match the
+  full-sequence forward exactly.
+* **decode** — one token per sequence per step: reads the K/V cache via
+  `ops.attention.decode_attention` (O(S) per token instead of the full
+  graph's O(S^2)) and scatter-writes the new K/V row in place.
+
+Both are pure functions over a `{name: array}` parameter dict using the
+SAME names `get_transformer_lm` mints (embed_weight, pos_embed_weight,
+layer<i>_{q,k,v,attn_out,ffn1,ffn2}_weight/_bias, layer<i>_ln{1,2}_gamma/
+_beta, final_ln_gamma/_beta, pred_weight/_bias), so a FeedForward
+checkpoint serves without conversion and the parity test
+(tests/test_serving.py) can bind one set of weights to both programs.
+
+Cache layout: ONE array of shape (num_layers, 2, n_slots, S_max, embed)
+(2 = K then V).  Keeping every layer in a single buffer lets the engine
+donate it through each prefill/decode call (in-place update, no per-step
+reallocation) and makes admit/retire a pure slot-index bookkeeping
+operation — no data moves when a sequence enters or leaves the batch.
+Sequences occupy a slot; per-row positions make the batch ragged-free:
+row b attends to cache[..., b, 0:pos[b]+1, :].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops.attention import decode_attention
+from ..ops.pallas_kernels.flash_attention import flash_attention
+from ..ops.pallas_kernels.layer_norm import layer_norm
+
+
+class TransformerKVModel:
+    """Prefill/decode program builder for one transformer-LM geometry.
+
+    Mirrors `get_transformer_lm(vocab_size, seq_len, num_layers, num_heads,
+    num_embed, num_ffn_hidden, use_bias)` — `seq_len` is the maximum
+    context (cache depth S_max).  `attn_layout` does not appear: the
+    parameter set is identical for 'bsd'/'bhsd' (only internal reshapes
+    differ), so checkpoints from either layout serve here.
+    """
+
+    def __init__(self, vocab_size, seq_len, num_layers=2, num_heads=4,
+                 num_embed=128, num_ffn_hidden=None, use_bias=True,
+                 eps=1e-5, dtype=np.float32):
+        if num_embed % num_heads != 0:
+            raise MXNetError("num_embed must be divisible by num_heads")
+        self.vocab_size = int(vocab_size)
+        self.seq_len = int(seq_len)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.num_embed = int(num_embed)
+        self.num_ffn_hidden = int(num_ffn_hidden or 4 * num_embed)
+        self.use_bias = bool(use_bias)
+        self.eps = float(eps)
+        self.dtype = np.dtype(dtype)
+
+    # -- parameters --------------------------------------------------------
+    def param_shapes(self):
+        """{name: shape} for every weight the programs read — the subset
+        of `get_transformer_lm(...).list_arguments()` that is a parameter
+        (everything but data/softmax_label)."""
+        e, f, v = self.num_embed, self.num_ffn_hidden, self.vocab_size
+        shapes = {
+            "embed_weight": (v, e),
+            "pos_embed_weight": (1, self.seq_len, e),
+            "final_ln_gamma": (e,),
+            "final_ln_beta": (e,),
+            "pred_weight": (v, e),
+        }
+        if self.use_bias:
+            shapes["pred_bias"] = (v,)
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            shapes[p + "ln1_gamma"] = (e,)
+            shapes[p + "ln1_beta"] = (e,)
+            shapes[p + "ln2_gamma"] = (e,)
+            shapes[p + "ln2_beta"] = (e,)
+            for proj, (nh, nin) in (("q", (e, e)), ("k", (e, e)),
+                                    ("v", (e, e)), ("attn_out", (e, e)),
+                                    ("ffn1", (f, e)), ("ffn2", (e, f))):
+                shapes[p + proj + "_weight"] = (nh, nin)
+                if self.use_bias:
+                    shapes[p + proj + "_bias"] = (nh,)
+        return shapes
+
+    def init_params(self, rng=None, scale=0.02):
+        """Random parameter dict (bench/tests; real deployments load a
+        checkpoint)."""
+        rng = rng or np.random.RandomState(0)
+        params = {}
+        for name, shape in self.param_shapes().items():
+            if name.endswith("_gamma"):
+                params[name] = np.ones(shape, self.dtype)
+            elif name.endswith(("_beta", "_bias")):
+                params[name] = np.zeros(shape, self.dtype)
+            else:
+                params[name] = (rng.randn(*shape) * scale).astype(self.dtype)
+        return params
+
+    def check_params(self, params):
+        missing = [n for n in self.param_shapes() if n not in params]
+        if missing:
+            raise MXNetError(
+                "TransformerKVModel: params missing %s" % missing)
+
+    def init_cache(self, n_slots):
+        """Zeroed K/V cache: (num_layers, 2, n_slots, S_max, embed)."""
+        return jnp.zeros((self.num_layers, 2, int(n_slots), self.seq_len,
+                          self.num_embed), self.dtype)
+
+    # -- shared pieces -----------------------------------------------------
+    def _proj(self, params, x, name):
+        y = jnp.dot(x, params[name + "_weight"].T,
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.use_bias:
+            y = y + params[name + "_bias"]
+        return y
+
+    def _head(self, params, x):
+        return self._proj(params, layer_norm(
+            x, params["final_ln_gamma"], params["final_ln_beta"], self.eps),
+            "pred")
+
+    # -- prefill -----------------------------------------------------------
+    def prefill(self, params, tokens, length):
+        """Forward the (right-padded) prompt, returning the cache fill.
+
+        tokens: (b, s) int32, rows padded past ``length`` with any id.
+        length: (b,) int32 — number of real tokens per row (>= 1).
+        Returns (logits, kv):
+          logits (b, vocab) — logits of each row's LAST real token
+          kv (num_layers, 2, b, s, embed) — per-layer K/V projections for
+          cache rows 0..s (entries past ``length`` are don't-cares: decode
+          overwrites position ``length`` first and only ever attends
+          <= its own position).
+
+        The head matmul runs on ONE row per sequence, not all s positions
+        — at serving shapes the (vocab, embed) head is the largest matmul
+        in the graph and the prompt's other s-1 logit rows are never
+        sampled from.
+        """
+        b, s = tokens.shape
+        h, e = self.num_heads, self.num_embed
+        x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
+                     axis=0)
+        x = x + params["pos_embed_weight"][0, :s]
+        kv = []
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            hn = layer_norm(x, params[p + "ln1_gamma"],
+                            params[p + "ln1_beta"], self.eps)
+            hf = hn.reshape(-1, e)
+            q = self._proj(params, hf, p + "q").reshape(b, s, e)
+            k = self._proj(params, hf, p + "k").reshape(b, s, e)
+            v = self._proj(params, hf, p + "v").reshape(b, s, e)
+            kv.append(jnp.stack([k, v]))
+            # (b, s, e) -> (b, h, s, hd): the training kernels' layout
+            def heads(t):
+                return t.reshape(b, s, h, e // h).transpose(0, 2, 1, 3)
+            attn = flash_attention(heads(q), heads(k), heads(v), causal=True)
+            attn = attn.transpose(0, 2, 1, 3).reshape(-1, e)
+            x = x + self._proj(params, attn, p + "attn_out").reshape(b, s, e)
+            hn = layer_norm(x, params[p + "ln2_gamma"],
+                            params[p + "ln2_beta"], self.eps)
+            f = jax.nn.gelu(self._proj(params, hn.reshape(-1, e), p + "ffn1"))
+            x = x + self._proj(params, f, p + "ffn2").reshape(b, s, e)
+        last = jnp.take_along_axis(
+            x, (length.astype(jnp.int32) - 1)[:, None, None], axis=1
+        )[:, 0, :]  # (b, e)
+        return self._head(params, last), jnp.stack(kv)
+
+    # -- decode ------------------------------------------------------------
+    def decode(self, params, cache, token, pos, slots):
+        """One generation step for a bucket of sequences.
+
+        cache: (num_layers, 2, n_slots, S_max, embed) — donated by the
+               engine's compiled program; updated in place.
+        token: (b,) int32 — each row's current token (the one sampled last
+               step, or the prompt's last token right after prefill).
+        pos:   (b,) int32 — the position ``token`` occupies.
+        slots: (b,) int32 — which cache slot each row owns.  Padding rows
+               point at the engine's trash slot.
+        Returns (logits (b, vocab), new_cache).
+        """
+        e = self.num_embed
+        pos = pos.astype(jnp.int32)
+        slots = slots.astype(jnp.int32)
+        x = jnp.take(params["embed_weight"], token.astype(jnp.int32), axis=0)
+        x = x + jnp.take(params["pos_embed_weight"][0], pos, axis=0)
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            hn = layer_norm(x, params[p + "ln1_gamma"],
+                            params[p + "ln1_beta"], self.eps)
+            q = self._proj(params, hn, p + "q")
+            k = self._proj(params, hn, p + "k")
+            v = self._proj(params, hn, p + "v")
+            # scatter this step's K/V rows, then gather the bucket's slots.
+            # Duplicate indices only occur among padding rows (shared trash
+            # slot), whose values are never attended.
+            cache = cache.at[i, 0, slots, pos].set(k.astype(cache.dtype))
+            cache = cache.at[i, 1, slots, pos].set(v.astype(cache.dtype))
+            kc = cache[i, 0, slots]  # (b, S_max, e)
+            vc = cache[i, 1, slots]
+            attn = decode_attention(q, kc, vc, pos, self.num_heads)
+            x = x + self._proj(params, attn, p + "attn_out")
+            hn = layer_norm(x, params[p + "ln2_gamma"],
+                            params[p + "ln2_beta"], self.eps)
+            f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
+            x = x + self._proj(params, f, p + "ffn2")
+        return self._head(params, x), cache
+
+    def write_prefill(self, cache, kv, length, slots):
+        """Scatter a prefill's (num_layers, 2, b, s, embed) K/V block into
+        the cache at ``slots`` (rows 0..s-1; s <= S_max).  ``length`` is
+        unused for masking (decode never attends past its own position)
+        but kept in the signature so a future packed layout can trim."""
+        s = kv.shape[3]
+        return cache.at[:, :, slots.astype(jnp.int32), :s].set(
+            kv.astype(cache.dtype))

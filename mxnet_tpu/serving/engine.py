@@ -1,0 +1,618 @@
+"""Continuous-batching serving engine + multi-replica router.
+
+Iteration-level scheduling (Orca, OSDI '22): the unit of work is ONE
+decode step over whichever sequences are active, not one request.  A
+request joins the running batch the step after its prefill and leaves the
+step it finishes — no head-of-line blocking on the longest generation in
+a batch, which is where request-level batching loses its throughput.
+
+Zero steady-state recompiles: every program the engine launches is
+AOT-compiled at `warmup()` for a small FIXED set of shapes —
+
+* prefill buckets: (1, s) for s in ``MXNET_SERVE_PREFILL_BUCKETS``
+  (prompts right-pad up to the smallest bucket that fits), and
+* decode buckets: (b, 1) for b in ``MXNET_SERVE_BUCKETS`` (the active
+  set pads up to the smallest bucket with rows pointed at a trash slot).
+
+Executables live in an `executor.AotCache` (`serve.aot.hits/compiles`
+counters) and every launch feeds the PR-2 retrace watchdog
+(`telemetry.watch_jit`, sites ``serving.prefill``/``serving.decode``), so
+"no recompiles after warmup" is an asserted property
+(tests/test_serving.py), not a hope.
+
+The K/V cache is one (L, 2, max_batch+1, S_max, E) buffer DONATED through
+each compiled call — decode updates it in place; slot ``max_batch`` is
+the trash slot padding rows write into.  Sampling (greedy argmax) runs
+inside the compiled step, so the only per-step host traffic is the bucket
+of sampled token ids the scheduler needs for EOS/retire decisions.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..base import MXNetError
+from ..context import Context
+from ..executor import AotCache
+
+
+class _EngineFatal(Exception):
+    """A failure of a compiled call that DONATED the K/V cache: the buffer
+    may already be invalidated, so the scheduler cannot carry on — step()
+    must not swallow this as a per-request poison error."""
+
+
+def _env_buckets(name, default):
+    raw = os.environ.get(name, "")
+    if not raw:
+        return list(default)
+    try:
+        vals = sorted({int(x) for x in raw.replace(" ", "").split(",") if x})
+    except ValueError:
+        raise MXNetError("%s must be a comma-separated int list, got %r"
+                         % (name, raw))
+    if not vals or vals[0] < 1:
+        raise MXNetError("%s needs positive bucket sizes, got %r"
+                         % (name, raw))
+    return vals
+
+
+class ServeRequest:
+    """One generation request: prompt in, tokens out, latency stamps."""
+
+    _ids = [0]
+    _ids_lock = threading.Lock()
+
+    def __init__(self, prompt, max_new_tokens, eos_id=None):
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise MXNetError("ServeRequest: empty prompt")
+        with self._ids_lock:
+            self._ids[0] += 1
+            self.id = self._ids[0]
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.tokens = []          # generated ids (includes eos if hit)
+        self.error = None
+        self.t_submit = time.perf_counter()
+        self.t_first = None       # first token sampled (end of prefill)
+        self.t_done = None
+        self._done = threading.Event()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block until finished; returns the generated token list."""
+        if not self._done.wait(timeout):
+            raise MXNetError("ServeRequest %d: timed out" % self.id)
+        if self.error is not None:
+            raise MXNetError("ServeRequest %d: %s" % (self.id, self.error))
+        return list(self.tokens)
+
+    # latency views (ms), None until the corresponding stamp exists
+    @property
+    def ttft_ms(self):
+        return None if self.t_first is None else \
+            1e3 * (self.t_first - self.t_submit)
+
+    @property
+    def latency_ms(self):
+        return None if self.t_done is None else \
+            1e3 * (self.t_done - self.t_submit)
+
+    def _finish(self, error=None):
+        self.error = error
+        self.t_done = time.perf_counter()
+        self._done.set()
+
+
+class _Seq:
+    """Scheduler state of one active sequence: `last` is the token that
+    will be fed (and cached) at position `pos` on the next decode step."""
+
+    __slots__ = ("req", "last", "pos", "n_new")
+
+    def __init__(self, req, last, pos):
+        self.req = req
+        self.last = last
+        self.pos = pos
+        self.n_new = 1  # the prefill already sampled token #1
+
+
+class ServingEngine:
+    """Single-replica continuous batcher over one device.
+
+    model:  `TransformerKVModel` (the program builder).
+    params: {name: array} transformer weights (device_put onto `ctx`).
+    ctx:    Context or jax device; default = first device.
+    """
+
+    def __init__(self, model, params, ctx=None, max_batch=None,
+                 decode_buckets=None, prefill_buckets=None,
+                 max_new_tokens=None, eos_id=None, name="replica0"):
+        model.check_params(params)
+        self.model = model
+        self.name = name
+        if ctx is None:
+            self._device = jax.devices()[0]
+        elif isinstance(ctx, Context):
+            self._device = ctx.jax_device()
+        else:
+            self._device = ctx
+        self.max_batch = int(os.environ.get("MXNET_SERVE_MAX_BATCH", "8")
+                             if max_batch is None else max_batch)
+        if self.max_batch < 1:
+            raise MXNetError("ServingEngine: max_batch must be >= 1")
+        # sorted + deduped regardless of source: submit() reads [-1] as the
+        # largest bucket and _bucket_for first-fit-scans ascending.
+        # Out-of-range values raise (a silently dropped bucket would make
+        # occupancy/latency quietly differ from the configured intent).
+        decode_src = decode_buckets or _env_buckets(
+            "MXNET_SERVE_BUCKETS", _default_decode_buckets(self.max_batch))
+        bad = sorted({int(b) for b in decode_src if b > self.max_batch})
+        if bad:
+            raise MXNetError(
+                "ServingEngine: decode buckets %s exceed max_batch %d"
+                % (bad, self.max_batch))
+        self.decode_buckets = sorted({int(b) for b in decode_src}
+                                     | {self.max_batch})
+        prefill_src = prefill_buckets or _env_buckets(
+            "MXNET_SERVE_PREFILL_BUCKETS",
+            _default_prefill_buckets(model.seq_len))
+        bad = sorted({int(s) for s in prefill_src if s > model.seq_len})
+        if bad:
+            raise MXNetError(
+                "ServingEngine: prefill buckets %s exceed seq_len %d"
+                % (bad, model.seq_len))
+        self.prefill_buckets = sorted({int(s) for s in prefill_src})
+        self.max_new_default = int(
+            os.environ.get("MXNET_SERVE_MAX_NEW", "32")
+            if max_new_tokens is None else max_new_tokens)
+        if self.max_new_default < 1:
+            raise MXNetError("ServingEngine: max_new_tokens must be >= 1")
+        self.eos_id = eos_id
+
+        self._params = {k: jax.device_put(np.asarray(v), self._device)
+                        for k, v in params.items()}
+        # slot max_batch is the trash slot padding rows write into
+        self._cache = jax.device_put(
+            np.zeros((model.num_layers, 2, self.max_batch + 1,
+                      model.seq_len, model.num_embed), model.dtype),
+            self._device)
+        self._aot = AotCache("serve.aot")
+        # gauges are namespaced per replica: engines share one process-wide
+        # registry, and a global "serve.queue_depth" written by N scheduler
+        # threads records whichever replica wrote last — neither any single
+        # replica nor the aggregate
+        self._gauge = "serve.%s." % self.name
+        self._queue = deque()
+        self._qlock = threading.Lock()
+        self._active = {}         # slot -> _Seq (insertion-ordered)
+        self._free = list(range(self.max_batch))
+        self._stopped = threading.Event()
+        self._wake = threading.Event()  # set by submit(): work arrived
+        self._thread = None
+        self._dead = None         # scheduler-fatal error message, if any
+        # bench accounting (host-side, touched only by the scheduler)
+        self.stats = {"decode_steps": 0, "decode_rows": 0,
+                      "decode_padded": 0, "prefills": 0, "completed": 0,
+                      "tokens": 0}
+
+    # -- program building --------------------------------------------------
+    def _compiled_prefill(self, s_bucket):
+        def build():
+            def prog(params, cache, tokens, length, slot):
+                logits, kv = self.model.prefill(params, tokens, length)
+                cache = self.model.write_prefill(cache, kv, length, slot)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            fn = jax.jit(prog, donate_argnums=(1,))
+            toks = self._put(np.zeros((1, s_bucket), np.int32))
+            one = self._put(np.ones((1,), np.int32))
+            return fn.lower(self._params, self._cache, toks, one,
+                            one).compile()
+
+        return self._aot.get(("prefill", 1, s_bucket), build)
+
+    def _compiled_decode(self, b_bucket):
+        def build():
+            def prog(params, cache, token, pos, slots):
+                logits, cache = self.model.decode(params, cache, token,
+                                                  pos, slots)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            fn = jax.jit(prog, donate_argnums=(1,))
+            z = self._put(np.zeros((b_bucket,), np.int32))
+            return fn.lower(self._params, self._cache, z, z, z).compile()
+
+        return self._aot.get(("decode", b_bucket, 1), build)
+
+    def _put(self, a):
+        return jax.device_put(a, self._device)
+
+    def warmup(self):
+        """AOT-compile every bucket shape up front, and pre-seed the
+        retrace watchdog with each bucket's call signature (the watchdog
+        counts every post-warmup NEW signature as a recompile — the whole
+        bucket set is warmup here, so only a shape that ESCAPED the
+        bucketing fires an event).  After warmup, `serve.aot.compiles`
+        advancing or a `serving.*` retrace event means exactly that bug."""
+        for s in self.prefill_buckets:
+            self._compiled_prefill(s)
+            toks = np.zeros((1, s), np.int32)
+            one = np.ones((1,), np.int32)
+            self._watch("prefill", (toks, one, one),
+                        ("tokens", "length", "slot"), s, seed=True)
+        for b in self.decode_buckets:
+            self._compiled_decode(b)
+            z = np.zeros((b,), np.int32)
+            self._watch("decode", (z, z, z), ("token", "pos", "slots"), b,
+                        seed=True)
+        return {"prefill": list(self.prefill_buckets),
+                "decode": list(self.decode_buckets)}
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_id=None):
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_default
+        elif int(max_new_tokens) < 1:
+            # every request samples at least its first token at prefill;
+            # reject rather than silently substituting the default
+            raise MXNetError("ServingEngine: max_new_tokens must be >= 1, "
+                             "got %s" % max_new_tokens)
+        req = ServeRequest(prompt, max_new_tokens,
+                           self.eos_id if eos_id is None else eos_id)
+        if len(req.prompt) > self.prefill_buckets[-1]:
+            raise MXNetError(
+                "ServingEngine: prompt length %d exceeds the largest "
+                "prefill bucket %d" % (len(req.prompt),
+                                       self.prefill_buckets[-1]))
+        if len(req.prompt) >= self.model.seq_len:
+            raise MXNetError(
+                "ServingEngine: prompt length %d leaves no room to "
+                "generate (seq_len %d)" % (len(req.prompt),
+                                           self.model.seq_len))
+        # dead-check and append under the SAME lock _fail_all drains under,
+        # so a request can never slip in after the failure drain and hang
+        with self._qlock:
+            if self._dead is not None:
+                raise MXNetError("ServingEngine %s: scheduler died: %s"
+                                 % (self.name, self._dead))
+            self._queue.append(req)
+            depth = len(self._queue)
+        self._wake.set()
+        telemetry.inc("serve.requests")
+        telemetry.set_gauge(self._gauge + "queue_depth", depth)
+        return req
+
+    def depth(self):
+        """Router load signal: queued + running requests."""
+        with self._qlock:
+            return len(self._queue) + len(self._active)
+
+    # -- scheduling --------------------------------------------------------
+    def _bucket_for(self, n, buckets):
+        for b in buckets:
+            if b >= n:
+                return b
+        # unreachable while submit()/__init__ enforce the bounds; raising
+        # keeps the invariant self-checking instead of silently truncating
+        raise MXNetError(
+            "ServingEngine %s: no bucket >= %d in %s" % (self.name, n,
+                                                         buckets))
+
+    def _watch(self, site, arrays, names, bucket, seed=False):
+        telemetry.watch_jit(
+            "serving.%s" % site,
+            telemetry.arrays_signature(arrays, names),
+            scope=telemetry.watch_scope(self),
+            meta={"bucket": bucket}, seed=seed)
+
+    def _admit_one(self, req):
+        slot = self._free.pop()
+        try:
+            plen = len(req.prompt)
+            s = self._bucket_for(plen, self.prefill_buckets)
+            toks = np.zeros((1, s), np.int32)
+            toks[0, :plen] = req.prompt
+            toks_d = self._put(toks)
+            length = self._put(np.array([plen], np.int32))
+            slot_d = self._put(np.array([slot], np.int32))
+            self._watch("prefill", (toks_d, length, slot_d),
+                        ("tokens", "length", "slot"), s)
+            compiled = self._compiled_prefill(s)
+        except Exception:
+            self._free.append(slot)
+            raise
+        try:
+            first, self._cache = compiled(self._params, self._cache, toks_d,
+                                          length, slot_d)
+            first = int(np.asarray(first)[0])
+        except Exception as e:
+            # the launch donated self._cache: the buffer may already be
+            # gone, so this is never a per-request poison error
+            self._free.append(slot)
+            raise _EngineFatal("prefill launch failed: %s" % e) from e
+        req.t_first = time.perf_counter()
+        req.tokens.append(first)
+        self.stats["prefills"] += 1
+        self.stats["tokens"] += 1
+        telemetry.inc("serve.prefills")
+        telemetry.inc("serve.tokens")
+        seq = _Seq(req, first, plen)
+        if self._seq_finished(seq, first):
+            self._retire(slot, seq, enter=False)
+        else:
+            self._active[slot] = seq
+
+    def _seq_finished(self, seq, token):
+        if seq.req.eos_id is not None and token == seq.req.eos_id:
+            return True
+        if seq.n_new >= seq.req.max_new_tokens:
+            return True
+        # `last` is fed (and cached) at `pos` on the next decode, so the
+        # last decodable position is seq_len - 1: the token IT samples
+        # needs no cache row because generation stops there
+        if seq.pos >= self.model.seq_len:
+            return True
+        return False
+
+    def _retire(self, slot, seq, enter=True):
+        if enter:
+            del self._active[slot]
+        self._free.append(slot)
+        seq.req._finish()
+        self.stats["completed"] += 1
+        telemetry.inc("serve.completed")
+        telemetry.observe("serve.latency_ms", seq.req.latency_ms)
+        if seq.req.ttft_ms is not None:
+            telemetry.observe("serve.ttft_ms", seq.req.ttft_ms)
+
+    def step(self):
+        """One scheduler iteration: admit while there is room, then one
+        decode step over the active set.  Returns the number of sequences
+        still active (0 = idle)."""
+        while self._free:
+            with self._qlock:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                break
+            try:
+                self._admit_one(req)
+            except _EngineFatal as e:
+                req._finish(error=str(e)[:500])
+                raise
+            except Exception as e:  # a poison request must not kill serving
+                req._finish(error=str(e)[:500])
+        with self._qlock:
+            telemetry.set_gauge(self._gauge + "queue_depth",
+                                len(self._queue))
+        n = len(self._active)
+        telemetry.set_gauge(self._gauge + "active", n)
+        if n == 0:
+            return 0
+        b = self._bucket_for(n, self.decode_buckets)
+        slots = list(self._active)
+        seqs = [self._active[s] for s in slots]
+        token = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        slot_ids = np.full((b,), self.max_batch, np.int32)  # trash slot
+        for i, (slot, seq) in enumerate(zip(slots, seqs)):
+            token[i] = seq.last
+            pos[i] = seq.pos
+            slot_ids[i] = slot
+        tok_d, pos_d, slot_d = (self._put(token), self._put(pos),
+                                self._put(slot_ids))
+        self._watch("decode", (tok_d, pos_d, slot_d),
+                    ("token", "pos", "slots"), b)
+        compiled = self._compiled_decode(b)
+        nxt, self._cache = compiled(self._params, self._cache, tok_d,
+                                    pos_d, slot_d)
+        nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
+        self.stats["decode_steps"] += 1
+        self.stats["decode_rows"] += n
+        self.stats["decode_padded"] += b - n
+        self.stats["tokens"] += n
+        telemetry.inc("serve.decode_steps")
+        telemetry.inc("serve.tokens", n)
+        telemetry.inc("serve.decode_padded", b - n)
+        telemetry.set_gauge(self._gauge + "batch_occupancy", n / float(b))
+        for i, (slot, seq) in enumerate(zip(slots, seqs)):
+            t = int(nxt[i])
+            seq.req.tokens.append(t)
+            seq.last = t
+            seq.pos += 1
+            seq.n_new += 1
+            if self._seq_finished(seq, t):
+                self._retire(slot, seq)
+        return len(self._active)
+
+    # -- worker loop -------------------------------------------------------
+    def start(self):
+        """Run the scheduler on a background thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-%s" % self.name, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                n = self.step()
+            except Exception as e:  # noqa: BLE001
+                # admission errors are handled per-request inside step();
+                # anything that escapes (a decode launch failure, a cache
+                # invalidated by a failed donating call) is scheduler-fatal
+                # — fail everyone loudly instead of stranding them in
+                # result() until their timeouts
+                telemetry.inc("serve.engine_failures")
+                self._fail_all(str(e)[:500])
+                return
+            if n == 0:
+                # idle: wait for a submit instead of spinning step() (and
+                # its gauge writes) at 1 kHz per replica.  Clear FIRST and
+                # then re-check the queue, so a submit landing in between
+                # leaves the event set and wait() returns immediately.
+                self._wake.clear()
+                with self._qlock:
+                    queued = bool(self._queue)
+                if not queued and not self._stopped.is_set():
+                    self._wake.wait(0.05)
+
+    def _fail_all(self, msg):
+        for slot, seq in list(self._active.items()):
+            del self._active[slot]
+            self._free.append(slot)
+            seq.req._finish(error=msg)
+        with self._qlock:
+            # mark dead and drain atomically: submit() checks _dead under
+            # this lock, so everything it enqueued is in `pending` and
+            # everything after it raises
+            self._dead = msg
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            req._finish(error=msg)
+
+    def stop(self):
+        self._stopped.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                # a wedged device launch: keep the ref so a later start()
+                # cannot spawn a second scheduler over the same cache and
+                # slot state, and fail loudly
+                raise MXNetError(
+                    "ServingEngine %s: scheduler thread did not stop "
+                    "within 30s (wedged launch?)" % self.name)
+            self._thread = None
+
+    def run_until_idle(self, timeout=None):
+        """Drive the scheduler synchronously (no worker thread) until the
+        queue and active set drain; returns steps taken."""
+        t0 = time.perf_counter()
+        steps = 0
+        while True:
+            with self._qlock:
+                queued = len(self._queue)
+            if self.step() == 0 and queued == 0:
+                with self._qlock:
+                    if not self._queue:
+                        return steps
+            steps += 1
+            if timeout is not None and time.perf_counter() - t0 > timeout:
+                raise MXNetError("run_until_idle: timed out after %d steps"
+                                 % steps)
+
+
+def _default_decode_buckets(max_batch):
+    """Powers of two up to max_batch (+ max_batch itself)."""
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return sorted(set(out))
+
+
+def _default_prefill_buckets(seq_len):
+    """Powers of two from 16 up to seq_len (+ seq_len itself)."""
+    out, s = [], 16
+    while s < seq_len:
+        out.append(s)
+        s *= 2
+    out.append(seq_len)
+    return sorted(set(out))
+
+
+class ReplicaRouter:
+    """Least-depth dispatch over per-device engine replicas.
+
+    Each replica owns a full parameter copy and its own queue/cache — the
+    NamedSharding-tree scale-out (SNIPPETS [3]) degenerates to replicated
+    params per device for serving, where requests are independent and the
+    win is N concurrent batches, not one sharded one.  `from_mesh` builds
+    one engine per device of a mesh (row-major over the first axis).
+    """
+
+    def __init__(self, engines):
+        if not engines:
+            raise MXNetError("ReplicaRouter: need at least one engine")
+        self.engines = list(engines)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_mesh(cls, model, params, mesh=None, n_replicas=None, **kw):
+        devices = (list(np.asarray(mesh.devices).reshape(-1))
+                   if mesh is not None else jax.devices())
+        if n_replicas is not None:
+            devices = devices[:int(n_replicas)]
+        engines = [ServingEngine(model, params, ctx=d,
+                                 name="replica%d" % i, **kw)
+                   for i, d in enumerate(devices)]
+        return cls(engines)
+
+    def warmup(self):
+        return [e.warmup() for e in self.engines]
+
+    def submit(self, prompt, **kw):
+        telemetry.set_gauge("serve.replicas", len(self.engines))
+        last_err = None
+        for _ in range(len(self.engines)):
+            with self._lock:
+                live = [e for e in self.engines if e._dead is None]
+            if not live:
+                break
+            eng = min(live, key=lambda e: e.depth())
+            try:
+                return eng.submit(prompt, **kw)
+            except MXNetError as e:
+                if eng._dead is None:
+                    raise  # a bad request, not a dead replica
+                last_err = e  # died between selection and submit: reroute
+        raise MXNetError(
+            "ReplicaRouter: no live replica among %d (%s)"
+            % (len(self.engines), last_err))
+
+    def start(self):
+        for e in self.engines:
+            e.start()
+        return self
+
+    def stop(self):
+        # stop EVERY engine before raising: aborting on the first failure
+        # would leave the remaining schedulers running (and, from a finally
+        # block, mask whatever error actually failed the run)
+        errs = []
+        for e in self.engines:
+            try:
+                e.stop()
+            except MXNetError as err:
+                errs.append(str(err))
+        if errs:
+            raise MXNetError(
+                "ReplicaRouter: %d engine(s) failed to stop: %s"
+                % (len(errs), "; ".join(errs)))
+
+    def run_until_idle(self, timeout=None):
+        """Synchronous drain of every replica (tests; bench uses start())."""
+        return [e.run_until_idle(timeout=timeout) for e in self.engines]
+
+    def depth(self):
+        return sum(e.depth() for e in self.engines)

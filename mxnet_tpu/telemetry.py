@@ -387,18 +387,28 @@ class MetricsRegistry:
         return n
 
     # -- retrace watchdog --------------------------------------------------
-    def watch_jit(self, site, sig, scope=None, meta=None):
+    def watch_jit(self, site, sig, scope=None, meta=None, seed=False):
         """Record one call of the jitted program at `site` with signature
         `sig` (see `arrays_signature`).  The first signature per
         (site, scope) is the warmup compile; every NEW signature after it
         is a jit cache miss — one retrace event fires per distinct
         signature, with a diagnosis diffing against the previous call.
-        Returns the event dict when one fired, else None."""
+        Returns the event dict when one fired, else None.
+
+        ``seed=True`` DECLARES the signature instead of observing a call:
+        it joins the seen set without firing.  Multi-shape warmups (the
+        serving engine pre-AOT-compiles a whole bucket set) seed each
+        bucket's signature so only a shape that escaped the declared set
+        ever diagnoses as a recompile."""
         meta_items = tuple(sorted((meta or {}).items()))
         full = (tuple(sig), meta_items)
         key = (site, scope)
         with self._lock:
             w = self._watches.get(key)
+            if w is not None and seed and full not in w.seen:
+                w.add(full)
+                w.last = full
+                return None
             if w is None:
                 # bounded: transient executors/optimizers (sweeps, test
                 # suites) must not accrete signature sets forever — evict
@@ -664,10 +674,11 @@ def step_end(step=None, extra=None):
     return reg.step_report(step=step, extra=extra)
 
 
-def watch_jit(site, sig, scope=None, meta=None):
+def watch_jit(site, sig, scope=None, meta=None, seed=False):
     if not retrace_enabled():
         return None
-    return registry().watch_jit(site, sig, scope=scope, meta=meta)
+    return registry().watch_jit(site, sig, scope=scope, meta=meta,
+                                seed=seed)
 
 
 def blocking_fetch(site):

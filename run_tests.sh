@@ -13,6 +13,15 @@ T1="timeout -k 10 870"
 if [ $# -eq 0 ]; then
     set -- tests/ -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly
+elif [ "$1" = "--serve-smoke" ]; then
+    # fast serving smoke: KV-cache decode parity, admit/retire scheduling,
+    # the zero-retrace bucket contract, and the 2-replica CPU-mesh
+    # dispatch (docs/serving.md) — the quick check that the continuous-
+    # batching engine still serves correctly
+    shift
+    T1=""
+    set -- tests/test_serving.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--chaos-smoke" ]; then
     # fast single-host fault-tolerance smoke: the chaos-driven recovery
     # tests (idempotent retries, snapshot/restart, nonfinite skip,

@@ -83,4 +83,29 @@ assert rec["value"] and rec["value"] >= 1.3, \
     "overlap gate failed: speedup %s < 1.3" % rec["value"]
 print("overlap gate passed: %sx" % rec["value"])
 PY
+
+# -- serving gate (docs/serving.md) ---------------------------------------
+# short Poisson-traffic run of the continuous-batching engine on the CPU
+# mesh, 2 replicas, under the retrace watchdog: every request must
+# complete and steady state must compile NOTHING after warmup (the
+# bucketed-AOT contract); artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SERVE_REQUESTS=24 SERVE_RATE=12 SERVE_REPLICAS=2 SERVE_SEQ=64 \
+    SERVE_NEW=8 SERVE_PROMPT_MAX=16 \
+    python bench.py --serve | tee /tmp/nightly_serve.log
+python - <<'PY'
+import json
+rec = json.loads(open("/tmp/nightly_serve.log").read().strip().splitlines()[-1])
+assert rec["completed"] == rec["requests"], \
+    "serve gate: %s/%s requests completed (errors: %s)" % (
+        rec["completed"], rec["requests"], rec.get("errors"))
+assert rec["steady_state_recompiles"] == 0, \
+    "serve gate: %d steady-state recompiles" % rec["steady_state_recompiles"]
+assert rec["steady_state_retrace_events"] == 0, \
+    "serve gate: retrace watchdog fired %d times after warmup" \
+    % rec["steady_state_retrace_events"]
+print("serve gate passed: %s tok/s/chip, p99 %s ms, occupancy %s" % (
+    rec["value"], rec["latency_ms"]["p99"], rec["batch_occupancy"]))
+PY
 echo "nightly: all gates passed"

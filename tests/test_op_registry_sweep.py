@@ -18,6 +18,7 @@ SKIP = {
     "_CrossDeviceCopy",               # executor-internal marker
     "Crop",                           # needs h_w/crop_like (test_operator)
     "Attention", "DotProductAttention",  # 4-D qkv (test_attention)
+    "DecodeAttention",                # KV-cache q/cache/pos (test_serving)
     "batch_dot", "dot",               # lhs/rhs rank rules (test_operator)
     "Unpooling",                      # paired with Pooling (test_operator)
     "softmax_cross_entropy",          # (data, label) ranks (test_operator)

@@ -132,3 +132,147 @@ def test_export_with_aux_states(tmp_path):
     pred.export(path)
     got = load_exported(path).predict(data=x)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# dtype contract (serving satellite fixes): inputs follow the placeholder
+# dtype instead of being forced through the predictor-wide dtype
+# ---------------------------------------------------------------------------
+
+def _embedding_lm_net():
+    import mxnet_tpu.symbol as sym
+
+    data = sym.Variable("data")
+    emb = sym.Embedding(data=data, input_dim=50, output_dim=6, name="emb")
+    fc = sym.FullyConnected(data=emb, num_hidden=4, name="fc")
+    return sym.SoftmaxOutput(data=fc, name="softmax")
+
+
+def _embedding_lm_params(rng):
+    return {"emb_weight": rng.randn(50, 6).astype(np.float32),
+            "fc_weight": rng.randn(4, 12).astype(np.float32) * 0.3,
+            "fc_bias": np.zeros(4, np.float32)}
+
+
+def test_input_types_int_placeholder_preserved():
+    """input_types={'data': int32} compiles an int32 placeholder and
+    set_input keeps token ids integral end to end."""
+    rng = np.random.RandomState(0)
+    net = _embedding_lm_net()
+    params = _embedding_lm_params(rng)
+    pred = mx.Predictor(net, params, {"data": (3, 2)},
+                        input_types={"data": np.int32})
+    i = pred._arg_index["data"]
+    assert np.dtype(pred._arg_arrays[i].dtype) == np.int32
+    ids = np.array([[0, 49], [7, 7], [12, 3]], np.int32)
+    probs = pred.predict(data=ids)
+    assert np.dtype(pred._arg_arrays[i].dtype) == np.int32
+
+    # oracle: same lookup by hand
+    x = params["emb_weight"][ids].reshape(3, 12)
+    logits = x @ params["fc_weight"].T + params["fc_bias"]
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    np.testing.assert_allclose(probs, e / e.sum(1, keepdims=True),
+                               rtol=1e-5)
+
+    # a typo'd key must error, not silently leave the placeholder at f32
+    with pytest.raises(MXNetError, match="input_types"):
+        mx.Predictor(net, params, {"data": (3, 2)},
+                     input_types={"dta": np.int32})
+
+
+def test_set_input_follows_placeholder_dtype():
+    """An int array into an f32 placeholder casts to f32 (the placeholder
+    wins), not to some per-call dtype."""
+    rng = np.random.RandomState(1)
+    pred = mx.Predictor(_embedding_lm_net(), _embedding_lm_params(rng),
+                        {"data": (2, 2)})
+    pred.set_input("data", np.array([[1, 2], [3, 4]], np.int64))
+    i = pred._arg_index["data"]
+    assert np.dtype(pred._arg_arrays[i].dtype) == np.float32
+
+
+def test_c_buffer_follows_placeholder_dtype():
+    """The C-shim SetInput path reads the buffer in the placeholder's
+    dtype (int32 ids arrive as int32 bytes, not reinterpreted floats)."""
+    from mxnet_tpu.predictor import _set_input_from_buffer
+
+    rng = np.random.RandomState(2)
+    pred = mx.Predictor(_embedding_lm_net(), _embedding_lm_params(rng),
+                        {"data": (2, 2)}, input_types={"data": np.int32})
+    ids = np.array([[5, 6], [7, 8]], np.int32)
+    _set_input_from_buffer(pred, "data", ids.tobytes())
+    got = np.asarray(pred._arg_arrays[pred._arg_index["data"]])
+    np.testing.assert_array_equal(got, ids)
+    with pytest.raises(MXNetError, match="int32 elements"):
+        _set_input_from_buffer(pred, "data", ids.tobytes() + b"\0\0\0\0")
+
+
+def test_export_roundtrip_int_inputs(tmp_path):
+    """Export with an int32 input: the artifact records per-input dtypes,
+    and the loaded predictor stages/zero-fills in them."""
+    from mxnet_tpu.predictor import load_exported
+
+    rng = np.random.RandomState(3)
+    net = _embedding_lm_net()
+    params = _embedding_lm_params(rng)
+    pred = mx.Predictor(net, params, {"data": (2, 2)},
+                        input_types={"data": np.int32})
+    ids = np.array([[10, 20], [30, 40]], np.int32)
+    want = pred.predict(data=ids)
+    path = str(tmp_path / "lm.mxtpu")
+    pred.export(path)
+    loaded = load_exported(path)
+    assert loaded._input_dtypes["data"] == np.int32
+    got = loaded.predict(data=ids)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # float input would previously be force-cast through the artifact
+    # dtype; ids passed as float must still land on int32 for the call
+    got2 = loaded.predict(data=ids.astype(np.float64))
+    np.testing.assert_allclose(got2, want, rtol=1e-5)
+
+
+def test_exported_predictor_ctx_placement(tmp_path):
+    """ExportedPredictor(ctx=...) places params on ctx (it used to accept
+    ctx and silently serve from the default device)."""
+    from mxnet_tpu.predictor import load_exported
+
+    rng = np.random.RandomState(4)
+    net = _embedding_lm_net()
+    pred = mx.Predictor(net, _embedding_lm_params(rng), {"data": (2, 2)})
+    path = str(tmp_path / "ctx.mxtpu")
+    pred.export(path)
+    ctx = mx.cpu(1)
+    loaded = load_exported(path, ctx=ctx)
+    dev = ctx.jax_device()
+    assert all(a.device == dev for a in loaded._params[0])
+    assert all(a.device == dev for a in loaded._params[1])
+    x = np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(loaded.predict(data=x),
+                               load_exported(path).predict(data=x),
+                               rtol=1e-5)
+
+
+def test_partial_forward_subgraph_cached(tmp_path, monkeypatch):
+    """partial_forward builds each prefix plan once (it used to re-run
+    _build_graph_fn per call: O(nodes^2) for a step-through)."""
+    import mxnet_tpu.predictor as predictor_mod
+
+    prefix, X, _ = _trained_checkpoint(tmp_path)
+    pred = mx.predictor.load(prefix, 3, input_shapes={"data": (4, 8)})
+    pred.set_input("data", X[:4])
+    calls = []
+    real = predictor_mod._build_graph_fn
+
+    def counting(sym):
+        calls.append(sym)
+        return real(sym)
+
+    monkeypatch.setattr(predictor_mod, "_build_graph_fn", counting)
+    first = pred.partial_forward(2)
+    again = pred.partial_forward(2)
+    assert len(calls) == 1
+    assert [n for n, _ in first] == [n for n, _ in again]
+    np.testing.assert_allclose(first[-1][1], again[-1][1])
+    pred.partial_forward(3)
+    assert len(calls) == 2

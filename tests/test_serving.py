@@ -1,0 +1,397 @@
+"""Continuous-batching serving engine tests (mxnet_tpu/serving).
+
+The contracts under test, in dependency order:
+
+1. KV-cache numerics: prefill + single-token decode reproduce the
+   full-sequence `models/transformer.py` forward (the Symbol graph bound
+   through Executor) within fp32 tolerance, token by token.
+2. Scheduling: sequences admit and retire MID-batch (iteration-level,
+   Orca-style) without perturbing their neighbours — batched greedy
+   outputs are bit-identical to one-request-at-a-time runs.
+3. Shape discipline: after `warmup()`, serving traffic compiles NOTHING
+   (retrace watchdog event stream empty for `serving.*` sites,
+   `serve.aot.compiles` static).
+4. Scale-out: a 2-replica router on the CPU mesh completes everything it
+   admits, on two distinct devices.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.transformer import get_transformer_lm
+from mxnet_tpu.ops.attention import decode_attention
+from mxnet_tpu.serving import (ReplicaRouter, ServingEngine,
+                               TransformerKVModel)
+
+V, S, L, H, E = 61, 32, 2, 2, 32
+
+
+@pytest.fixture
+def model_and_params():
+    model = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E)
+    return model, model.init_params(np.random.RandomState(7))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("max_new_tokens", 6)
+    return ServingEngine(model, params, **kw)
+
+
+# ---------------------------------------------------------------------------
+# 1. numerics
+# ---------------------------------------------------------------------------
+
+def test_decode_attention_matches_full_softmax():
+    """decode_attention at position p == row p of masked full attention."""
+    rng = np.random.RandomState(0)
+    b, s, e, h = 3, 10, 16, 2
+    k = rng.randn(b, s, e).astype(np.float32)
+    v = rng.randn(b, s, e).astype(np.float32)
+    q = rng.randn(b, e).astype(np.float32)
+    pos = np.array([4, 9, 0], np.int32)
+    got = np.asarray(decode_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(pos), h))
+    hd = e // h
+    for bi in range(b):
+        p = pos[bi]
+        for hi in range(h):
+            qh = q[bi, hi * hd:(hi + 1) * hd]
+            kh = k[bi, :p + 1].reshape(p + 1, h, hd)[:, hi]
+            vh = v[bi, :p + 1].reshape(p + 1, h, hd)[:, hi]
+            sc = kh @ qh / np.sqrt(hd)
+            w = np.exp(sc - sc.max())
+            w /= w.sum()
+            want = w @ vh
+            np.testing.assert_allclose(
+                got[bi, hi * hd:(hi + 1) * hd], want, atol=1e-5)
+
+
+def test_param_names_match_transformer_symbol(model_and_params):
+    """The decode model's parameter dict must stay in lockstep with the
+    names/shapes `get_transformer_lm` mints, or checkpoints stop serving."""
+    model, _ = model_and_params
+    net = get_transformer_lm(V, S, num_layers=L, num_heads=H, num_embed=E)
+    logits_sym = net.get_internals()["pred_output"]
+    sym_args = set(logits_sym.list_arguments()) - {"data"}
+    assert sym_args == set(model.param_shapes())
+    arg_shapes, _, _ = logits_sym.infer_shape(data=(2, S))
+    by_name = dict(zip(logits_sym.list_arguments(), arg_shapes))
+    for name, shape in model.param_shapes().items():
+        assert tuple(by_name[name]) == tuple(shape), name
+
+
+def test_prefill_decode_parity_vs_full_forward(model_and_params):
+    """Acceptance gate: KV-cache decode logits == full-sequence forward
+    logits at every generated position, within fp32 tolerance."""
+    model, params = model_and_params
+    net = get_transformer_lm(V, S, num_layers=L, num_heads=H, num_embed=E)
+    logits_sym = net.get_internals()["pred_output"]
+
+    B, P = 3, 5
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, V, size=(B, S))
+    args = {n: mx.nd.array(params[n]) for n in model.param_shapes()}
+    args["data"] = mx.nd.array(toks.astype(np.float32))
+    exe = logits_sym.bind(mx.cpu(), args, grad_req="null")
+    full = exe.forward(is_train=False)[0].asnumpy().reshape(B, S, V)
+
+    pj = {k: jnp.asarray(v) for k, v in params.items()}
+    length = jnp.full((B,), P, jnp.int32)
+    slots = jnp.arange(B, dtype=jnp.int32)
+    logits_p, kv = model.prefill(pj, jnp.asarray(toks[:, :P], jnp.int32),
+                                 length)
+    np.testing.assert_allclose(np.asarray(logits_p), full[:, P - 1],
+                               atol=2e-5)
+    cache = model.write_prefill(model.init_cache(B), kv, length, slots)
+    for t in range(P, S):
+        lg, cache = model.decode(pj, cache,
+                                 jnp.asarray(toks[:, t], jnp.int32),
+                                 jnp.full((B,), t, jnp.int32), slots)
+        np.testing.assert_allclose(np.asarray(lg), full[:, t], atol=2e-5,
+                                   err_msg="decode diverged at pos %d" % t)
+
+
+def test_ragged_prefill_lengths_isolated(model_and_params):
+    """Rows with different prompt lengths in one padded prefill must match
+    their own unpadded single-row prefill (right-padding is inert)."""
+    model, params = model_and_params
+    pj = {k: jnp.asarray(v) for k, v in params.items()}
+    rng = np.random.RandomState(3)
+    lens = [3, 8, 5]
+    s_bucket = 8
+    toks = np.zeros((len(lens), s_bucket), np.int32)
+    rows = [rng.randint(0, V, size=n) for n in lens]
+    for i, r in enumerate(rows):
+        toks[i, :len(r)] = r
+    logits, _ = model.prefill(pj, jnp.asarray(toks),
+                              jnp.asarray(lens, jnp.int32))
+    for i, r in enumerate(rows):
+        solo, _ = model.prefill(
+            pj, jnp.asarray(r[None, :], jnp.int32),
+            jnp.asarray([len(r)], jnp.int32))
+        np.testing.assert_allclose(np.asarray(logits[i]),
+                                   np.asarray(solo[0]), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# 2. scheduling
+# ---------------------------------------------------------------------------
+
+def _oracle(model, params, prompt, max_new=6):
+    """One-request-at-a-time greedy generation (the batching-free truth)."""
+    eng = _engine(model, params, max_batch=1)
+    req = eng.submit(prompt, max_new_tokens=max_new)
+    eng.run_until_idle(timeout=300)
+    return req.result(1)
+
+
+def test_admit_retire_mid_batch(model_and_params):
+    """Requests joining and leaving the running batch at step granularity
+    must not change any sequence's greedy output."""
+    model, params = model_and_params
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (3, 7, 5, 9, 2, 4)]
+    # staggered max_new makes retirement happen mid-batch, and staggered
+    # submission makes admission happen mid-batch
+    max_news = [2, 6, 3, 5, 6, 4]
+
+    eng = _engine(model, params, max_batch=3)
+    eng.warmup()
+    first = [eng.submit(p, max_new_tokens=m)
+             for p, m in zip(prompts[:4], max_news[:4])]
+    for _ in range(3):       # run a few steps with the initial wave
+        eng.step()
+    late = [eng.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts[4:], max_news[4:])]
+    eng.run_until_idle(timeout=300)
+    outs = [r.result(1) for r in first + late]
+
+    assert all(r.done for r in first + late)
+    for p, m, o in zip(prompts, max_news, outs):
+        assert o == _oracle(model, params, p, max_new=m), \
+            "batched output diverged from solo run for prompt %s" % p
+        assert len(o) == m
+    assert eng.stats["completed"] == len(prompts)
+    assert not eng._active and len(eng._free) == eng.max_batch
+
+
+def test_eos_retires_early(model_and_params):
+    model, params = model_and_params
+    prompt = [5, 9, 11]
+    base = _oracle(model, params, prompt, max_new=6)
+    eos = base[2]
+    eng = _engine(model, params)
+    req = eng.submit(prompt, max_new_tokens=6, eos_id=eos)
+    eng.run_until_idle(timeout=300)
+    got = req.result(1)
+    assert got == base[:base.index(eos) + 1]
+
+
+def test_capacity_bound_request_uses_full_cache(model_and_params):
+    """A request that hits the context limit generates through the LAST
+    cache row (position seq_len - 1), not one short of it: 1 prefill
+    token + one decode per remaining position."""
+    model, params = model_and_params
+    eng = ServingEngine(model, params, max_batch=2,
+                        prefill_buckets=[16, S], max_new_tokens=4)
+    plen = S - 2
+    req = eng.submit(list(np.arange(plen) % V), max_new_tokens=10)
+    eng.run_until_idle(timeout=300)
+    assert len(req.result(1)) == S - plen + 1  # 3, not 2
+
+
+def test_prompt_too_long_rejected(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    with pytest.raises(MXNetError, match="prefill bucket"):
+        eng.submit(list(range(17)))
+    with pytest.raises(MXNetError, match="empty prompt"):
+        eng.submit([])
+    with pytest.raises(MXNetError, match="max_new_tokens"):
+        eng.submit([1, 2], max_new_tokens=0)  # not silently the default
+    with pytest.raises(MXNetError, match="max_new_tokens"):
+        ServingEngine(model, params, max_new_tokens=0)
+
+
+def test_scheduler_death_fails_requests_not_hangs(model_and_params,
+                                                  monkeypatch):
+    """A scheduler-fatal error (anything escaping step(), e.g. a decode
+    launch failure) must fail every outstanding request promptly and mark
+    the engine dead — not strand clients in result() until timeout."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    eng.warmup()
+
+    def boom(b_bucket):
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(eng, "_compiled_decode", boom)
+    eng.start()
+    req = eng.submit([1, 2, 3])
+    with pytest.raises(MXNetError, match="device exploded"):
+        req.result(timeout=60)  # prompt failure, not a 60 s hang
+    eng.stop()
+    with pytest.raises(MXNetError, match="scheduler died"):
+        eng.submit([4, 5])
+
+
+def test_prefill_launch_failure_is_scheduler_fatal(model_and_params,
+                                                   monkeypatch):
+    """A failure of the DONATING prefill launch may have invalidated the
+    K/V cache: it must kill the scheduler (failing the request loudly),
+    not be swallowed as a poison request while the engine limps on toward
+    an 'Array has been deleted' one step later."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    eng.warmup()
+
+    def bad_compiled(*a, **k):
+        raise RuntimeError("launch blew up")
+
+    monkeypatch.setattr(eng, "_compiled_prefill", lambda s: bad_compiled)
+    eng.start()
+    req = eng.submit([1, 2, 3])
+    with pytest.raises(MXNetError, match="launch blew up"):
+        req.result(timeout=60)
+    eng.stop()
+    with pytest.raises(MXNetError, match="scheduler died"):
+        eng.submit([4, 5])
+
+
+def test_unsorted_bucket_kwargs_normalized(model_and_params):
+    """Caller-supplied bucket lists are sorted+deduped: submit() reads
+    [-1] as the largest bucket and _bucket_for scans ascending.
+    Out-of-range buckets raise instead of being silently dropped."""
+    model, params = model_and_params
+    with pytest.raises(MXNetError, match="exceed max_batch"):
+        ServingEngine(model, params, max_batch=4, decode_buckets=[2, 8])
+    with pytest.raises(MXNetError, match="exceed seq_len"):
+        ServingEngine(model, params, prefill_buckets=[8, 64])
+    eng = ServingEngine(model, params, max_batch=4,
+                        decode_buckets=[4, 2, 2], prefill_buckets=[16, 8],
+                        max_new_tokens=2)
+    assert eng.decode_buckets == [2, 4]
+    assert eng.prefill_buckets == [8, 16]
+    req = eng.submit(list(range(1, 13)))  # 12 tokens: needs bucket 16
+    eng.run_until_idle(timeout=120)
+    assert len(req.result(1)) == 2
+
+
+def test_router_skips_dead_replica(model_and_params, monkeypatch):
+    """One replica's scheduler dying must not black-hole the router:
+    least-depth dispatch skips dead engines while any replica lives."""
+    model, params = model_and_params
+    engines = [_engine(model, params, max_batch=2, max_new_tokens=2)
+               for _ in range(2)]
+    router = ReplicaRouter(engines)
+    router.warmup()
+
+    def boom(b_bucket):
+        raise RuntimeError("replica0 exploded")
+
+    monkeypatch.setattr(engines[0], "_compiled_decode", boom)
+    router.start()
+    try:
+        dead_req = engines[0].submit([1, 2])
+        with pytest.raises(MXNetError, match="exploded"):
+            dead_req.result(timeout=60)
+        reqs = [router.submit([3 + i]) for i in range(4)]
+        outs = [r.result(timeout=60) for r in reqs]
+    finally:
+        router.stop()
+    assert all(len(o) == 2 for o in outs)
+    assert engines[0]._dead is not None
+    assert engines[1].stats["completed"] == 4
+
+
+# ---------------------------------------------------------------------------
+# 3. zero steady-state recompiles
+# ---------------------------------------------------------------------------
+
+def test_bucketed_shapes_zero_retrace(model_and_params):
+    """After warmup pre-AOT-compiles the bucket set, serving traffic of
+    mixed prompt lengths and batch sizes must compile nothing: no
+    `serving.*` retrace event, `serve.aot.compiles` static."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    eng.warmup()
+    reg = telemetry.registry()
+    compiles_after_warmup = reg.counter("serve.aot.compiles").value
+    assert compiles_after_warmup == \
+        len(eng.prefill_buckets) + len(eng.decode_buckets)
+
+    rng = np.random.RandomState(2)
+    reqs = [eng.submit(list(rng.randint(0, V, size=n)),
+                       max_new_tokens=int(m))
+            for n, m in zip((3, 11, 7, 2, 16, 5, 9, 13),
+                            (4, 2, 6, 3, 5, 6, 2, 4))]
+    eng.run_until_idle(timeout=300)
+    for r in reqs:
+        r.result(1)
+
+    serving_events = [e for e in telemetry.events("retrace")
+                      if str(e.get("site", "")).startswith("serving.")]
+    assert serving_events == [], serving_events
+    assert reg.counter("serve.aot.compiles").value == compiles_after_warmup
+    assert reg.counter("serve.aot.hits").value > 0
+    assert reg.counter("serve.completed").value == len(reqs)
+
+
+def test_watch_jit_seed_declares_without_firing():
+    """telemetry.watch_jit(seed=True) joins the seen set silently; a
+    signature OUTSIDE the seeded set still diagnoses as a retrace."""
+    telemetry.reset()
+    reg = telemetry.registry()
+    sigs = [((("x", (b,), "int32"),), b) for b in (1, 2, 4)]
+    for sig, b in sigs:
+        assert reg.watch_jit("t.site", sig, scope=1, meta={"b": b},
+                             seed=True) is None
+    for sig, b in sigs:  # live traffic over the declared set: silent
+        assert reg.watch_jit("t.site", sig, scope=1, meta={"b": b}) is None
+    ev = reg.watch_jit("t.site", (("x", (3,), "int32"),), scope=1,
+                       meta={"b": 3})
+    assert ev is not None and ev["kind"] == "retrace"
+
+
+# ---------------------------------------------------------------------------
+# 4. multi-replica dispatch
+# ---------------------------------------------------------------------------
+
+def test_two_replica_cpu_mesh_dispatch(model_and_params):
+    from mxnet_tpu.parallel import make_mesh
+
+    model, params = model_and_params
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    router = ReplicaRouter.from_mesh(
+        model, params, mesh=mesh, max_batch=2, prefill_buckets=[8, 16],
+        max_new_tokens=4)
+    router.warmup()
+    assert len(router.engines) == 2
+    assert len({e._device for e in router.engines}) == 2
+
+    rng = np.random.RandomState(5)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (3, 6, 4, 8, 2, 5)]
+    router.start()
+    try:
+        reqs = [router.submit(p) for p in prompts]
+        outs = [r.result(120) for r in reqs]
+    finally:
+        router.stop()
+    assert all(len(o) == 4 for o in outs)
+    # least-depth routing under a burst must use both replicas
+    assert all(e.stats["prefills"] > 0 for e in router.engines)
+    for p, o in zip(prompts, outs):
+        assert o == _oracle(model, params, p, max_new=4)

@@ -32,6 +32,13 @@ RECOVERY_COUNTERS = ("dist.rpc_retries", "dist.dup_push_applied",
                      "chaos.rpc_drops", "train.nonfinite_steps",
                      "train.auto_checkpoints", "train.resumes")
 
+# serving accounting (docs/serving.md): counters/gauges/hists emitted by
+# the continuous-batching engine (mxnet_tpu/serving)
+SERVE_COUNTERS = ("serve.requests", "serve.completed", "serve.tokens",
+                  "serve.prefills", "serve.decode_steps",
+                  "serve.decode_padded", "serve.aot.compiles",
+                  "serve.aot.hits", "serve.engine_failures")
+
 
 def load(path):
     records = []
@@ -59,6 +66,21 @@ def _step_ms(rec):
 def _comm_delta(rec):
     d = rec.get("deltas", {})
     return sum(int(d.get(k, 0)) for k in COMM_KEYS)
+
+
+def _merge_hists(records, name):
+    """Pool a histogram's per-step summaries across the stream: count-
+    weighted mean plus the worst per-step p99/max (the pools themselves
+    are drained per report, so exact stream-wide percentiles are gone)."""
+    rows = [r["hists"][name] for r in records
+            if r.get("hists", {}).get(name, {}).get("count")]
+    if not rows:
+        return None
+    n = sum(h["count"] for h in rows)
+    return {"count": n,
+            "mean": round(sum(h["mean"] * h["count"] for h in rows) / n, 2),
+            "p99_max": round(max(h["p99"] for h in rows), 2),
+            "max": round(max(h["max"] for h in rows), 2)}
 
 
 def _fmt_bytes(n):
@@ -135,6 +157,25 @@ def summarize(records):
             recovery[key] = v
     if recovery:
         out["recovery"] = recovery
+    serving = {k: int(final.get(k, 0)) for k in SERVE_COUNTERS
+               if final.get(k)}
+    if serving:
+        # batch occupancy over the whole stream: real decode rows vs the
+        # bucket slots launched (padding included)
+        toks = serving.get("serve.tokens", 0) - \
+            serving.get("serve.prefills", 0)
+        padded = serving.get("serve.decode_padded", 0)
+        if toks + padded:
+            serving["batch_occupancy"] = round(
+                toks / float(toks + padded), 4)
+        serving["steady_state_recompiles"] = len(
+            [e for e in retraces
+             if str(e.get("site", "")).startswith("serving.")])
+        for name in ("serve.latency_ms", "serve.ttft_ms"):
+            agg = _merge_hists(records, name)
+            if agg:
+                serving[name] = agg
+        out["serving"] = serving
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -164,6 +205,17 @@ def format_summary(summary):
         lines.append("  recovery:")
         for key in sorted(recovery):
             lines.append("    %-24s %d" % (key, recovery[key]))
+    serving = summary.get("serving")
+    if serving:
+        lines.append("  serving:")
+        for key in sorted(serving):
+            v = serving[key]
+            if isinstance(v, dict):
+                lines.append("    %-24s n=%d mean=%.1f p99<=%.1f max=%.1f"
+                             % (key, v["count"], v["mean"], v["p99_max"],
+                                v["max"]))
+            else:
+                lines.append("    %-24s %s" % (key, v))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "
