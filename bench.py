@@ -582,7 +582,7 @@ def overlap_bench(batches=None, batch=None, record=True):
     return result
 
 
-def serve_bench(record=True):
+def serve_bench(record=True, with_chaos=False):
     """Poisson-traffic serving benchmark (``python bench.py --serve``).
 
     Drives the continuous-batching engine (mxnet_tpu/serving) with
@@ -594,16 +594,35 @@ def serve_bench(record=True):
     retrace watchdog, and warmup pre-AOT-compiles the whole bucket set).
     Artifact: bench_results/serve_bench.json.
 
+    ``--chaos`` (``with_chaos=True``) additionally injects the serving
+    chaos clauses (a default MXNET_CHAOS spec with one replica crashed
+    mid-traffic unless the env already sets one), runs 2 replicas and a
+    default 10 s request deadline, and records the resilience
+    accounting: shed rate, deadline-hit p99, quarantine/failover/respawn
+    counts, and the hung-request count (must be 0 — the nightly
+    serve-chaos gate reads exactly these fields).
+
     CPU-mesh friendly: the default geometry is small; SERVE_* knobs
     scale it up for on-chip runs (see docs/serving.md).
     """
     import jax
 
+    from mxnet_tpu import chaos as chaos_mod
     from mxnet_tpu import telemetry
     from mxnet_tpu.base import MXNetError
-    from mxnet_tpu.serving import ReplicaRouter, TransformerKVModel
+    from mxnet_tpu.serving import (ReplicaRouter, TransformerKVModel,
+                                   ServeOverload, ServeTimeout,
+                                   ServeEngineDead, ServeDeadlineExceeded)
 
     n_requests = int(os.environ.get("SERVE_REQUESTS", "48"))
+    if with_chaos:
+        os.environ.setdefault(
+            "MXNET_CHAOS",
+            "engine_crash:%d:replica0,decode_slow:0.05:20,launch_error:0.02"
+            % max(4, n_requests // 6))
+        os.environ.setdefault("SERVE_REPLICAS", "2")
+        os.environ.setdefault("SERVE_DEADLINE_MS", "10000")
+        chaos_mod.reset()
     rate = float(os.environ.get("SERVE_RATE", "16"))  # req/s offered
     n_replicas = int(os.environ.get("SERVE_REPLICAS", "1"))
     vocab = int(os.environ.get("SERVE_VOCAB", "512"))
@@ -613,6 +632,7 @@ def serve_bench(record=True):
     embed = int(os.environ.get("SERVE_EMBED", "128"))
     prompt_max = int(os.environ.get("SERVE_PROMPT_MAX", "24"))
     max_new = int(os.environ.get("SERVE_NEW", "16"))
+    deadline_ms = float(os.environ.get("SERVE_DEADLINE_MS", "0")) or None
     rng = np.random.RandomState(int(os.environ.get("SERVE_SEED", "0")))
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -627,7 +647,8 @@ def serve_bench(record=True):
                                num_heads=heads, num_embed=embed)
     params = model.init_params(rng)
     n_replicas = min(n_replicas, len(jax.devices()))
-    router = ReplicaRouter.from_mesh(model, params, n_replicas=n_replicas)
+    router = ReplicaRouter.from_mesh(model, params, n_replicas=n_replicas,
+                                     deadline_ms=deadline_ms)
     t0 = time.perf_counter()
     buckets = router.warmup()[0]
     warmup_s = time.perf_counter() - t0
@@ -641,10 +662,21 @@ def serve_bench(record=True):
     router.start()
     depth_samples = []
     reqs = []
+    submit_shed = 0
+    submit_rejected = 0
+    hung = 0
     t_start = time.perf_counter()
     try:
         for p in prompts:
-            reqs.append(router.submit(p, max_new_tokens=max_new))
+            try:
+                reqs.append(router.submit(p, max_new_tokens=max_new))
+            except ServeOverload:
+                submit_shed += 1  # admission control shed at the door
+            except ServeEngineDead:
+                # no live replica in the crash-to-respawn window (certain
+                # when chaos collapses a 1-replica run): a typed rejection
+                # at the door, not a lost benchmark
+                submit_rejected += 1
             depth_samples.append(router.depth())
             if rate > 0:
                 time.sleep(rng.exponential(1.0 / rate))
@@ -653,6 +685,8 @@ def serve_bench(record=True):
             try:
                 r.result(timeout=max(1.0, deadline -
                                      (time.perf_counter() - t_start)))
+            except ServeTimeout:
+                hung += 1  # never resolved: the one unacceptable outcome
             except MXNetError:
                 pass  # r.error / r.done carry it into the accounting below
     finally:
@@ -673,6 +707,19 @@ def serve_bench(record=True):
         return None if not xs else round(xs[min(len(xs) - 1,
                                                 int(len(xs) * q))], 2)
 
+    ok_lat = sorted(r.latency_ms for r in reqs
+                    if r.done and r.error is None
+                    and r.latency_ms is not None)
+    hit = ok_lat if deadline_ms is None else \
+        [v for v in ok_lat if v <= deadline_ms]
+    resilience = {k.split(".", 1)[1]: int(reg.counter(k).value)
+                  for k in ("serve.shed", "serve.expired",
+                            "serve.cancelled", "serve.degraded",
+                            "serve.quarantined", "serve.cache_rebuilds",
+                            "serve.launch_errors", "serve.failovers",
+                            "serve.redispatched", "serve.respawns",
+                            "serve.chaos_flooded")
+                  if reg.counter(k).value}
     result = {
         "metric": "serve_tokens_per_sec_per_chip",
         "value": round(n_tokens / elapsed / n_replicas, 2),
@@ -681,7 +728,28 @@ def serve_bench(record=True):
                                               embed, seq),
         "requests": n_requests,
         "completed": sum(1 for r in reqs if r.done and r.error is None),
-        "errors": ([r.error for r in reqs if r.error is not None] +
+        # every offered request must account for itself: finished (ok or
+        # typed error) or rejected typed at the door — `hung` is the
+        # residue and the serve-chaos gate requires it to be zero
+        "resolved": (sum(1 for r in reqs if r.done) + submit_shed +
+                     submit_rejected),
+        "hung": hung,
+        "submit_shed": submit_shed,
+        "submit_rejected": submit_rejected,
+        # expiries counted off the REAL request objects: the process-wide
+        # serve.expired counter also includes chaos queue_flood synthetics
+        "shed_rate": round((submit_shed +
+                            sum(1 for r in reqs if isinstance(
+                                r.error, ServeDeadlineExceeded))) /
+                           float(max(n_requests, 1)), 4),
+        "deadline": {
+            "deadline_ms": deadline_ms,
+            "hit_rate": round(len(hit) / float(max(n_requests, 1)), 4),
+            "hit_p99_ms": pct(hit, 0.99),
+        },
+        "resilience": resilience,
+        "chaos": os.environ.get("MXNET_CHAOS") if with_chaos else None,
+        "errors": ([str(r.error) for r in reqs if r.error is not None] +
                    ["timeout" for r in reqs if not r.done])[:5],
         "offered_rate_req_s": rate,
         "elapsed_s": round(elapsed, 3),
@@ -742,6 +810,6 @@ if __name__ == "__main__":
     if "--overlap" in sys.argv:
         overlap_bench()
     elif "--serve" in sys.argv:
-        serve_bench()
+        serve_bench(with_chaos="--chaos" in sys.argv)
     else:
         main()

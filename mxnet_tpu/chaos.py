@@ -10,7 +10,10 @@ fault_tolerance.md) claims to survive:
   retries: the mutation landed but the ack was lost),
 * RPC delays,
 * parameter-server crash at the Nth state-mutating apply,
-* NaN/Inf gradients at the Nth fused optimizer update.
+* NaN/Inf gradients at the Nth fused optimizer update,
+* serving-side faults for the continuous batcher (docs/serving.md):
+  slow decode steps, a replica scheduler crash mid-traffic, launch
+  errors, and synthetic queue floods driving the overload policy.
 
 Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
 
@@ -24,12 +27,30 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           must re-accumulate the round from retries)
     nan_grad:N[:inf]      poison the gradients of fused-update call #N in
                           this process with NaN (or +inf)
+    decode_slow:P:MS      with probability P a serving decode step sleeps
+                          MS ms before launching (SLO pressure: deadlines
+                          expire mid-flight, queues back up)
+    engine_crash:N[:NAME] serving replica NAME (default replica0) raises
+                          `ChaosEngineCrash` at its Nth decode-bearing
+                          step — classified as a dead device, so the
+                          engine dies and the router's failover path runs
+    launch_error:P        with probability P a serving prefill/decode
+                          launch raises `ChaosError` BEFORE the compiled
+                          call (the donated cache survives): prefill hits
+                          quarantine the request, decode hits retry
+    queue_flood:RATE[:TOTAL]  each serving step injects RATE synthetic
+                          one-token requests (TOTAL cap, default 256)
+                          through admission control — exercises
+                          MXNET_SERVE_OVERLOAD shedding under load
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
 (``DMLC_ROLE``/``DMLC_RANK``/``DMLC_SERVER_ID``), so a chaos run replays
 the same fault sequence every time — a recovery bug found under chaos is
-reproducible by rerunning the same command.
+reproducible by rerunning the same command.  The serving clauses draw
+from per-clause streams (seed additionally mixed with the clause name),
+so adding `decode_slow` to a spec does not perturb which launches
+`launch_error` hits.
 
 Every hook re-reads ``MXNET_CHAOS`` per call (same live-flip contract as
 `optimizer.fused_update_enabled`); with the variable unset each hook is a
@@ -45,8 +66,10 @@ import zlib
 import numpy as np
 
 __all__ = [
-    "ChaosError", "CRASH_EXIT_CODE", "enabled", "spec", "reset",
-    "rpc_action", "maybe_crash_server", "grad_poison",
+    "ChaosError", "ChaosEngineCrash", "CRASH_EXIT_CODE", "enabled", "spec",
+    "reset", "rpc_action", "maybe_crash_server", "grad_poison",
+    "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
+    "serve_queue_flood",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -59,6 +82,13 @@ class ChaosError(OSError):
     worker treats it exactly like a real socket error (retry path)."""
 
 
+class ChaosEngineCrash(ChaosError):
+    """Injected serving-replica death (`engine_crash:N`).  The engine's
+    failure classifier treats it as a dead device — scheduler dies,
+    router failover takes over — unlike a plain `ChaosError` launch
+    fault, which stays scoped to the triggering request/step."""
+
+
 class _Spec:
     """Parsed MXNET_CHAOS spec + the per-process deterministic RNG and
     injection counters."""
@@ -69,6 +99,10 @@ class _Spec:
         self.rpc_delay = (0.0, 0.0)       # (probability, milliseconds)
         self.server_crash = None          # (apply_count, server_id)
         self.nan_grad = None              # (call_index, np value)
+        self.decode_slow = (0.0, 0.0)     # (probability, milliseconds)
+        self.engine_crash = None          # (step_count, replica name)
+        self.launch_error = 0.0           # probability per launch
+        self.queue_flood = None           # (per-step rate, total cap)
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -84,6 +118,19 @@ class _Spec:
                 val = np.inf if len(parts) > 2 and parts[2] == "inf" \
                     else np.nan
                 self.nan_grad = (int(parts[1]), val)
+            elif kind == "decode_slow":
+                self.decode_slow = (float(parts[1]),
+                                    float(parts[2]) if len(parts) > 2
+                                    else 50.0)
+            elif kind == "engine_crash":
+                self.engine_crash = (int(parts[1]),
+                                     parts[2] if len(parts) > 2
+                                     else "replica0")
+            elif kind == "launch_error":
+                self.launch_error = float(parts[1])
+            elif kind == "queue_flood":
+                self.queue_flood = (int(parts[1]),
+                                    int(parts[2]) if len(parts) > 2 else 256)
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -91,10 +138,27 @@ class _Spec:
         role = os.environ.get("DMLC_ROLE", "local")
         rank = os.environ.get("DMLC_RANK", os.environ.get("DMLC_SERVER_ID",
                                                           "0"))
-        mix = zlib.crc32(("%s/%s" % (role, rank)).encode())
+        self._seed = seed
+        self._role_rank = "%s/%s" % (role, rank)
+        mix = zlib.crc32(self._role_rank.encode())
         self.rng = np.random.RandomState((seed + mix) & 0x7FFFFFFF)
         self.fused_update_calls = 0
+        self.engine_steps = {}            # replica name -> decode steps
+        self.flooded = 0                  # synthetic requests injected
+        self._clause_rng = {}
         self.lock = threading.Lock()
+
+    def rng_for(self, clause):
+        """Per-clause deterministic stream: the draw sequence each serving
+        clause sees depends only on (seed, role/rank, clause name), not on
+        which OTHER clauses are active — `launch_error` hits the same
+        launches whether or not `decode_slow` is also in the spec."""
+        rng = self._clause_rng.get(clause)
+        if rng is None:
+            mix = zlib.crc32(("%s/%s" % (self._role_rank, clause)).encode())
+            rng = np.random.RandomState((self._seed + mix) & 0x7FFFFFFF)
+            self._clause_rng[clause] = rng
+        return rng
 
 
 _CACHE = (None, None)   # (raw env string, _Spec)
@@ -193,3 +257,71 @@ def grad_poison():
                             "call %d with %r", at, val)
             return val
     return None
+
+
+# ---------------------------------------------------------------------------
+# Serving-side hooks (mxnet_tpu/serving — docs/serving.md failure semantics)
+# ---------------------------------------------------------------------------
+
+def serve_decode_slow():
+    """Milliseconds to stall the CURRENT decode step, or None.  The engine
+    sleeps host-side before launching, so the injected latency shows up in
+    queue age / deadline accounting exactly like a slow device would."""
+    s = spec()
+    if s is None or s.decode_slow[0] <= 0:
+        return None
+    p, ms = s.decode_slow
+    with s.lock:
+        if s.rng_for("decode_slow").random_sample() < p:
+            return ms
+    return None
+
+
+def serve_engine_crash(name):
+    """Count one decode-bearing step of replica ``name``; True exactly
+    when that replica reaches its ``engine_crash:N`` step.  Counting is
+    per replica NAME and persists across respawns (the counter keeps
+    advancing past N), so a respawned replica does not crash again at
+    ITS Nth step — one crash-and-recover cycle per spec, same contract
+    as `maybe_crash_server`'s rehydrated exemption."""
+    s = spec()
+    if s is None or s.engine_crash is None:
+        return False
+    at, target = s.engine_crash
+    with s.lock:
+        n = s.engine_steps.get(name, 0) + 1
+        s.engine_steps[name] = n
+    if name != target or n != at:
+        return False
+    logging.error("chaos: crashing serving replica %s at decode step %d "
+                  "(MXNET_CHAOS=%s)", name, at, s.raw)
+    return True
+
+
+def serve_launch_error():
+    """True when the CURRENT serving launch should fail with a
+    `ChaosError` before the compiled call runs (the donated cache is
+    never consumed, so the engine classifies it as request/step-scoped,
+    not cache loss)."""
+    s = spec()
+    if s is None or s.launch_error <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("launch_error").random_sample()
+                    < s.launch_error)
+
+
+def serve_queue_flood():
+    """Number of synthetic requests the CURRENT serving step should
+    inject through admission control (0 when the clause is absent or its
+    TOTAL cap is spent)."""
+    s = spec()
+    if s is None or s.queue_flood is None:
+        return 0
+    rate, total = s.queue_flood
+    with s.lock:
+        n = min(rate, total - s.flooded)
+        if n <= 0:
+            return 0
+        s.flooded += n
+    return n

@@ -345,12 +345,29 @@ class AotCache:
     this cache for every steady-state call — `<name>.compiles` advancing
     after warmup is the same signal the retrace watchdog diagnoses, made
     countable.  Thread-safe: replica engines build caches from worker
-    threads."""
+    threads.
+
+    The cache object outlives any single engine: a respawned serving
+    replica is constructed WITH its dead incarnation's AotCache (compiled
+    executables are immutable — a failed call only consumes the donated
+    buffers it was passed), so recovery warmup is pure hits and the
+    zero-recompile invariant survives failover.  `compiles` exposes the
+    local build count for exactly that gate."""
 
     def __init__(self, name="aot"):
         self._name = name
         self._cache = {}
         self._lock = threading.Lock()
+        self._compiles = 0
+
+    @property
+    def compiles(self):
+        """Executables built BY this cache (== telemetry `<name>.compiles`
+        when one cache owns the name).  The respawn path snapshots it
+        around the replacement replica's warmup to assert recovery
+        compiled nothing."""
+        with self._lock:
+            return self._compiles
 
     def get(self, key, build=None):
         """The executable for `key`, building (and counting a compile) via
@@ -365,6 +382,8 @@ class AotCache:
         ent = build()
         with self._lock:
             winner = self._cache.setdefault(key, ent)
+            if winner is ent:
+                self._compiles += 1
         # two threads can race build() for the same key; only the insert
         # that won counts as a compile, so `<name>.compiles` stays exactly
         # the number of cached executables (the zero-recompile gates

@@ -11,14 +11,25 @@ package is the production path on top of it (ROADMAP item 1):
   batcher (Orca, OSDI '22): sequences admit/retire at step granularity,
   padded and bucketed onto a small fixed set of pre-AOT-compiled
   (batch, seq) shapes so steady state has zero recompiles (asserted via
-  the telemetry retrace watchdog).
+  the telemetry retrace watchdog).  Per-request deadlines, cancellation,
+  and a bounded queue with configurable overload policy
+  (``MXNET_SERVE_OVERLOAD=shed|block|degrade``) make it SLO-grade.
 * `engine.ReplicaRouter` — least-depth dispatch over per-device engine
-  replicas (the mesh scale-out path).
+  replicas (the mesh scale-out path) with heartbeat monitoring, failover
+  of a dead replica's queued requests to survivors, and background
+  respawn off the shared AOT cache (recovery compiles nothing).
+* `errors` — the typed failure taxonomy every request resolves to.
 
 See docs/serving.md.
 """
 from .decode import TransformerKVModel
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
+from .errors import (ServeError, ServeTimeout, ServeOverload,
+                     ServeDeadlineExceeded, ServeCancelled,
+                     ServeQuarantined, ServeCacheInvalidated,
+                     ServeEngineDead)
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
-           "ReplicaRouter"]
+           "ReplicaRouter", "ServeError", "ServeTimeout", "ServeOverload",
+           "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
+           "ServeCacheInvalidated", "ServeEngineDead"]

@@ -116,10 +116,18 @@ class TransformerKVModel:
             raise MXNetError(
                 "TransformerKVModel: params missing %s" % missing)
 
-    def init_cache(self, n_slots):
-        """Zeroed K/V cache: (num_layers, 2, n_slots, S_max, embed)."""
-        return jnp.zeros((self.num_layers, 2, int(n_slots), self.seq_len,
-                          self.num_embed), self.dtype)
+    def init_cache(self, n_slots, device=None):
+        """Zeroed K/V cache: (num_layers, 2, n_slots, S_max, embed).
+
+        ``device`` places the buffer on a specific device (the engine's
+        ctor AND its cache-rebuild recovery path: when a failed donating
+        launch consumes the buffer, a fresh one is allocated here without
+        touching the compiled executables — rebuild compiles nothing)."""
+        shape = (self.num_layers, 2, int(n_slots), self.seq_len,
+                 self.num_embed)
+        if device is None:
+            return jnp.zeros(shape, self.dtype)
+        return jax.device_put(np.zeros(shape, self.dtype), device)
 
     # -- shared pieces -----------------------------------------------------
     def _proj(self, params, x, name):

@@ -25,10 +25,23 @@ each compiled call — decode updates it in place; slot ``max_batch`` is
 the trash slot padding rows write into.  Sampling (greedy argmax) runs
 inside the compiled step, so the only per-step host traffic is the bucket
 of sampled token ids the scheduler needs for EOS/retire decisions.
+
+Failure model (docs/serving.md "Failure semantics"): partial failure is
+the normal case, not an engine-killing event.  Every request carries an
+optional deadline and resolves — with tokens or a typed `ServeError` —
+at iteration granularity; admission control bounds the queue
+(``MXNET_SERVE_QUEUE_MAX`` + ``MXNET_SERVE_OVERLOAD=shed|block|degrade``);
+launch failures are classified by SCOPE (a poisoned request is
+quarantined while the batch keeps decoding, a consumed donated cache is
+rebuilt, only a dead device kills the scheduler); and a dead replica's
+queued-but-not-admitted requests fail over to surviving replicas while
+the `ReplicaRouter` respawns a replacement that re-warms from the SHARED
+AOT cache — recovery compiles nothing.
 """
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -38,16 +51,20 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import chaos
 from .. import telemetry
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
+from .errors import (ServeError, ServeTimeout, ServeOverload,
+                     ServeDeadlineExceeded, ServeCancelled,
+                     ServeQuarantined, ServeCacheInvalidated,
+                     ServeEngineDead)
 
 
 class _EngineFatal(Exception):
-    """A failure of a compiled call that DONATED the K/V cache: the buffer
-    may already be invalidated, so the scheduler cannot carry on — step()
-    must not swallow this as a per-request poison error."""
+    """A dead-device-scoped failure: the scheduler cannot carry on —
+    step() must not swallow this as a per-request poison error."""
 
 
 def _env_buckets(name, default):
@@ -66,12 +83,18 @@ def _env_buckets(name, default):
 
 
 class ServeRequest:
-    """One generation request: prompt in, tokens out, latency stamps."""
+    """One generation request: prompt in, tokens out, latency stamps.
+
+    ``deadline_ms`` (optional) is the SLO contract: once
+    ``t_submit + deadline_ms`` passes, the scheduler retires the request
+    at its next iteration with `ServeDeadlineExceeded` — whether it is
+    still queued or mid-decode — so an expired request never costs a
+    dispatch.  ``cancel()`` retires the same way with `ServeCancelled`."""
 
     _ids = [0]
     _ids_lock = threading.Lock()
 
-    def __init__(self, prompt, max_new_tokens, eos_id=None):
+    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline_ms=None):
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("ServeRequest: empty prompt")
@@ -84,20 +107,44 @@ class ServeRequest:
         self.tokens = []          # generated ids (includes eos if hit)
         self.error = None
         self.t_submit = time.perf_counter()
+        self.t_deadline = None if not deadline_ms \
+            else self.t_submit + float(deadline_ms) / 1e3
         self.t_first = None       # first token sampled (end of prefill)
         self.t_done = None
         self._done = threading.Event()
+        self._cancelled = False
+        self._requeues = 0        # cache-loss retries already burned
+        self._waker = None        # set by the owning engine at enqueue
 
     @property
     def done(self):
         return self._done.is_set()
 
+    def expired(self, now=None):
+        return self.t_deadline is not None and \
+            (time.perf_counter() if now is None else now) > self.t_deadline
+
+    def cancel(self):
+        """Ask the scheduler to retire this request at its next iteration
+        (`ServeCancelled`).  Idempotent; a no-op once finished."""
+        self._cancelled = True
+        waker = self._waker
+        if waker is not None:
+            waker()
+
     def result(self, timeout=None):
-        """Block until finished; returns the generated token list."""
+        """Block until finished; returns the generated token list.  Raises
+        `ServeTimeout` if the wait expires, or the request's own typed
+        `ServeError` if it failed."""
         if not self._done.wait(timeout):
-            raise MXNetError("ServeRequest %d: timed out" % self.id)
+            raise ServeTimeout("ServeRequest %d: timed out after %ss"
+                               % (self.id, timeout))
         if self.error is not None:
-            raise MXNetError("ServeRequest %d: %s" % (self.id, self.error))
+            err = self.error
+            cls = err.__class__ if isinstance(err, ServeError) else MXNetError
+            msg = str(err)
+            tag = "ServeRequest %d" % self.id
+            raise cls(msg if tag in msg else "%s: %s" % (tag, msg))
         return list(self.tokens)
 
     # latency views (ms), None until the corresponding stamp exists
@@ -112,6 +159,8 @@ class ServeRequest:
             1e3 * (self.t_done - self.t_submit)
 
     def _finish(self, error=None):
+        if self._done.is_set():
+            return
         self.error = error
         self.t_done = time.perf_counter()
         self._done.set()
@@ -130,17 +179,28 @@ class _Seq:
         self.n_new = 1  # the prefill already sampled token #1
 
 
+_OVERLOAD_POLICIES = ("shed", "block", "degrade")
+
+
 class ServingEngine:
     """Single-replica continuous batcher over one device.
 
     model:  `TransformerKVModel` (the program builder).
-    params: {name: array} transformer weights (device_put onto `ctx`).
+    params: {name: array} transformer weights (device_put onto `ctx`;
+            already-device-resident arrays are shared, not copied — the
+            respawn path reuses the dead replica's placed params).
     ctx:    Context or jax device; default = first device.
+    queue_max / overload / deadline_ms: admission control (env defaults
+            ``MXNET_SERVE_QUEUE_MAX`` / ``MXNET_SERVE_OVERLOAD`` /
+            ``MXNET_SERVE_DEADLINE_MS``).
+    aot:    share a prebuilt `AotCache` (respawn: recovery compiles
+            nothing the dead incarnation already compiled).
     """
 
     def __init__(self, model, params, ctx=None, max_batch=None,
                  decode_buckets=None, prefill_buckets=None,
-                 max_new_tokens=None, eos_id=None, name="replica0"):
+                 max_new_tokens=None, eos_id=None, name="replica0",
+                 queue_max=None, overload=None, deadline_ms=None, aot=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -182,15 +242,29 @@ class ServingEngine:
         if self.max_new_default < 1:
             raise MXNetError("ServingEngine: max_new_tokens must be >= 1")
         self.eos_id = eos_id
+        # admission control (0 = unbounded queue, policy moot)
+        self._queue_max = int(os.environ.get("MXNET_SERVE_QUEUE_MAX", "0")
+                              if queue_max is None else queue_max)
+        self._overload = str(os.environ.get("MXNET_SERVE_OVERLOAD", "shed")
+                             if overload is None else overload).lower()
+        if self._overload not in _OVERLOAD_POLICIES:
+            raise MXNetError(
+                "ServingEngine: overload policy %r not in %s"
+                % (self._overload, _OVERLOAD_POLICIES))
+        dl = float(os.environ.get("MXNET_SERVE_DEADLINE_MS", "0")
+                   if deadline_ms is None else deadline_ms)
+        self._deadline_ms_default = dl if dl > 0 else None
+        self._launch_retries = max(1, int(os.environ.get(
+            "MXNET_SERVE_LAUNCH_RETRIES", "3")))
 
-        self._params = {k: jax.device_put(np.asarray(v), self._device)
-                        for k, v in params.items()}
+        jarr = getattr(jax, "Array", ())
+        self._params = {k: jax.device_put(
+            v if isinstance(v, jarr) else np.asarray(v), self._device)
+            for k, v in params.items()}
         # slot max_batch is the trash slot padding rows write into
-        self._cache = jax.device_put(
-            np.zeros((model.num_layers, 2, self.max_batch + 1,
-                      model.seq_len, model.num_embed), model.dtype),
-            self._device)
-        self._aot = AotCache("serve.aot")
+        self._cache = model.init_cache(self.max_batch + 1,
+                                       device=self._device)
+        self._aot = aot if aot is not None else AotCache("serve.aot")
         # gauges are namespaced per replica: engines share one process-wide
         # registry, and a global "serve.queue_depth" written by N scheduler
         # threads records whichever replica wrote last — neither any single
@@ -198,12 +272,17 @@ class ServingEngine:
         self._gauge = "serve.%s." % self.name
         self._queue = deque()
         self._qlock = threading.Lock()
+        self._qcond = threading.Condition(self._qlock)
+        self._admitting = 0       # popped off _queue, prefill in flight
         self._active = {}         # slot -> _Seq (insertion-ordered)
         self._free = list(range(self.max_batch))
         self._stopped = threading.Event()
         self._wake = threading.Event()  # set by submit(): work arrived
         self._thread = None
         self._dead = None         # scheduler-fatal error message, if any
+        self._on_death = None     # router failover hook: fn(engine, pending, msg)
+        self._launch_fails = 0    # consecutive decode launch failures
+        self.last_beat = time.monotonic()  # scheduler heartbeat
         # bench accounting (host-side, touched only by the scheduler)
         self.stats = {"decode_steps": 0, "decode_rows": 0,
                       "decode_padded": 0, "prefills": 0, "completed": 0,
@@ -247,7 +326,9 @@ class ServingEngine:
         counts every post-warmup NEW signature as a recompile — the whole
         bucket set is warmup here, so only a shape that ESCAPED the
         bucketing fires an event).  After warmup, `serve.aot.compiles`
-        advancing or a `serving.*` retrace event means exactly that bug."""
+        advancing or a `serving.*` retrace event means exactly that bug.
+        A respawned replica warms from the dead incarnation's shared
+        AotCache, so recovery hits every key and compiles nothing."""
         for s in self.prefill_buckets:
             self._compiled_prefill(s)
             toks = np.zeros((1, s), np.int32)
@@ -262,8 +343,25 @@ class ServingEngine:
         return {"prefill": list(self.prefill_buckets),
                 "decode": list(self.decode_buckets)}
 
+    def respawn(self):
+        """A replacement engine for this (dead) replica: same device,
+        geometry, name, and admission config; params SHARED (already on
+        the device, no host round-trip); the compiled AOT set SHARED, so
+        the replacement's `warmup()` re-seeds the watchdog but compiles
+        nothing new; fresh K/V cache and slot state."""
+        return ServingEngine(
+            self.model, self._params, ctx=self._device,
+            max_batch=self.max_batch,
+            decode_buckets=list(self.decode_buckets),
+            prefill_buckets=list(self.prefill_buckets),
+            max_new_tokens=self.max_new_default, eos_id=self.eos_id,
+            name=self.name, queue_max=self._queue_max,
+            overload=self._overload,
+            deadline_ms=self._deadline_ms_default, aot=self._aot)
+
     # -- request intake ----------------------------------------------------
-    def submit(self, prompt, max_new_tokens=None, eos_id=None):
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None, _count_shed=True):
         if max_new_tokens is None:
             max_new_tokens = self.max_new_default
         elif int(max_new_tokens) < 1:
@@ -271,8 +369,11 @@ class ServingEngine:
             # reject rather than silently substituting the default
             raise MXNetError("ServingEngine: max_new_tokens must be >= 1, "
                              "got %s" % max_new_tokens)
+        if deadline_ms is None:
+            deadline_ms = self._deadline_ms_default
         req = ServeRequest(prompt, max_new_tokens,
-                           self.eos_id if eos_id is None else eos_id)
+                           self.eos_id if eos_id is None else eos_id,
+                           deadline_ms=deadline_ms)
         if len(req.prompt) > self.prefill_buckets[-1]:
             raise MXNetError(
                 "ServingEngine: prompt length %d exceeds the largest "
@@ -283,23 +384,111 @@ class ServingEngine:
                 "ServingEngine: prompt length %d leaves no room to "
                 "generate (seq_len %d)" % (len(req.prompt),
                                            self.model.seq_len))
-        # dead-check and append under the SAME lock _fail_all drains under,
-        # so a request can never slip in after the failure drain and hang
-        with self._qlock:
-            if self._dead is not None:
-                raise MXNetError("ServingEngine %s: scheduler died: %s"
-                                 % (self.name, self._dead))
-            self._queue.append(req)
-            depth = len(self._queue)
-        self._wake.set()
+        if self._queue_max > 0 and self._overload == "block":
+            self._enqueue_blocking(req)
+        else:
+            self._enqueue(req, count_shed_global=_count_shed)
+        # counted at the submit door only: failover re-dispatch and chaos
+        # floods reuse _enqueue but are not new offered requests (they
+        # have serve.redispatched / serve.chaos_flooded of their own)
         telemetry.inc("serve.requests")
+        return req
+
+    def _count(self, what, n=1):
+        telemetry.inc("serve.%s" % what, n)
+        telemetry.inc(self._gauge + what, n)
+
+    def _admission_shed(self, depth, count_global=True):
+        """Overload decision for one enqueue at queue depth `depth`.
+        Returns a degrade token-cap (or None) — raises `ServeOverload`
+        when the request should shed.  Called under `_qlock`.
+
+        ``count_global=False`` (the router's dispatch/redispatch paths,
+        which retry other replicas) bumps only the per-replica shed
+        counter: process-wide ``serve.shed`` counts REQUESTS finally
+        rejected, not per-replica attempts."""
+        if self._queue_max <= 0 or depth < self._queue_max:
+            return None
+        if self._overload == "degrade" and depth < 4 * self._queue_max:
+            # cap generation length under pressure instead of shedding;
+            # the 4x backstop bounds the queue even under a flood
+            return max(1, self.max_new_default // 4)
+        telemetry.inc(self._gauge + "shed")
+        if count_global:
+            telemetry.inc("serve.shed")
+        raise ServeOverload(
+            "ServingEngine %s: queue full (%d >= %d, policy %s)"
+            % (self.name, depth, self._queue_max, self._overload))
+
+    def _check_alive_locked(self):
+        """Raise `ServeEngineDead` on a dead/stopped engine.  Must run
+        under `_qlock` — the same lock `_die`/`stop` drain under, so a
+        request can never slip in after the drain and hang."""
+        if self._dead is not None:
+            raise ServeEngineDead("ServingEngine %s: scheduler died: %s"
+                                  % (self.name, self._dead))
+        if self._stopped.is_set():
+            raise ServeEngineDead("ServingEngine %s: engine stopped"
+                                  % self.name)
+
+    def _post_enqueue(self, req, depth):
+        req._waker = self._wake.set
+        self._wake.set()
         telemetry.set_gauge(self._gauge + "queue_depth", depth)
         return req
 
-    def depth(self):
-        """Router load signal: queued + running requests."""
+    def _enqueue(self, req, count_shed_global=True):
+        """Admission under the shed/degrade policies (also the router's
+        failover re-dispatch path and the chaos flood — both must never
+        block a scheduler thread)."""
         with self._qlock:
-            return len(self._queue) + len(self._active)
+            self._check_alive_locked()
+            cap = self._admission_shed(len(self._queue),
+                                       count_global=count_shed_global)
+            if cap is not None and req.max_new_tokens > cap:
+                req.max_new_tokens = cap
+                self._count("degraded")
+            self._queue.append(req)
+            depth = len(self._queue)
+        return self._post_enqueue(req, depth)
+
+    def _enqueue_blocking(self, req):
+        """`block` overload policy: wait for queue room, bounded by the
+        request's own deadline (unbounded when it has none) and by
+        `cancel()` — both resolve the wait typed instead of leaving the
+        submitter blocked."""
+        waited = False
+        with self._qcond:
+            while True:
+                self._check_alive_locked()
+                if req._cancelled:
+                    self._count("cancelled")
+                    raise ServeCancelled(
+                        "ServeRequest %d: cancelled while blocked at "
+                        "admission (%s queue full)" % (req.id, self.name))
+                if req.expired():
+                    self._count("expired")
+                    raise ServeDeadlineExceeded(
+                        "ServeRequest %d: deadline passed while blocked at "
+                        "admission (%s queue full)" % (req.id, self.name))
+                if len(self._queue) < self._queue_max:
+                    self._queue.append(req)
+                    depth = len(self._queue)
+                    break
+                waited = True
+                self._qcond.wait(0.05)
+        if waited:
+            self._count("block_waits")
+        return self._post_enqueue(req, depth)
+
+    def depth(self):
+        """Router load signal: queued + mid-admission + running requests.
+        `_admitting` covers the window between the scheduler popping a
+        request and its prefill landing in `_active` (or finishing) —
+        without it a thread-driven `run_until_idle` could read depth 0
+        and declare idle while a prefill is in flight."""
+        with self._qlock:
+            return len(self._queue) + self._admitting + len(self._active)
 
     # -- scheduling --------------------------------------------------------
     def _bucket_for(self, n, buckets):
@@ -319,6 +508,64 @@ class ServingEngine:
             scope=telemetry.watch_scope(self),
             meta={"bucket": bucket}, seed=seed)
 
+    # -- failure scoping ---------------------------------------------------
+    def _cache_lost(self):
+        c = self._cache
+        return getattr(c, "is_deleted", None) is not None and c.is_deleted()
+
+    def _classify_failure(self, exc):
+        """Scope of a failed compiled launch:
+
+        * ``device`` — the accelerator itself is gone (or chaos says so):
+          scheduler-fatal, the router fails over.
+        * ``cache``  — the launch CONSUMED the donated K/V buffer before
+          failing: every admitted sequence lost its context, but the
+          engine rebuilds the cache and keeps serving its queue.
+        * ``scoped`` — the donated buffer survived, so the fault is local
+          to the triggering launch (a poisoned request at prefill, a
+          transient error at decode)."""
+        if isinstance(exc, chaos.ChaosEngineCrash):
+            return "device"
+        if self._cache_lost():
+            return "cache"
+        msg = str(exc).lower()
+        # allocation pressure mentions the device in its message but the
+        # device is healthy — scoped retry (an immediate respawn would
+        # allocate ANOTHER full cache into the same pressure)
+        if any(k in msg for k in ("resource_exhausted", "out of memory",
+                                  "oom")):
+            return "scoped"
+        # \bdead\b: "dead device"/"backend is dead" yes, a transient
+        # DEADLINE_EXCEEDED status no — that one takes the scoped retry
+        if any(k in msg for k in ("device", "data_loss", "disconnected")) \
+                or re.search(r"\bdead\b", msg):
+            return "device"
+        return "scoped"
+
+    def _quarantine(self, req, msg):
+        """Fail ONE poisoned request with a typed error; the batch keeps
+        decoding and the scheduler stays up."""
+        self._count("quarantined")
+        telemetry.record_event("serve_quarantine", replica=self.name,
+                               request=req.id, error=msg[:200])
+        req._finish(error=ServeQuarantined(msg[:500]))
+
+    def _rebuild_cache(self, reason):
+        """The donated K/V buffer was consumed by a failed launch: every
+        ADMITTED sequence lost its context (typed failure), the cache is
+        reallocated, and the engine keeps serving its queue — scoped
+        failure, not an engine death."""
+        err = ServeCacheInvalidated(
+            "ServingEngine %s: K/V cache invalidated (%s)"
+            % (self.name, reason[:300]))
+        for slot, seq in list(self._active.items()):
+            self._retire_error(slot, seq, err)
+        self._cache = self.model.init_cache(self.max_batch + 1,
+                                            device=self._device)
+        self._count("cache_rebuilds")
+        telemetry.record_event("serve_cache_rebuild", replica=self.name,
+                               reason=reason[:200])
+
     def _admit_one(self, req):
         slot = self._free.pop()
         try:
@@ -332,18 +579,41 @@ class ServingEngine:
             self._watch("prefill", (toks_d, length, slot_d),
                         ("tokens", "length", "slot"), s)
             compiled = self._compiled_prefill(s)
-        except Exception:
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError("chaos: injected prefill launch "
+                                       "error")
+        except Exception as e:
+            # nothing launched: the fault is this request's alone
             self._free.append(slot)
-            raise
+            self._quarantine(req, "prefill setup failed: %s" % e)
+            return
         try:
             first, self._cache = compiled(self._params, self._cache, toks_d,
                                           length, slot_d)
             first = int(np.asarray(first)[0])
         except Exception as e:
-            # the launch donated self._cache: the buffer may already be
-            # gone, so this is never a per-request poison error
             self._free.append(slot)
-            raise _EngineFatal("prefill launch failed: %s" % e) from e
+            kind = self._classify_failure(e)
+            if kind == "device":
+                req._finish(error=ServeEngineDead(
+                    "prefill launch failed: %s" % str(e)[:400]))
+                raise _EngineFatal("prefill launch failed: %s" % e) from e
+            if kind == "cache":
+                self._rebuild_cache("prefill launch failed: %s" % e)
+                # this request's prefill was eaten with the cache; one
+                # retry against the fresh buffer, then quarantine
+                if req._requeues < 1:
+                    req._requeues += 1
+                    with self._qlock:
+                        self._queue.appendleft(req)
+                else:
+                    self._quarantine(req, "prefill launch failed twice "
+                                     "across a cache rebuild: %s" % e)
+                return
+            self._quarantine(req, "prefill launch failed: %s" % e)
+            return
+        telemetry.observe("serve.queue_age_ms",
+                          1e3 * (time.perf_counter() - req.t_submit))
         req.t_first = time.perf_counter()
         req.tokens.append(first)
         self.stats["prefills"] += 1
@@ -379,22 +649,90 @@ class ServingEngine:
         if seq.req.ttft_ms is not None:
             telemetry.observe("serve.ttft_ms", seq.req.ttft_ms)
 
+    def _retire_error(self, slot, seq, err):
+        del self._active[slot]
+        self._free.append(slot)
+        seq.req._finish(error=err)
+
+    def _finish_dropped(self, req, now=None):
+        """Resolve a cancelled/expired request with its typed error (the
+        single construction site for both — `_sweep` and the admit pop
+        share it)."""
+        if req._cancelled:
+            self._count("cancelled")
+            req._finish(error=ServeCancelled(
+                "ServeRequest %d: cancelled" % req.id))
+        else:
+            now = time.perf_counter() if now is None else now
+            self._count("expired")
+            req._finish(error=ServeDeadlineExceeded(
+                "ServeRequest %d: deadline exceeded after %.0f ms"
+                % (req.id, 1e3 * (now - req.t_submit))))
+
+    def _sweep(self):
+        """Retire expired/cancelled requests at iteration granularity:
+        queued ones never reach a prefill, active ones leave the next
+        decode batch — shedding costs no extra dispatches."""
+        now = time.perf_counter()
+        dropped = []
+        with self._qlock:
+            if any(r._cancelled or r.expired(now) for r in self._queue):
+                keep = deque()
+                for r in self._queue:
+                    if r._cancelled or r.expired(now):
+                        dropped.append(r)
+                    else:
+                        keep.append(r)
+                self._queue = keep
+                self._qcond.notify_all()
+        for slot, seq in list(self._active.items()):
+            r = seq.req
+            if r._cancelled or r.expired(now):
+                dropped.append(r)
+                del self._active[slot]
+                self._free.append(slot)
+        for r in dropped:
+            self._finish_dropped(r, now)
+
+    def _inject_flood(self):
+        """`queue_flood:rate` chaos: synthetic one-token requests pushed
+        through the SAME admission control as real traffic (shed floods
+        count in `serve.shed`)."""
+        n = chaos.serve_queue_flood()
+        for _ in range(n):
+            req = ServeRequest([1], 1,
+                               deadline_ms=self._deadline_ms_default)
+            telemetry.inc("serve.chaos_flooded")
+            try:
+                self._enqueue(req)
+            except ServeError:
+                pass  # shed: exactly the pressure the clause probes
+
     def step(self):
-        """One scheduler iteration: admit while there is room, then one
-        decode step over the active set.  Returns the number of sequences
-        still active (0 = idle)."""
+        """One scheduler iteration: sweep deadlines/cancellations, admit
+        while there is room, then one decode step over the active set.
+        Returns the number of sequences still active (0 = idle)."""
+        self.last_beat = time.monotonic()
+        if chaos.enabled():
+            self._inject_flood()
+        self._sweep()
         while self._free:
             with self._qlock:
                 req = self._queue.popleft() if self._queue else None
+                if req is not None:
+                    self._admitting += 1
+                    self._qcond.notify_all()
             if req is None:
                 break
             try:
+                if req._cancelled or req.expired():
+                    # arrived expired between sweeps
+                    self._finish_dropped(req)
+                    continue
                 self._admit_one(req)
-            except _EngineFatal as e:
-                req._finish(error=str(e)[:500])
-                raise
-            except Exception as e:  # a poison request must not kill serving
-                req._finish(error=str(e)[:500])
+            finally:
+                with self._qlock:
+                    self._admitting -= 1
         with self._qlock:
             telemetry.set_gauge(self._gauge + "queue_depth",
                                 len(self._queue))
@@ -402,6 +740,13 @@ class ServingEngine:
         telemetry.set_gauge(self._gauge + "active", n)
         if n == 0:
             return 0
+        if chaos.enabled():
+            if chaos.serve_engine_crash(self.name):
+                raise chaos.ChaosEngineCrash(
+                    "chaos: engine_crash killed replica %s" % self.name)
+            ms = chaos.serve_decode_slow()
+            if ms:
+                time.sleep(ms / 1e3)
         b = self._bucket_for(n, self.decode_buckets)
         slots = list(self._active)
         seqs = [self._active[s] for s in slots]
@@ -417,8 +762,28 @@ class ServingEngine:
         self._watch("decode", (tok_d, pos_d, slot_d),
                     ("token", "pos", "slots"), b)
         compiled = self._compiled_decode(b)
-        nxt, self._cache = compiled(self._params, self._cache, tok_d,
-                                    pos_d, slot_d)
+        try:
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError("chaos: injected decode launch error")
+            nxt, self._cache = compiled(self._params, self._cache, tok_d,
+                                        pos_d, slot_d)
+        except Exception as e:
+            kind = self._classify_failure(e)
+            if kind == "device":
+                raise _EngineFatal("decode launch failed: %s" % e) from e
+            if kind == "cache":
+                self._rebuild_cache("decode launch failed: %s" % e)
+                return len(self._active)
+            # scoped/transient: the donated cache survived — retry the
+            # same decode next iteration, escalate after N consecutive
+            self._launch_fails += 1
+            self._count("launch_errors")
+            if self._launch_fails >= self._launch_retries:
+                raise _EngineFatal(
+                    "decode launch failed %d consecutive times (last: %s)"
+                    % (self._launch_fails, e)) from e
+            return len(self._active)
+        self._launch_fails = 0
         nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
         self.stats["decode_steps"] += 1
         self.stats["decode_rows"] += n
@@ -454,14 +819,13 @@ class ServingEngine:
             try:
                 n = self.step()
             except Exception as e:  # noqa: BLE001
-                # admission errors are handled per-request inside step();
-                # anything that escapes (a decode launch failure, a cache
-                # invalidated by a failed donating call) is scheduler-fatal
-                # — fail everyone loudly instead of stranding them in
-                # result() until their timeouts
+                # per-request poison and cache loss are absorbed inside
+                # step(); anything that escapes is device-scoped — die
+                # loudly, hand queued requests to the router's failover
                 telemetry.inc("serve.engine_failures")
-                self._fail_all(str(e)[:500])
+                self._die(str(e)[:500])
                 return
+            self.last_beat = time.monotonic()
             if n == 0:
                 # idle: wait for a submit instead of spinning step() (and
                 # its gauge writes) at 1 kHz per replica.  Clear FIRST and
@@ -473,24 +837,38 @@ class ServingEngine:
                 if not queued and not self._stopped.is_set():
                     self._wake.wait(0.05)
 
-    def _fail_all(self, msg):
+    def _die(self, msg):
+        """Scheduler death: fail every ADMITTED request (their K/V context
+        is unrecoverable), mark dead, and hand the queued-but-not-admitted
+        requests to the router's failover hook (failed typed when no
+        router owns this engine)."""
+        err = ServeEngineDead("ServingEngine %s: scheduler died: %s"
+                              % (self.name, msg))
         for slot, seq in list(self._active.items()):
-            del self._active[slot]
-            self._free.append(slot)
-            seq.req._finish(error=msg)
+            self._retire_error(slot, seq, err)
         with self._qlock:
-            # mark dead and drain atomically: submit() checks _dead under
+            # mark dead and drain atomically: _enqueue checks _dead under
             # this lock, so everything it enqueued is in `pending` and
             # everything after it raises
             self._dead = msg
             pending = list(self._queue)
             self._queue.clear()
+            self._qcond.notify_all()
+        handler = self._on_death
+        if handler is not None:
+            try:
+                handler(self, pending, msg)
+                return
+            except Exception:  # failover must never strand requests
+                pass
         for req in pending:
-            req._finish(error=msg)
+            req._finish(error=err)
 
     def stop(self):
         self._stopped.set()
         self._wake.set()
+        with self._qcond:
+            self._qcond.notify_all()  # unblock `block`-policy submitters
         t = self._thread
         if t is not None:
             t.join(timeout=30)
@@ -502,23 +880,49 @@ class ServingEngine:
                     "ServingEngine %s: scheduler thread did not stop "
                     "within 30s (wedged launch?)" % self.name)
             self._thread = None
+        # every-request-resolves contract: anything still queued or
+        # admitted when the scheduler stopped gets a typed error instead
+        # of a result() that hangs forever (drained under the same lock
+        # _enqueue's stopped-check reads, so no request slips in after)
+        err = ServeEngineDead("ServingEngine %s: engine stopped"
+                              % self.name)
+        with self._qlock:
+            stranded = list(self._queue)
+            self._queue.clear()
+        for slot, seq in list(self._active.items()):
+            self._retire_error(slot, seq, err)
+        for req in stranded:
+            req._finish(error=err)
 
     def run_until_idle(self, timeout=None):
-        """Drive the scheduler synchronously (no worker thread) until the
-        queue and active set drain; returns steps taken."""
+        """Drive the scheduler until the queue and active set drain;
+        returns steps taken.  Steps synchronously when no worker thread
+        owns the engine, polls for drain when one does, and returns
+        immediately on a dead engine (its queue was drained/redispatched
+        at death — that depth will never drain by stepping)."""
         t0 = time.perf_counter()
         steps = 0
         while True:
-            with self._qlock:
-                queued = len(self._queue)
-            if self.step() == 0 and queued == 0:
+            if self._dead is not None:
+                return steps
+            thread_driven = self._thread is not None and \
+                self._thread.is_alive()
+            if thread_driven:
+                if self.depth() == 0:
+                    return steps
+                time.sleep(0.005)
+            else:
                 with self._qlock:
-                    if not self._queue:
-                        return steps
-            steps += 1
+                    queued = len(self._queue)
+                if self.step() == 0 and queued == 0:
+                    with self._qlock:
+                        if not self._queue:
+                            return steps
+                steps += 1
             if timeout is not None and time.perf_counter() - t0 > timeout:
-                raise MXNetError("run_until_idle: timed out after %d steps"
-                                 % steps)
+                raise ServeTimeout(
+                    "run_until_idle: timed out after %.1fs "
+                    "(%d steps, depth %d)" % (timeout, steps, self.depth()))
 
 
 def _default_decode_buckets(max_batch):
@@ -542,23 +946,49 @@ def _default_prefill_buckets(seq_len):
 
 
 class ReplicaRouter:
-    """Least-depth dispatch over per-device engine replicas.
+    """Least-depth dispatch over per-device engine replicas, with health
+    monitoring, failover, and respawn.
 
     Each replica owns a full parameter copy and its own queue/cache — the
     NamedSharding-tree scale-out (SNIPPETS [3]) degenerates to replicated
     params per device for serving, where requests are independent and the
     win is N concurrent batches, not one sharded one.  `from_mesh` builds
     one engine per device of a mesh (row-major over the first axis).
+
+    Partial failure is the normal case: when a replica's scheduler dies,
+    its queued-but-not-admitted requests re-dispatch to survivors (the
+    admitted ones fail typed — their K/V context died with the cache),
+    and a background monitor respawns a replacement on the same device
+    behind a capped-exponential-backoff circuit breaker (the PR-3
+    `parallel/dist.py` pattern).  The replacement warms from the dead
+    incarnation's SHARED AotCache, so failover compiles nothing —
+    `serve.aot.compiles` stays at its warmup value (asserted by the chaos
+    acceptance test).  ``respawn=False`` (or ``MXNET_SERVE_RESPAWN=0``)
+    disables respawn; failover re-dispatch still runs.
     """
 
-    def __init__(self, engines):
+    _MONITOR_PERIOD = 0.2
+    _BREAKER_RESET_S = 10.0   # healthy-for-this-long clears the breaker
+
+    def __init__(self, engines, respawn=None):
         if not engines:
             raise MXNetError("ReplicaRouter: need at least one engine")
         self.engines = list(engines)
         self._lock = threading.Lock()
+        if respawn is None:
+            respawn = os.environ.get("MXNET_SERVE_RESPAWN", "1").lower() \
+                not in ("0", "false", "no")
+        self._respawn = bool(respawn)
+        self._stopped = False
+        self._monitor = None
+        self._mon_stop = threading.Event()
+        self._breaker = {}   # replica name -> (fails, next_try monotonic)
+        for e in self.engines:
+            e._on_death = self._handle_death
 
     @classmethod
-    def from_mesh(cls, model, params, mesh=None, n_replicas=None, **kw):
+    def from_mesh(cls, model, params, mesh=None, n_replicas=None,
+                  respawn=None, **kw):
         devices = (list(np.asarray(mesh.devices).reshape(-1))
                    if mesh is not None else jax.devices())
         if n_replicas is not None:
@@ -566,41 +996,174 @@ class ReplicaRouter:
         engines = [ServingEngine(model, params, ctx=d,
                                  name="replica%d" % i, **kw)
                    for i, d in enumerate(devices)]
-        return cls(engines)
+        return cls(engines, respawn=respawn)
 
     def warmup(self):
         return [e.warmup() for e in self.engines]
 
+    # -- failover ----------------------------------------------------------
+    def _live_engines(self, exclude=None):
+        with self._lock:
+            engines = list(self.engines)
+        return [e for e in engines
+                if e is not exclude and e._dead is None
+                and not e._stopped.is_set()]
+
+    def _handle_death(self, engine, pending, msg):
+        """Engine death hook (runs on the dying scheduler's thread):
+        re-dispatch its queued-but-not-admitted requests to survivors.
+        Resolution is guaranteed PER REQUEST: a surprise mid-list must
+        not abort the loop — `_die`'s fallback would then fail the whole
+        pending list typed, including requests already successfully
+        enqueued on healthy survivors."""
+        try:
+            telemetry.inc("serve.failovers")
+            telemetry.inc("serve.%s.failover" % engine.name)
+            telemetry.record_event("serve_failover", replica=engine.name,
+                                   pending=len(pending), error=msg[:200])
+        except Exception:  # accounting must not abort failover
+            pass
+        err = ServeEngineDead(
+            "ServingEngine %s: scheduler died: %s (no live replica to "
+            "fail over to)" % (engine.name, msg))
+        for req in pending:
+            try:
+                if not self._redispatch(req, exclude=engine):
+                    req._finish(error=err)
+            except Exception:
+                req._finish(error=err)
+
+    def _redispatch(self, req, exclude=None):
+        """Move an un-admitted request (same object: deadline and latency
+        stamps ride along) to the least-loaded survivor."""
+        for eng in sorted(self._live_engines(exclude=exclude),
+                          key=lambda e: e.depth()):
+            try:
+                eng._enqueue(req, count_shed_global=False)
+            except ServeError:
+                continue  # died or shed in the window: try the next
+            telemetry.inc("serve.redispatched")
+            return True
+        return False
+
+    def _monitor_loop(self):
+        """Replica health: export heartbeat-age gauges, and respawn dead
+        replicas behind a capped-exp-backoff circuit breaker."""
+        while not self._mon_stop.wait(self._MONITOR_PERIOD):
+            with self._lock:
+                engines = list(self.engines)
+            now = time.monotonic()
+            for e in engines:
+                telemetry.set_gauge("serve.%s.beat_age_s" % e.name,
+                                    round(now - e.last_beat, 3))
+                if e._dead is None:
+                    # replacement stayed healthy past the reset window:
+                    # clear its breaker so independent rare faults over a
+                    # long process lifetime don't escalate recovery
+                    # latency toward the permanent backoff cap
+                    fails, next_try = self._breaker.get(e.name, (0, 0.0))
+                    if fails and now - next_try > self._BREAKER_RESET_S:
+                        self._breaker.pop(e.name, None)
+                if e._dead is None or not self._respawn or self._stopped:
+                    continue
+                fails, next_try = self._breaker.get(e.name, (0, 0.0))
+                if now < next_try:
+                    continue
+                # breaker advances whether or not the respawn works: a
+                # replica that dies instantly again retries with backoff
+                self._breaker[e.name] = (
+                    fails + 1, now + min(0.05 * (2 ** fails), 5.0))
+                try:
+                    fresh = e.respawn()
+                    compiled_before = fresh._aot.compiles
+                    fresh.warmup()
+                    if fresh._aot.compiles != compiled_before:
+                        # the zero-recompile invariant of recovery: warmup
+                        # off the shared AOT set must be pure cache hits
+                        telemetry.record_event(
+                            "serve_respawn_compiled", replica=e.name,
+                            n=fresh._aot.compiles - compiled_before)
+                    fresh._on_death = self._handle_death
+                    fresh.start()
+                except Exception as ex:  # noqa: BLE001
+                    telemetry.record_event("serve_respawn_failed",
+                                           replica=e.name,
+                                           error=str(ex)[:200])
+                    continue
+                with self._lock:
+                    try:
+                        self.engines[self.engines.index(e)] = fresh
+                    except ValueError:   # raced with a concurrent swap
+                        fresh.stop()
+                        continue
+                telemetry.inc("serve.respawns")
+                telemetry.record_event("serve_respawn", replica=e.name,
+                                       attempt=fails + 1)
+
+    # -- dispatch ----------------------------------------------------------
     def submit(self, prompt, **kw):
+        if self._stopped:
+            raise ServeEngineDead("ReplicaRouter: router stopped")
         telemetry.set_gauge("serve.replicas", len(self.engines))
         last_err = None
-        for _ in range(len(self.engines)):
-            with self._lock:
-                live = [e for e in self.engines if e._dead is None]
+        # two rounds: a replica dying (or respawning) between the snapshot
+        # and the submit re-routes instead of failing the request
+        for _ in range(2):
+            live = self._live_engines()
             if not live:
                 break
-            eng = min(live, key=lambda e: e.depth())
-            try:
-                return eng.submit(prompt, **kw)
-            except MXNetError as e:
-                if eng._dead is None:
-                    raise  # a bad request, not a dead replica
-                last_err = e  # died between selection and submit: reroute
-        raise MXNetError(
+            shed = 0
+            for eng in sorted(live, key=lambda e: e.depth()):
+                try:
+                    return eng.submit(prompt, _count_shed=False, **kw)
+                except ServeOverload as e:
+                    last_err = e
+                    shed += 1
+                except ServeEngineDead as e:
+                    last_err = e  # died in the window: try the next
+                except MXNetError as e:
+                    if eng._dead is None:
+                        raise  # a bad request, not a dead replica
+                    last_err = e
+            if shed == len(live):
+                # the request is definitively rejected only here — the
+                # per-replica attempts above counted serve.<name>.shed
+                telemetry.inc("serve.shed")
+                raise ServeOverload(
+                    "ReplicaRouter: all %d live replicas shed (%s)"
+                    % (shed, last_err))
+        raise ServeEngineDead(
             "ReplicaRouter: no live replica among %d (%s)"
             % (len(self.engines), last_err))
 
     def start(self):
+        self._stopped = False
         for e in self.engines:
             e.start()
+        if self._monitor is None or not self._monitor.is_alive():
+            self._mon_stop.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="serve-router-monitor",
+                daemon=True)
+            self._monitor.start()
         return self
 
     def stop(self):
-        # stop EVERY engine before raising: aborting on the first failure
-        # would leave the remaining schedulers running (and, from a finally
-        # block, mask whatever error actually failed the run)
+        # refuse new submits first, then stop the monitor (no respawn may
+        # race the drain), then stop EVERY engine before raising: aborting
+        # on the first failure would leave the remaining schedulers
+        # running (and, from a finally block, mask the error that actually
+        # failed the run)
+        self._stopped = True
+        self._mon_stop.set()
+        m = self._monitor
+        if m is not None:
+            m.join(timeout=10)
+            self._monitor = None
         errs = []
-        for e in self.engines:
+        with self._lock:
+            engines = list(self.engines)
+        for e in engines:
             try:
                 e.stop()
             except MXNetError as err:
@@ -611,8 +1174,27 @@ class ReplicaRouter:
                 % (len(errs), "; ".join(errs)))
 
     def run_until_idle(self, timeout=None):
-        """Synchronous drain of every replica (tests; bench uses start())."""
-        return [e.run_until_idle(timeout=timeout) for e in self.engines]
+        """Synchronous drain of every replica (tests; bench uses start()).
+        ``timeout`` bounds the WHOLE drain — a replica whose worker thread
+        died cannot eat the budget waiting on a depth that will never
+        drain (its queue was redispatched/failed at death, and the shared
+        deadline raises `ServeTimeout` instead of hanging)."""
+        t0 = time.perf_counter()
+        steps = []
+        with self._lock:
+            engines = list(self.engines)
+        for e in engines:
+            remaining = None if timeout is None else \
+                max(0.0, timeout - (time.perf_counter() - t0))
+            if timeout is not None and remaining <= 0 and e.depth() > 0:
+                raise ServeTimeout(
+                    "ReplicaRouter.run_until_idle: timed out after %.1fs "
+                    "with %s still holding %d request(s)"
+                    % (timeout, e.name, e.depth()))
+            steps.append(e.run_until_idle(timeout=remaining))
+        return steps
 
     def depth(self):
-        return sum(e.depth() for e in self.engines)
+        with self._lock:
+            engines = list(self.engines)
+        return sum(e.depth() for e in engines)

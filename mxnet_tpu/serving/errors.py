@@ -1,0 +1,71 @@
+"""Typed serving errors (the failure taxonomy of docs/serving.md).
+
+Every way a `ServeRequest` can fail resolves to exactly one subclass of
+`ServeError`, so clients can branch on *what* went wrong (retry a shed
+request, drop an expired one, page on an engine death) instead of
+grepping message strings.  All of them subclass `MXNetError`, so code
+written against the PR-7 engine ("except MXNetError") keeps working.
+
+The classes mirror the scheduler's failure scopes:
+
+* request-scoped   — `ServeOverload`, `ServeDeadlineExceeded`,
+  `ServeCancelled`, `ServeQuarantined`, `ServeTimeout` (client-side
+  wait, nothing wrong server-side)
+* batch-scoped     — `ServeCacheInvalidated` (a donated K/V buffer was
+  consumed by a failed launch: every *admitted* sequence on that replica
+  lost its context; queued requests survive)
+* replica-scoped   — `ServeEngineDead` (scheduler died / engine or
+  router stopped; queued requests fail over to surviving replicas when
+  a router owns the engine)
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = [
+    "ServeError", "ServeTimeout", "ServeOverload",
+    "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
+    "ServeCacheInvalidated", "ServeEngineDead",
+]
+
+
+class ServeError(MXNetError):
+    """Base of every typed serving failure."""
+
+
+class ServeTimeout(ServeError):
+    """`ServeRequest.result(timeout=...)` expired before the request
+    finished.  Client-side only: the request may still complete."""
+
+
+class ServeOverload(ServeError):
+    """Admission control shed the request: the queue was at
+    `MXNET_SERVE_QUEUE_MAX` under the `shed` (or deadline-bounded
+    `block`) overload policy.  Safe to retry elsewhere/later."""
+
+
+class ServeDeadlineExceeded(ServeError):
+    """The request's `deadline_ms` passed before it finished; the
+    scheduler retired it at iteration granularity (queued requests never
+    reach a prefill, running ones leave the next decode batch)."""
+
+
+class ServeCancelled(ServeError):
+    """`ServeRequest.cancel()` retired the request."""
+
+
+class ServeQuarantined(ServeError):
+    """This single request poisoned its own launch (bad shape escaping a
+    bucket, an injected launch fault) and was quarantined; the rest of
+    the batch kept decoding."""
+
+
+class ServeCacheInvalidated(ServeError):
+    """A failed launch consumed the donated K/V cache, so every admitted
+    sequence on the replica lost its context.  The engine rebuilt the
+    cache and kept serving its queue."""
+
+
+class ServeEngineDead(ServeError):
+    """The owning scheduler died (dead device, repeated launch failures)
+    or the engine/router was stopped before the request finished."""

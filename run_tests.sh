@@ -22,6 +22,15 @@ elif [ "$1" = "--serve-smoke" ]; then
     T1=""
     set -- tests/test_serving.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-chaos-smoke" ]; then
+    # fast serving-resilience smoke: deadlines/cancellation, overload
+    # policies, quarantine + cache-rebuild scoping, router failover and
+    # respawn, and the 2-replica chaos acceptance gate
+    # (docs/serving.md "Failure semantics")
+    shift
+    T1=""
+    set -- tests/test_serve_chaos.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--chaos-smoke" ]; then
     # fast single-host fault-tolerance smoke: the chaos-driven recovery
     # tests (idempotent retries, snapshot/restart, nonfinite skip,

@@ -108,4 +108,39 @@ assert rec["steady_state_retrace_events"] == 0, \
 print("serve gate passed: %s tok/s/chip, p99 %s ms, occupancy %s" % (
     rec["value"], rec["latency_ms"]["p99"], rec["batch_occupancy"]))
 PY
+
+# -- serve-chaos gate (docs/serving.md "Failure semantics") ---------------
+# the same Poisson run with one replica crashed mid-traffic, slow decode
+# steps, and injected launch errors: every request must RESOLVE (tokens
+# or a typed error — zero hung), the crash must fail over and respawn,
+# and recovery must compile nothing (the respawned replica warms from
+# the shared AOT cache); artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SERVE_REQUESTS=24 SERVE_RATE=12 SERVE_REPLICAS=2 SERVE_SEQ=64 \
+    SERVE_NEW=8 SERVE_PROMPT_MAX=16 SERVE_DEADLINE_MS=30000 \
+    MXNET_CHAOS="engine_crash:6:replica0,decode_slow:0.1:10,launch_error:0.05" \
+    python bench.py --serve --chaos | tee /tmp/nightly_serve_chaos.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_chaos.log").read().strip().splitlines()[-1])
+assert rec["hung"] == 0, "serve-chaos gate: %d hung requests" % rec["hung"]
+assert rec["resolved"] == rec["requests"], \
+    "serve-chaos gate: %s/%s requests resolved (errors: %s)" % (
+        rec["resolved"], rec["requests"], rec.get("errors"))
+assert rec["resilience"].get("failovers", 0) >= 1, \
+    "serve-chaos gate: injected crash never failed over (%s)" % \
+    rec["resilience"]
+assert rec["steady_state_recompiles"] == 0, \
+    "serve-chaos gate: %d recompiles after failover" \
+    % rec["steady_state_recompiles"]
+assert rec["steady_state_retrace_events"] == 0, \
+    "serve-chaos gate: retrace watchdog fired %d times" \
+    % rec["steady_state_retrace_events"]
+print("serve-chaos gate passed: %s/%s resolved, resilience %s, "
+      "deadline hit_rate %s" % (rec["resolved"], rec["requests"],
+                                rec["resilience"],
+                                rec["deadline"]["hit_rate"]))
+PY
 echo "nightly: all gates passed"

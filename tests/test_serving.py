@@ -13,7 +13,15 @@ The contracts under test, in dependency order:
    `serve.aot.compiles` static).
 4. Scale-out: a 2-replica router on the CPU mesh completes everything it
    admits, on two distinct devices.
+5. Failure semantics (docs/serving.md): every request resolves with
+   tokens or a TYPED ServeError — deadlines/cancellation retire at
+   iteration granularity, overload policies bound the queue, launch
+   failures stay scoped (quarantine / cache rebuild) unless the device
+   is gone, and a dead replica fails over to survivors (+ respawn off
+   the shared AOT cache, compiling nothing).
 """
+import time
+
 import numpy as np
 import pytest
 
@@ -25,7 +33,10 @@ from mxnet_tpu.base import MXNetError
 from mxnet_tpu.models.transformer import get_transformer_lm
 from mxnet_tpu.ops.attention import decode_attention
 from mxnet_tpu.serving import (ReplicaRouter, ServingEngine,
-                               TransformerKVModel)
+                               TransformerKVModel, ServeTimeout,
+                               ServeOverload, ServeDeadlineExceeded,
+                               ServeCancelled, ServeQuarantined,
+                               ServeCacheInvalidated, ServeEngineDead)
 
 V, S, L, H, E = 61, 32, 2, 2, 32
 
@@ -248,27 +259,241 @@ def test_scheduler_death_fails_requests_not_hangs(model_and_params,
         eng.submit([4, 5])
 
 
-def test_prefill_launch_failure_is_scheduler_fatal(model_and_params,
-                                                   monkeypatch):
-    """A failure of the DONATING prefill launch may have invalidated the
-    K/V cache: it must kill the scheduler (failing the request loudly),
-    not be swallowed as a poison request while the engine limps on toward
-    an 'Array has been deleted' one step later."""
+def test_prefill_launch_failure_quarantines_when_cache_survives(
+        model_and_params, monkeypatch):
+    """Scoped failure: a prefill launch that fails WITHOUT consuming the
+    donated K/V cache poisons only its own request — typed
+    `ServeQuarantined`, engine stays up, the rest of the traffic serves
+    (the PR-7 behavior killed the whole scheduler here)."""
     model, params = model_and_params
     eng = _engine(model, params)
     eng.warmup()
+    real = eng._compiled_prefill
+    poison = [True]
 
-    def bad_compiled(*a, **k):
-        raise RuntimeError("launch blew up")
+    def flaky(s):
+        compiled = real(s)
 
-    monkeypatch.setattr(eng, "_compiled_prefill", lambda s: bad_compiled)
+        def call(*a, **k):
+            if poison[0]:
+                poison[0] = False
+                raise RuntimeError("launch blew up")
+            return compiled(*a, **k)
+
+        return call
+
+    monkeypatch.setattr(eng, "_compiled_prefill", flaky)
     eng.start()
-    req = eng.submit([1, 2, 3])
-    with pytest.raises(MXNetError, match="launch blew up"):
-        req.result(timeout=60)
+    bad = eng.submit([1, 2, 3])
+    with pytest.raises(ServeQuarantined, match="launch blew up"):
+        bad.result(timeout=60)
+    ok = eng.submit([4, 5], max_new_tokens=2)
+    assert len(ok.result(timeout=60)) == 2  # engine survived the poison
     eng.stop()
-    with pytest.raises(MXNetError, match="scheduler died"):
-        eng.submit([4, 5])
+    assert eng._dead is None
+    assert telemetry.registry().counter("serve.quarantined").value == 1
+
+
+def test_cache_invalidation_rebuilds_and_keeps_serving(model_and_params,
+                                                       monkeypatch):
+    """A launch that CONSUMED the donated cache fails every admitted
+    sequence with `ServeCacheInvalidated`, rebuilds the buffer, and keeps
+    serving the queue — compiling nothing new (rebuild is a device_put,
+    not a recompile)."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_batch=2)
+    eng.warmup()
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    real = eng._compiled_decode
+    armed = [True]
+
+    def bomb(b):
+        compiled = real(b)
+
+        def call(params_, cache, tok, pos, slots):
+            if armed[0]:
+                armed[0] = False
+                cache.delete()  # the donation landed, then the launch died
+                raise RuntimeError("launch exploded mid-donation")
+            return compiled(params_, cache, tok, pos, slots)
+
+        return call
+
+    monkeypatch.setattr(eng, "_compiled_decode", bomb)
+    lost = [eng.submit([3 + i, 5], max_new_tokens=4) for i in range(2)]
+    eng.run_until_idle(timeout=300)
+    for r in lost:
+        with pytest.raises(ServeCacheInvalidated):
+            r.result(timeout=1)
+    ok = eng.submit([7, 8], max_new_tokens=2)
+    eng.run_until_idle(timeout=300)
+    assert len(ok.result(timeout=1)) == 2
+    assert eng._dead is None
+    assert reg.counter("serve.cache_rebuilds").value == 1
+    assert reg.counter("serve.aot.compiles").value == compiles
+
+
+def test_quarantine_leaves_surviving_rows_batch_invariant(model_and_params,
+                                                          monkeypatch):
+    """Mid-batch quarantine parity: poisoning ONE admission while a batch
+    is decoding must not change any surviving sequence's greedy output
+    (the admit/retire-parity contract extended to the failure path)."""
+    model, params = model_and_params
+    rng = np.random.RandomState(11)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (4, 6, 3)]
+    eng = _engine(model, params, max_batch=3)
+    eng.warmup()
+    good = [eng.submit(p, max_new_tokens=5) for p in prompts[:2]]
+    for _ in range(2):
+        eng.step()
+    real = eng._compiled_prefill
+    poison = [True]
+
+    def flaky(s):
+        compiled = real(s)
+
+        def call(*a, **k):
+            if poison[0]:
+                poison[0] = False
+                raise RuntimeError("poisoned admission")
+            return compiled(*a, **k)
+
+        return call
+
+    monkeypatch.setattr(eng, "_compiled_prefill", flaky)
+    bad = eng.submit(prompts[2], max_new_tokens=5)
+    late = eng.submit(list(rng.randint(0, V, size=5)), max_new_tokens=3)
+    eng.run_until_idle(timeout=300)
+    with pytest.raises(ServeQuarantined):
+        bad.result(timeout=1)
+    for p, r in zip(prompts[:2], good):
+        assert r.result(timeout=1) == _oracle(model, params, p, max_new=5)
+    assert late.result(timeout=1) == _oracle(
+        model, params, late.prompt, max_new=3)
+
+
+# ---------------------------------------------------------------------------
+# 2b. deadlines, cancellation, admission control
+# ---------------------------------------------------------------------------
+
+def test_result_timeout_and_deadline_are_typed(model_and_params):
+    """result(timeout) raises ServeTimeout; an expired queued request is
+    retired with ServeDeadlineExceeded at the next iteration, costing no
+    prefill dispatch."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    req = eng.submit([1, 2], deadline_ms=1)
+    with pytest.raises(ServeTimeout):
+        req.result(timeout=0.01)  # engine not stepping: client-side wait
+    time.sleep(0.01)
+    eng.step()
+    with pytest.raises(ServeDeadlineExceeded):
+        req.result(timeout=1)
+    assert eng.stats["prefills"] == 0  # shed before any dispatch
+    assert telemetry.registry().counter("serve.expired").value == 1
+
+
+def test_deadline_expires_mid_decode(model_and_params):
+    """An ACTIVE sequence whose deadline passes leaves the batch at the
+    next iteration (typed error, partial tokens preserved on the request,
+    slot freed)."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_batch=2)
+    req = eng.submit([1, 2, 3], max_new_tokens=6, deadline_ms=60000)
+    eng.step()          # prefill + first decode
+    assert len(req.tokens) >= 1
+    req.t_deadline = time.perf_counter() - 1.0  # force expiry
+    eng.step()
+    with pytest.raises(ServeDeadlineExceeded):
+        req.result(timeout=1)
+    assert not eng._active and len(eng._free) == eng.max_batch
+
+
+def test_cancel_retires_at_iteration_granularity(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params, max_batch=2)
+    rng = np.random.RandomState(4)
+    keep_p = list(rng.randint(0, V, size=4))
+    keep = eng.submit(keep_p, max_new_tokens=4)
+    victim = eng.submit([5, 6], max_new_tokens=6)
+    eng.step()
+    victim.cancel()
+    eng.run_until_idle(timeout=300)
+    with pytest.raises(ServeCancelled):
+        victim.result(timeout=1)
+    # the survivor's greedy output is untouched by its neighbour leaving
+    assert keep.result(timeout=1) == _oracle(model, params, keep_p,
+                                             max_new=4)
+    assert telemetry.registry().counter("serve.cancelled").value == 1
+
+
+def test_overload_shed_and_degrade(model_and_params):
+    """Bounded queue: `shed` raises typed ServeOverload at admission;
+    `degrade` admits but caps max_new_tokens under pressure."""
+    model, params = model_and_params
+    eng = _engine(model, params, queue_max=2, overload="shed")
+    eng.submit([1])
+    eng.submit([2])
+    with pytest.raises(ServeOverload):
+        eng.submit([3])
+    assert telemetry.registry().counter("serve.shed").value == 1
+
+    deg = _engine(model, params, queue_max=1, overload="degrade",
+                  max_new_tokens=8)
+    deg.submit([1])                       # fills the bounded queue
+    capped = deg.submit([2], max_new_tokens=8)
+    assert capped.max_new_tokens == 2     # max(1, 8 // 4)
+    deg.run_until_idle(timeout=300)
+    assert len(capped.result(timeout=1)) == 2
+    assert telemetry.registry().counter("serve.degraded").value == 1
+
+    with pytest.raises(MXNetError, match="overload policy"):
+        _engine(model, params, overload="panic")
+
+
+def test_overload_block_policy_drains(model_and_params):
+    """`block` admission waits for queue room instead of shedding; with a
+    live scheduler every submit eventually lands and completes."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_batch=2, queue_max=1,
+                  overload="block", max_new_tokens=2)
+    eng.warmup()
+    eng.start()
+    try:
+        reqs = [eng.submit([1 + i]) for i in range(5)]
+        outs = [r.result(timeout=120) for r in reqs]
+    finally:
+        eng.stop()
+    assert all(len(o) == 2 for o in outs)
+
+
+def test_submit_after_stop_raises_immediately(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    eng.start()
+    eng.stop()
+    with pytest.raises(ServeEngineDead, match="stopped"):
+        eng.submit([1, 2])
+    router = ReplicaRouter([_engine(model, params)], respawn=False)
+    router.stop()
+    with pytest.raises(ServeEngineDead, match="stopped"):
+        router.submit([1, 2])
+
+
+def test_run_until_idle_timeout_honored_with_dead_thread(model_and_params,
+                                                         monkeypatch):
+    """The router drain must honor its timeout as a WHOLE-drain bound,
+    including when a replica can never drain (dead scheduler thread or a
+    wedged step)."""
+    model, params = model_and_params
+    engines = [_engine(model, params) for _ in range(2)]
+    router = ReplicaRouter(engines, respawn=False)
+    monkeypatch.setattr(engines[0], "step", lambda: 1)  # never drains
+    t0 = time.perf_counter()
+    with pytest.raises(ServeTimeout):
+        router.run_until_idle(timeout=0.3)
+    assert time.perf_counter() - t0 < 5  # one shared budget, not n x t
 
 
 def test_unsorted_bucket_kwargs_normalized(model_and_params):
@@ -292,15 +517,17 @@ def test_unsorted_bucket_kwargs_normalized(model_and_params):
 
 def test_router_skips_dead_replica(model_and_params, monkeypatch):
     """One replica's scheduler dying must not black-hole the router:
-    least-depth dispatch skips dead engines while any replica lives."""
+    least-depth dispatch skips dead engines while any replica lives.
+    (respawn=False keeps the dead replica dead for determinism — the
+    respawn path has its own test.)"""
     model, params = model_and_params
     engines = [_engine(model, params, max_batch=2, max_new_tokens=2)
                for _ in range(2)]
-    router = ReplicaRouter(engines)
+    router = ReplicaRouter(engines, respawn=False)
     router.warmup()
 
     def boom(b_bucket):
-        raise RuntimeError("replica0 exploded")
+        raise RuntimeError("replica0 device exploded")
 
     monkeypatch.setattr(engines[0], "_compiled_decode", boom)
     router.start()
@@ -315,6 +542,88 @@ def test_router_skips_dead_replica(model_and_params, monkeypatch):
     assert all(len(o) == 2 for o in outs)
     assert engines[0]._dead is not None
     assert engines[1].stats["completed"] == 4
+
+
+def test_router_redispatches_queued_requests_on_death(model_and_params,
+                                                      monkeypatch):
+    """Failover: a dying replica's queued-but-not-admitted requests move
+    to survivors (same ServeRequest objects — deadlines ride along) and
+    complete there; the admitted one fails typed (its K/V died with the
+    cache)."""
+    model, params = model_and_params
+    engines = [_engine(model, params, max_batch=1, max_new_tokens=2),
+               _engine(model, params, max_batch=2, max_new_tokens=2)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()
+
+    def boom(b_bucket):
+        raise RuntimeError("replica0 device gone")
+
+    monkeypatch.setattr(engines[0], "_compiled_decode", boom)
+    rng = np.random.RandomState(9)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (3, 5, 4, 6)]
+    # all queued on replica0 BEFORE it runs: max_batch=1 admits only the
+    # first; the rest are queued-but-not-admitted when it dies
+    reqs = [engines[0].submit(p) for p in prompts]
+    router.start()
+    try:
+        with pytest.raises(ServeEngineDead):
+            reqs[0].result(timeout=60)
+        outs = [r.result(timeout=60) for r in reqs[1:]]
+    finally:
+        router.stop()
+    for p, o in zip(prompts[1:], outs):
+        assert o == _oracle(model, params, p, max_new=2)
+    reg = telemetry.registry()
+    assert reg.counter("serve.failovers").value == 1
+    assert reg.counter("serve.redispatched").value == 3
+    assert engines[1].stats["completed"] == 3
+
+
+def test_router_respawns_dead_replica_compiling_nothing(model_and_params,
+                                                        monkeypatch):
+    """Background respawn: the router replaces a dead replica with a
+    fresh engine on the same device that warms from the SHARED AotCache —
+    `serve.aot.compiles` stays at its warmup value, the zero-retrace gate
+    holds, and traffic completes on the respawned replica."""
+    model, params = model_and_params
+    engines = [_engine(model, params, max_batch=2, max_new_tokens=2)
+               for _ in range(2)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=True)
+    router.warmup()
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+
+    def boom(b_bucket):
+        raise RuntimeError("replica0 device gone")
+
+    monkeypatch.setattr(engines[0], "_compiled_decode", boom)
+    router.start()
+    try:
+        doomed = engines[0].submit([1, 2])
+        with pytest.raises(ServeEngineDead):
+            doomed.result(timeout=60)
+        deadline = time.perf_counter() + 30
+        while router.engines[0] is engines[0]:
+            assert time.perf_counter() < deadline, "respawn never happened"
+            time.sleep(0.05)
+        fresh = router.engines[0]
+        assert fresh.name == "replica0" and fresh._dead is None
+        assert fresh._aot is engines[0]._aot  # shared compiled set
+        # the respawned replica itself serves (submit directly to it)
+        req = fresh.submit([4, 5])
+        assert len(req.result(timeout=60)) == 2
+    finally:
+        router.stop()
+    assert reg.counter("serve.respawns").value == 1
+    assert reg.counter("serve.aot.compiles").value == compiles
+    serving_events = [e for e in telemetry.events("retrace")
+                      if str(e.get("site", "")).startswith("serving.")]
+    assert serving_events == []
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,17 @@ SERVE_COUNTERS = ("serve.requests", "serve.completed", "serve.tokens",
                   "serve.decode_padded", "serve.aot.compiles",
                   "serve.aot.hits", "serve.engine_failures")
 
+# serving resilience accounting (docs/serving.md "Failure semantics"):
+# the SLO/failover counters + the failover/respawn event kinds
+SERVE_RESILIENCE_COUNTERS = (
+    "serve.shed", "serve.expired", "serve.cancelled", "serve.degraded",
+    "serve.quarantined", "serve.cache_rebuilds", "serve.launch_errors",
+    "serve.failovers", "serve.redispatched", "serve.respawns",
+    "serve.chaos_flooded", "serve.block_waits")
+SERVE_RESILIENCE_EVENT_KINDS = (
+    "serve_failover", "serve_respawn", "serve_respawn_failed",
+    "serve_respawn_compiled", "serve_cache_rebuild", "serve_quarantine")
+
 
 def load(path):
     records = []
@@ -176,6 +187,18 @@ def summarize(records):
             if agg:
                 serving[name] = agg
         out["serving"] = serving
+    resilience = {k: int(final.get(k, 0))
+                  for k in SERVE_RESILIENCE_COUNTERS if final.get(k)}
+    for kind in SERVE_RESILIENCE_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            resilience["%s_events" % kind] = n
+    age = _merge_hists(records, "serve.queue_age_ms")
+    if age:
+        resilience["serve.queue_age_ms"] = age
+    if resilience:
+        out["resilience"] = resilience
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -216,6 +239,17 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %s" % (key, v))
+    resilience = summary.get("resilience")
+    if resilience:
+        lines.append("  resilience:")
+        for key in sorted(resilience):
+            v = resilience[key]
+            if isinstance(v, dict):
+                lines.append("    %-24s n=%d mean=%.1f p99<=%.1f max=%.1f"
+                             % (key, v["count"], v["mean"], v["p99_max"],
+                                v["max"]))
+            else:
+                lines.append("    %-24s %d" % (key, v))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "
