@@ -618,7 +618,8 @@ def serve_bench(record=True, with_chaos=False):
     if with_chaos:
         os.environ.setdefault(
             "MXNET_CHAOS",
-            "engine_crash:%d:replica0,decode_slow:0.05:20,launch_error:0.02"
+            "engine_crash:%d:replica0,decode_slow:0.05:20,"
+            "launch_error:0.02,block_exhaust:0.05"
             % max(4, n_requests // 6))
         os.environ.setdefault("SERVE_REPLICAS", "2")
         os.environ.setdefault("SERVE_DEADLINE_MS", "10000")
@@ -656,9 +657,27 @@ def serve_bench(record=True, with_chaos=False):
     reg = telemetry.registry()
     compiles_after_warmup = reg.counter("serve.aot.compiles").value
 
-    prompts = [list(rng.randint(0, vocab,
-                                size=int(rng.randint(1, prompt_max + 1))))
-               for _ in range(n_requests)]
+    trace = os.environ.get("SERVE_TRACE", "uniform")
+    if trace == "mixed":
+        # log-normal prompt/output lengths (the realistic mixed-length
+        # traffic paging exists for): most requests short, a heavy tail
+        # near the cap — the slot cache reserves for the tail on every
+        # request, the paged cache only pays for what each one uses
+        sigma = float(os.environ.get("SERVE_TRACE_SIGMA", "0.6"))
+        def _lens(mean, cap, n):
+            mu = np.log(max(mean, 1.5)) - sigma * sigma / 2.0
+            return np.clip(np.round(rng.lognormal(mu, sigma, n)),
+                           1, cap).astype(int)
+        plens = _lens(float(os.environ.get("SERVE_PROMPT_MEAN",
+                                           str(max(2, prompt_max // 3)))),
+                      prompt_max, n_requests)
+        newlens = _lens(float(os.environ.get("SERVE_NEW_MEAN",
+                                             str(max(2, max_new // 2)))),
+                        max_new, n_requests)
+    else:
+        plens = rng.randint(1, prompt_max + 1, size=n_requests)
+        newlens = np.full(n_requests, max_new)
+    prompts = [list(rng.randint(0, vocab, size=int(n))) for n in plens]
     router.start()
     depth_samples = []
     reqs = []
@@ -667,9 +686,9 @@ def serve_bench(record=True, with_chaos=False):
     hung = 0
     t_start = time.perf_counter()
     try:
-        for p in prompts:
+        for p, m in zip(prompts, newlens):
             try:
-                reqs.append(router.submit(p, max_new_tokens=max_new))
+                reqs.append(router.submit(p, max_new_tokens=int(m)))
             except ServeOverload:
                 submit_shed += 1  # admission control shed at the door
             except ServeEngineDead:
@@ -698,6 +717,26 @@ def serve_bench(record=True, with_chaos=False):
     n_tokens = sum(len(r.tokens) for r in reqs)
     rows = sum(e.stats["decode_rows"] for e in router.engines)
     padded = sum(e.stats["decode_padded"] for e in router.engines)
+    max_concurrent = max(e.stats["max_concurrent"] for e in router.engines)
+    paged_engines = [e for e in router.engines if e._alloc is not None]
+    blocks = None
+    if paged_engines:
+        # leak check runs post-stop: every retired/failed/stranded
+        # sequence must have returned its blocks
+        blocks = {
+            "block_size": paged_engines[0].block_size,
+            "n_blocks": sum(e.n_blocks for e in paged_engines),
+            "free_min": min(e.stats["blocks_free_min"]
+                            for e in paged_engines),
+            "leaked": sum(e._alloc.capacity - e._alloc.free_blocks
+                          for e in paged_engines),
+            "prefill_chunks": sum(e.stats["prefill_chunks"]
+                                  for e in paged_engines),
+            "preemptions": sum(e.stats["preemptions"]
+                               for e in paged_engines),
+            "alloc_denied": sum(e.stats["alloc_denied"]
+                                for e in paged_engines),
+        }
     steady_retraces = [e for e in telemetry.events("retrace")
                        if str(e.get("site", "")).startswith("serving.")]
     compiles_after_run = reg.counter("serve.aot.compiles").value
@@ -718,7 +757,8 @@ def serve_bench(record=True, with_chaos=False):
                             "serve.quarantined", "serve.cache_rebuilds",
                             "serve.launch_errors", "serve.failovers",
                             "serve.redispatched", "serve.respawns",
-                            "serve.chaos_flooded")
+                            "serve.chaos_flooded", "serve.preempted",
+                            "serve.alloc_denied")
                   if reg.counter(k).value}
     result = {
         "metric": "serve_tokens_per_sec_per_chip",
@@ -758,6 +798,12 @@ def serve_bench(record=True, with_chaos=False):
         "ttft_ms": {"p50": pct(ttft, 0.50), "p99": pct(ttft, 0.99)},
         "tokens_generated": n_tokens,
         "batch_occupancy": round(rows / max(rows + padded, 1), 4),
+        "max_concurrent": max_concurrent,
+        "cache": "paged" if paged_engines else "slot",
+        "blocks": blocks,
+        "trace": trace,
+        "prompt_len_mean": round(float(np.mean(plens)), 2),
+        "output_len_mean": round(float(np.mean(newlens)), 2),
         "queue_depth": {"mean": round(float(np.mean(depth_samples)), 2),
                         "max": int(np.max(depth_samples))},
         "buckets": buckets,
@@ -770,6 +816,76 @@ def serve_bench(record=True, with_chaos=False):
         "telemetry_stream": os.path.relpath(tel_path, here),
     }
     if record:
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+def serve_mixed_bench(record=True):
+    """Slot-vs-paged cache A/B under a mixed-length log-normal trace at
+    EQUAL HBM budget (``python bench.py --serve --mixed``).
+
+    The slot run gets ``SERVE_SLOT_BATCH`` cache rows (each pinned at
+    the full S_max depth); the paged run gets exactly that memory re-cut
+    into blocks (`MXNET_SERVE_N_BLOCKS = (slot_batch+1) * ceil(S/bs)`)
+    and a ``SERVE_PAGED_BATCH`` (default 4x) row ceiling — under
+    mixed-length traffic the same HBM admits several times the
+    concurrent batch, which is the whole point of paging.  Records both
+    runs side by side (occupancy, free-block low-water mark, leak check,
+    tok/s/chip) plus the speedup into bench_results/serve_bench.json —
+    the nightly paged gate reads exactly these fields.
+    """
+    from mxnet_tpu import telemetry
+
+    slot_b = int(os.environ.get("SERVE_SLOT_BATCH", "2"))
+    paged_b = int(os.environ.get("SERVE_PAGED_BATCH", str(4 * slot_b)))
+    seq = int(os.environ.get("SERVE_SEQ", "128"))
+    bs = int(os.environ.get("MXNET_SERVE_BLOCK_SIZE", "16"))
+    n_blocks = (slot_b + 1) * -(-seq // bs)
+    runs = {}
+    # the A/B premise is the mixed-length trace at offered load >>
+    # capacity — pinned for BOTH legs (and restored after: an in-process
+    # caller's later serve_bench must not inherit them)
+    shared = {"SERVE_TRACE": "mixed", "SERVE_RATE": "0"}
+    for mode, env in (
+            ("slot", {"MXNET_SERVE_PAGED": "0",
+                      "MXNET_SERVE_MAX_BATCH": str(slot_b)}),
+            ("paged", {"MXNET_SERVE_PAGED": "1",
+                       "MXNET_SERVE_MAX_BATCH": str(paged_b),
+                       "MXNET_SERVE_N_BLOCKS": str(n_blocks),
+                       "MXNET_SERVE_BLOCK_SIZE": str(bs)})):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    slot, paged = runs["slot"], runs["paged"]
+    result = {
+        "metric": "serve_paged_vs_slot",
+        # the acceptance ratio: tok/s/chip at equal HBM budget
+        "value": round(paged["value"] / max(slot["value"], 1e-9), 3),
+        "unit": "paged/slot tok/s/chip ratio (equal HBM: %d slot rows "
+                "== %d blocks x %d)" % (slot_b + 1, n_blocks, bs),
+        "slot": slot,
+        "paged": paged,
+        "equal_hbm_token_rows": (slot_b + 1) * seq,
+        "concurrency_gain": round(
+            paged["max_concurrent"] / max(slot["max_concurrent"], 1), 3),
+        "occupancy": {"slot": slot["batch_occupancy"],
+                      "paged": paged["batch_occupancy"]},
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
         out = os.path.join(here, "bench_results", "serve_bench.json")
         os.makedirs(os.path.dirname(out), exist_ok=True)
         with open(out, "w") as f:
@@ -810,6 +926,9 @@ if __name__ == "__main__":
     if "--overlap" in sys.argv:
         overlap_bench()
     elif "--serve" in sys.argv:
-        serve_bench(with_chaos="--chaos" in sys.argv)
+        if "--mixed" in sys.argv:
+            serve_mixed_bench()
+        else:
+            serve_bench(with_chaos="--chaos" in sys.argv)
     else:
         main()

@@ -42,6 +42,12 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           one-token requests (TOTAL cap, default 256)
                           through admission control — exercises
                           MXNET_SERVE_OVERLOAD shedding under load
+    block_exhaust:P       with probability P a paged-KV block allocation
+                          attempt is denied as if the pool were empty —
+                          admission parks the request for a typed
+                          retry/shed and decode growth preempts the
+                          sequence (requeue), never a hang or a
+                          scheduler death
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
@@ -69,7 +75,7 @@ __all__ = [
     "ChaosError", "ChaosEngineCrash", "CRASH_EXIT_CODE", "enabled", "spec",
     "reset", "rpc_action", "maybe_crash_server", "grad_poison",
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
-    "serve_queue_flood",
+    "serve_queue_flood", "serve_block_exhaust",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -103,6 +109,7 @@ class _Spec:
         self.engine_crash = None          # (step_count, replica name)
         self.launch_error = 0.0           # probability per launch
         self.queue_flood = None           # (per-step rate, total cap)
+        self.block_exhaust = 0.0          # probability per allocation
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -131,6 +138,8 @@ class _Spec:
             elif kind == "queue_flood":
                 self.queue_flood = (int(parts[1]),
                                     int(parts[2]) if len(parts) > 2 else 256)
+            elif kind == "block_exhaust":
+                self.block_exhaust = float(parts[1])
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -309,6 +318,20 @@ def serve_launch_error():
     with s.lock:
         return bool(s.rng_for("launch_error").random_sample()
                     < s.launch_error)
+
+
+def serve_block_exhaust():
+    """True when the CURRENT paged-KV block allocation attempt should be
+    denied (`block_exhaust:P`): the allocator reports the pool empty
+    without touching its free list, so the engine's shed/requeue/preempt
+    handling runs against a healthy pool — proving allocation failure is
+    survivable before a real exhaustion ever happens."""
+    s = spec()
+    if s is None or s.block_exhaust <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("block_exhaust").random_sample()
+                    < s.block_exhaust)
 
 
 def serve_queue_flood():

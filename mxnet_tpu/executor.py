@@ -359,6 +359,7 @@ class AotCache:
         self._cache = {}
         self._lock = threading.Lock()
         self._compiles = 0
+        self._frozen = False
 
     @property
     def compiles(self):
@@ -382,6 +383,7 @@ class AotCache:
         ent = build()
         with self._lock:
             winner = self._cache.setdefault(key, ent)
+            frozen_miss = self._frozen and winner is ent
             if winner is ent:
                 self._compiles += 1
         # two threads can race build() for the same key; only the insert
@@ -390,7 +392,30 @@ class AotCache:
         # compare against it)
         telemetry.inc("%s.compiles" % self._name
                       if winner is ent else "%s.hits" % self._name)
+        if frozen_miss:
+            # the declared-complete set grew: same bug class the retrace
+            # watchdog diagnoses, made structural.  The compile still
+            # proceeds (refusing would escalate a bucketing bug into an
+            # engine death) but the gates fail loudly on the counter.
+            telemetry.inc("%s.frozen_compiles" % self._name)
+            telemetry.record_event("aot_frozen_compile", cache=self._name,
+                                   key=str(key)[:200])
         return winner
+
+    def freeze(self):
+        """Declare the compiled set complete (the serving engine calls
+        this after `warmup()`): any later build is counted in
+        `<name>.frozen_compiles` and recorded as an `aot_frozen_compile`
+        event — the steady-state "compiles nothing" assertion gets a
+        witness at the cache itself, independent of the watchdog's
+        signature tracking.  Idempotent; hits are unaffected."""
+        with self._lock:
+            self._frozen = True
+
+    @property
+    def frozen(self):
+        with self._lock:
+            return self._frozen
 
     def keys(self):
         with self._lock:

@@ -167,6 +167,81 @@ def decode_attention(q, k_cache, v_cache, pos, num_heads, *, scale=None):
     return out.reshape(b, e).astype(q.dtype)
 
 
+def gather_paged_kv(pool, block_tables):
+    """Materialize per-row K or V context from a paged block pool.
+
+    pool:         (n_blocks, block_size, embed) — ONE layer's K (or V)
+                  block pool; block 0 is the engine's trash block.
+    block_tables: (b, m) int32 — row r's table entry t names the pool
+                  block holding positions [t*block_size, (t+1)*block_size);
+                  unallocated tail entries point at the trash block (their
+                  positions are > pos[r], so the decode mask hides them).
+    Returns (b, m*block_size, embed): the same layout `decode_attention`
+    reads from a slot cache, reassembled by gather — paging changes WHERE
+    rows live, not what attention sees.
+    """
+    b, m = block_tables.shape
+    _, bs, e = pool.shape
+    return pool[block_tables.astype(jnp.int32)].reshape(b, m * bs, e)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, pos, num_heads,
+                           *, scale=None):
+    """`decode_attention` over a paged K/V pool: gather each row's blocks
+    by table index, then run the same single-query position-masked
+    attention.  The gather is the only extra work — numerics are
+    identical to the slot cache (masked tail positions contribute exact
+    zeros either way)."""
+    kc = gather_paged_kv(k_pool, block_tables)
+    vc = gather_paged_kv(v_pool, block_tables)
+    return decode_attention(q, kc, vc, pos, num_heads, scale=scale)
+
+
+def chunk_attention(q, k_cache, v_cache, start, num_heads, *, scale=None):
+    """Chunked-prefill attention: a c-token query chunk at absolute
+    positions ``start .. start+c-1`` attends to the cached prefix plus
+    itself (causal within the chunk).
+
+    The generalization between the two existing programs: c=1 degenerates
+    to `decode_attention` (one query over the cache) and start=0 with
+    c=S degenerates to the full causal forward.  Chunked prefill streams
+    a long prompt through the cache bucket-sized chunks at a time, so a
+    prompt longer than the largest prefill bucket needs no dedicated
+    compiled shape — each chunk is a fixed (1, c) program.
+
+    q:        (b, c, embed)   — query projections of the chunk
+    k_cache:  (b, S, embed)   — keys, the chunk's own rows already written
+    v_cache:  (b, S, embed)
+    start:    (b,) int        — absolute position of each row's chunk
+    Returns (b, c, embed).  f32 softmax statistics like the siblings.
+    """
+    b, c, e = q.shape
+    s = k_cache.shape[1]
+    if e % num_heads != 0:
+        raise MXNetError(
+            "chunk_attention: embed %d not divisible by num_heads %d"
+            % (e, num_heads))
+    hd = e // num_heads
+    if scale is None:
+        scale = 1.0 / float(hd) ** 0.5
+    qh = q.reshape(b, c, num_heads, hd)
+    kh = k_cache.reshape(b, s, num_heads, hd)
+    vh = v_cache.reshape(b, s, num_heads, hd)
+    scores = jnp.einsum(
+        "bchd,bshd->bhcs", qh.astype(jnp.float32), kh.astype(jnp.float32),
+        preferred_element_type=jnp.float32) * scale
+    # query i (absolute position start+i) sees cache rows j <= start+i
+    qpos = start.astype(jnp.int32)[:, None] + \
+        jnp.arange(c, dtype=jnp.int32)[None, :]          # (b, c)
+    valid = (jnp.arange(s, dtype=jnp.int32)[None, None, :]
+             <= qpos[:, :, None])                        # (b, c, s)
+    scores = jnp.where(valid[:, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhcs,bshd->bchd", p, vh.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, c, e).astype(q.dtype)
+
+
 class DecodeAttention(OpDef):
     """Symbol-level wrapper of `decode_attention` so KV-cache decode graphs
     can be expressed with the op registry (query (batch, embed), caches

@@ -6,12 +6,20 @@ package is the production path on top of it (ROADMAP item 1):
 
 * `decode.TransformerKVModel` — prefill + single-token KV-cache decode
   functions for `models/transformer.py` graphs (same parameter names, so
-  training checkpoints serve directly).
+  training checkpoints serve directly), over either a slot cache or the
+  paged block pool (`prefill_paged`/`decode_paged`).
+* `paged.BlockAllocator` — host-side free list over the fixed device
+  block pool (the vLLM PagedAttention idea): sequences hold blocks for
+  their actual length, so HBM admits by footprint, not worst case.
+* `sampling.sample_tokens` — in-graph temperature/top-k/top-p sampling
+  with a request-keyed, position-folded RNG (deterministic, batch-
+  composition-invariant; temperature 0 = greedy argmax).
 * `engine.ServingEngine` — request queue + iteration-level continuous
   batcher (Orca, OSDI '22): sequences admit/retire at step granularity,
   padded and bucketed onto a small fixed set of pre-AOT-compiled
   (batch, seq) shapes so steady state has zero recompiles (asserted via
-  the telemetry retrace watchdog).  Per-request deadlines, cancellation,
+  the telemetry retrace watchdog; chunked prefill streams long prompts
+  through the same bucket shapes).  Per-request deadlines, cancellation,
   and a bounded queue with configurable overload policy
   (``MXNET_SERVE_OVERLOAD=shed|block|degrade``) make it SLO-grade.
 * `engine.ReplicaRouter` — least-depth dispatch over per-device engine
@@ -24,12 +32,16 @@ See docs/serving.md.
 """
 from .decode import TransformerKVModel
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
+from .paged import BlockAllocator, TRASH_BLOCK
+from .sampling import sample_tokens
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
-                     ServeQuarantined, ServeCacheInvalidated,
-                     ServeEngineDead)
+                     ServeQuarantined, ServeBlocksExhausted,
+                     ServeCacheInvalidated, ServeEngineDead)
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
-           "ReplicaRouter", "ServeError", "ServeTimeout", "ServeOverload",
+           "ReplicaRouter", "BlockAllocator", "TRASH_BLOCK",
+           "sample_tokens", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
-           "ServeCacheInvalidated", "ServeEngineDead"]
+           "ServeBlocksExhausted", "ServeCacheInvalidated",
+           "ServeEngineDead"]

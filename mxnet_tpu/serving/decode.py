@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from ..ops.attention import decode_attention
+from ..ops.attention import (gather_paged_kv, paged_decode_attention,
+                             decode_attention, chunk_attention)
 from ..ops.pallas_kernels.flash_attention import flash_attention
 from ..ops.pallas_kernels.layer_norm import layer_norm
 
@@ -229,6 +230,130 @@ class TransformerKVModel:
             f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
             x = x + self._proj(params, f, p + "ffn2")
         return self._head(params, x), cache
+
+    # -- paged cache -------------------------------------------------------
+    def init_block_pool(self, n_blocks, block_size, device=None):
+        """Zeroed paged K/V pool: (num_layers, 2, n_blocks, block_size,
+        embed).  Block 0 is the trash block (serving/paged.py); like
+        `init_cache` this is also the pool-rebuild recovery allocation."""
+        shape = (self.num_layers, 2, int(n_blocks), int(block_size),
+                 self.num_embed)
+        if device is None:
+            return jnp.zeros(shape, self.dtype)
+        return jax.device_put(np.zeros(shape, self.dtype), device)
+
+    def prefill_paged(self, params, pool, tokens, start, length, tables):
+        """One chunked-prefill step over the paged pool.
+
+        tokens: (b, c) int32 — a chunk of the prompt, rows padded past
+                ``length``; c must be a multiple of the pool block size.
+        start:  (b,) int32 — the chunk's absolute start position (a
+                multiple of the block size: chunks are bucket-sized and
+                every prefill bucket is block-aligned).
+        length: (b,) int32 — real tokens in THIS chunk (>= 1).
+        tables: (b, m) int32 block tables; entries covering
+                ``start .. start+c-1`` must be allocated.
+        Returns (logits, pool): logits of each row's last real chunk
+        token (only meaningful for the prompt's final chunk — that row
+        is position ``start+length-1``, the first sampling decision),
+        and the pool with the chunk's K/V scattered in by block index.
+
+        A short prompt is the degenerate single chunk (start 0), so one
+        compiled program per chunk bucket serves both the single-shot
+        and the streaming case — chunked prefill adds no shapes.
+        Attention runs `chunk_attention` over the gathered context
+        (cached prefix + the chunk itself), which is exactly the
+        training causal mask once start=0.
+        """
+        b, c = tokens.shape
+        h, e = self.num_heads, self.num_embed
+        bs = pool.shape[3]
+        m = tables.shape[1]
+        start = start.astype(jnp.int32)
+        tables = tables.astype(jnp.int32)
+        nb = c // bs  # chunk blocks (c is a validated multiple of bs)
+        # table entries covering the chunk: start//bs + 0..nb-1 per row.
+        # A short final chunk's bucket can extend past the table width
+        # (positions >= the block-rounded cache depth — all padding rows);
+        # those entries redirect to the trash block EXPLICITLY rather
+        # than leaning on take_along_axis's out-of-bounds fill behavior.
+        ent = start[:, None] // bs + jnp.arange(nb, dtype=jnp.int32)[None]
+        blk = jnp.take_along_axis(tables, jnp.minimum(ent, m - 1), axis=1)
+        blk = jnp.where(ent < m, blk, 0)                      # (b, nb)
+        positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+        x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
+                     axis=0)
+        x = x + jnp.take(params["pos_embed_weight"][0], positions, axis=0)
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            hn = layer_norm(x, params[p + "ln1_gamma"],
+                            params[p + "ln1_beta"], self.eps)
+            hf = hn.reshape(-1, e)
+            q = self._proj(params, hf, p + "q").reshape(b, c, e)
+            k = self._proj(params, hf, p + "k").reshape(b, c, e)
+            v = self._proj(params, hf, p + "v").reshape(b, c, e)
+            # scatter the chunk's K/V rows into their blocks, THEN gather
+            # the whole context so the chunk attends to itself too.
+            # Rows past `length` write garbage into the chunk's own
+            # blocks — never visible: decode overwrites position
+            # start+length first and every mask is `j <= own position`.
+            kw = k.reshape(b, nb, bs, e).astype(pool.dtype)
+            vw = v.reshape(b, nb, bs, e).astype(pool.dtype)
+            pool = pool.at[i, 0, blk].set(kw)
+            pool = pool.at[i, 1, blk].set(vw)
+            kc = gather_paged_kv(pool[i, 0], tables)          # (b, m*bs, e)
+            vc = gather_paged_kv(pool[i, 1], tables)
+            attn = chunk_attention(q, kc, vc, start, h)
+            x = x + self._proj(params, attn.reshape(-1, e),
+                               p + "attn_out").reshape(b, c, e)
+            hn = layer_norm(x, params[p + "ln2_gamma"],
+                            params[p + "ln2_beta"], self.eps)
+            f = jax.nn.gelu(self._proj(params, hn.reshape(-1, e),
+                                       p + "ffn1"))
+            x = x + self._proj(params, f, p + "ffn2").reshape(b, c, e)
+        last = jnp.take_along_axis(
+            x, (length.astype(jnp.int32) - 1)[:, None, None], axis=1
+        )[:, 0, :]
+        return self._head(params, last), pool
+
+    def decode_paged(self, params, pool, token, pos, tables):
+        """One generation step over the paged pool (the block-table
+        counterpart of `decode`).
+
+        pool:   (num_layers, 2, n_blocks, block_size, embed), donated.
+        token:  (b,) int32 — each row's current token.
+        pos:    (b,) int32 — the position ``token`` occupies; its block
+                (``tables[r, pos // block_size]``) must be allocated.
+        tables: (b, m) int32 — block tables; padding rows are all-trash
+                with pos 0, so their scatter lands in the trash block.
+        Returns (logits (b, vocab), new_pool).
+        """
+        e = self.num_embed
+        bs = pool.shape[3]
+        pos = pos.astype(jnp.int32)
+        tables = tables.astype(jnp.int32)
+        blk = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                                  axis=1)[:, 0]               # (b,)
+        off = pos % bs
+        x = jnp.take(params["embed_weight"], token.astype(jnp.int32), axis=0)
+        x = x + jnp.take(params["pos_embed_weight"][0], pos, axis=0)
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            hn = layer_norm(x, params[p + "ln1_gamma"],
+                            params[p + "ln1_beta"], self.eps)
+            q = self._proj(params, hn, p + "q")
+            k = self._proj(params, hn, p + "k")
+            v = self._proj(params, hn, p + "v")
+            pool = pool.at[i, 0, blk, off].set(k.astype(pool.dtype))
+            pool = pool.at[i, 1, blk, off].set(v.astype(pool.dtype))
+            attn = paged_decode_attention(q, pool[i, 0], pool[i, 1],
+                                          tables, pos, self.num_heads)
+            x = x + self._proj(params, attn, p + "attn_out")
+            hn = layer_norm(x, params[p + "ln2_gamma"],
+                            params[p + "ln2_beta"], self.eps)
+            f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
+            x = x + self._proj(params, f, p + "ffn2")
+        return self._head(params, x), pool
 
     def write_prefill(self, cache, kv, length, slots):
         """Scatter a prefill's (num_layers, 2, b, s, embed) K/V block into

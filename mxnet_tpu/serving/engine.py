@@ -20,11 +20,25 @@ counters) and every launch feeds the PR-2 retrace watchdog
 "no recompiles after warmup" is an asserted property
 (tests/test_serving.py), not a hope.
 
-The K/V cache is one (L, 2, max_batch+1, S_max, E) buffer DONATED through
-each compiled call — decode updates it in place; slot ``max_batch`` is
-the trash slot padding rows write into.  Sampling (greedy argmax) runs
-inside the compiled step, so the only per-step host traffic is the bucket
-of sampled token ids the scheduler needs for EOS/retire decisions.
+The K/V cache is PAGED by default (``MXNET_SERVE_PAGED=0`` restores the
+slot cache bit-for-bit): a fixed block pool
+(L, 2, n_blocks, block_size, E) DONATED through each compiled call, with
+per-row int32 block tables and a host-side free-list allocator
+(serving/paged.py).  Admission is free-block accounting — a sequence
+holds blocks for its ACTUAL length, so at equal HBM mixed-length traffic
+admits a strictly larger concurrent batch than the slot cache's
+worst-case rows.  Growth is one block at a time; a denied growth
+preempts (blocks freed, request requeued with its generated tokens —
+deterministic replay makes preemption invisible in the output).  Prompts
+longer than the largest prefill bucket stream through the pool in
+bucket-sized CHUNKS (one per iteration once decoding — the Sarathi
+ttft-interference bound), so the out-of-range rejection path is gone.
+
+Sampling runs inside the compiled step — greedy argmax, or per-request
+temperature/top-k/top-p with a request-keyed position-folded RNG
+(serving/sampling.py) when ``MXNET_SERVE_SAMPLING`` programs are built —
+so the only per-step host traffic is the bucket of sampled token ids the
+scheduler needs for EOS/retire decisions.
 
 Failure model (docs/serving.md "Failure semantics"): partial failure is
 the normal case, not an engine-killing event.  Every request carries an
@@ -56,10 +70,16 @@ from .. import telemetry
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
+from .paged import BlockAllocator, TRASH_BLOCK
+from .sampling import sample_tokens
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
-                     ServeQuarantined, ServeCacheInvalidated,
-                     ServeEngineDead)
+                     ServeQuarantined, ServeBlocksExhausted,
+                     ServeCacheInvalidated, ServeEngineDead)
+
+
+def _env_flag(name, default="1"):
+    return os.environ.get(name, default).lower() not in ("0", "false", "no")
 
 
 class _EngineFatal(Exception):
@@ -94,7 +114,8 @@ class ServeRequest:
     _ids = [0]
     _ids_lock = threading.Lock()
 
-    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline_ms=None):
+    def __init__(self, prompt, max_new_tokens, eos_id=None, deadline_ms=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("ServeRequest: empty prompt")
@@ -104,6 +125,24 @@ class ServeRequest:
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        # sampling contract: temperature <= 0 is greedy argmax (the
+        # default); > 0 samples with optional top-k / nucleus filtering.
+        # The RNG is request-keyed: `seed` (default: the request id, so
+        # unseeded traffic still decodes deterministically per process)
+        # folded with each token's absolute position — batch composition
+        # and preemption are invisible to the draw sequence.
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if self.temperature < 0:
+            raise MXNetError("ServeRequest: temperature must be >= 0")
+        if self.top_k < 0:
+            raise MXNetError("ServeRequest: top_k must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise MXNetError("ServeRequest: top_p must be in (0, 1]")
+        self.seed = (self.id if seed is None else int(seed)) & 0x7FFFFFFF
+        self._resume = None       # paged preemption: (tokens, last, pos,
+        #                           n_new) to re-prefill and continue from
         self.tokens = []          # generated ids (includes eos if hit)
         self.error = None
         self.t_submit = time.perf_counter()
@@ -168,15 +207,39 @@ class ServeRequest:
 
 class _Seq:
     """Scheduler state of one active sequence: `last` is the token that
-    will be fed (and cached) at position `pos` on the next decode step."""
+    will be fed (and cached) at position `pos` on the next decode step.
+    ``blocks`` is the paged path's host-side block list (None on the
+    slot path): entry t holds cache positions [t*bs, (t+1)*bs)."""
 
-    __slots__ = ("req", "last", "pos", "n_new")
+    __slots__ = ("req", "last", "pos", "n_new", "blocks")
 
-    def __init__(self, req, last, pos):
+    def __init__(self, req, last, pos, blocks=None):
         self.req = req
         self.last = last
         self.pos = pos
         self.n_new = 1  # the prefill already sampled token #1
+        self.blocks = blocks
+
+
+class _Prefill:
+    """A paged-path admission mid-stream: ``tokens`` is everything the
+    cache must hold before decode starts (the prompt — or, after a
+    preemption, prompt + already-generated tokens), ``done`` how many of
+    them are cached so far.  One bucket-sized chunk advances per
+    scheduler iteration once the engine is decoding, so a long prompt
+    never stalls active sequences for more than one chunk (the
+    Sarathi-style piggyback); an idle engine streams chunks back to
+    back."""
+
+    __slots__ = ("req", "row", "tokens", "done", "blocks", "resume")
+
+    def __init__(self, req, row, tokens, blocks, resume=None):
+        self.req = req
+        self.row = row
+        self.tokens = tokens
+        self.done = 0
+        self.blocks = blocks
+        self.resume = resume      # (last, pos, n_new) after preemption
 
 
 _OVERLOAD_POLICIES = ("shed", "block", "degrade")
@@ -200,7 +263,9 @@ class ServingEngine:
     def __init__(self, model, params, ctx=None, max_batch=None,
                  decode_buckets=None, prefill_buckets=None,
                  max_new_tokens=None, eos_id=None, name="replica0",
-                 queue_max=None, overload=None, deadline_ms=None, aot=None):
+                 queue_max=None, overload=None, deadline_ms=None, aot=None,
+                 paged=None, block_size=None, n_blocks=None,
+                 chunk_prefill=None, sampling=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -257,13 +322,64 @@ class ServingEngine:
         self._launch_retries = max(1, int(os.environ.get(
             "MXNET_SERVE_LAUNCH_RETRIES", "3")))
 
+        # paged K/V cache (MXNET_SERVE_PAGED=0 kill-switch restores the
+        # slot cache bit-for-bit); sampling programs (MXNET_SERVE_SAMPLING
+        # =0 restores the PR-7 greedy-only program signatures)
+        self._paged = _env_flag("MXNET_SERVE_PAGED") if paged is None \
+            else bool(paged)
+        self._sampling = _env_flag("MXNET_SERVE_SAMPLING") \
+            if sampling is None else bool(sampling)
         jarr = getattr(jax, "Array", ())
         self._params = {k: jax.device_put(
             v if isinstance(v, jarr) else np.asarray(v), self._device)
             for k, v in params.items()}
-        # slot max_batch is the trash slot padding rows write into
-        self._cache = model.init_cache(self.max_batch + 1,
-                                       device=self._device)
+        if self._paged:
+            self._chunk_prefill = _env_flag("MXNET_SERVE_CHUNK_PREFILL") \
+                if chunk_prefill is None else bool(chunk_prefill)
+            bs = int(os.environ.get("MXNET_SERVE_BLOCK_SIZE", "0")
+                     if block_size is None else block_size)
+            if bs < 0:
+                raise MXNetError("ServingEngine: block_size must be >= 1")
+            if bs == 0:
+                # auto: the largest divisor of EVERY prefill bucket, capped
+                # at 16 (the vLLM-ish default) — default buckets end at
+                # seq_len itself, so e.g. seq_len=100 resolves to 4, not a
+                # constructor error
+                import math
+                g = 0
+                for s in self.prefill_buckets:
+                    g = math.gcd(g, s)
+                bs = max(d for d in range(1, min(16, g) + 1) if g % d == 0)
+            bad = [s for s in self.prefill_buckets if s % bs]
+            if bad:
+                raise MXNetError(
+                    "ServingEngine: block_size %d must divide every "
+                    "prefill bucket (violated by %s) — chunk starts and "
+                    "prefill scatters are block-aligned" % (bs, bad))
+            self.block_size = bs
+            # table width: enough entries to cover the full cache depth
+            self._n_table = -(-model.seq_len // bs)
+            nb = int(os.environ.get("MXNET_SERVE_N_BLOCKS", "0")
+                     if n_blocks is None else n_blocks)
+            if nb == 0:
+                # default = the slot cache's exact HBM budget: the
+                # (max_batch + 1 trash) rows it would have pinned,
+                # re-cut into blocks (+ the trash block)
+                nb = (self.max_batch + 1) * self._n_table
+            self.n_blocks = nb
+            self._alloc = BlockAllocator(nb, bs)
+            self._cache = model.init_block_pool(nb, bs,
+                                                device=self._device)
+            self._prefilling = {}  # row -> _Prefill (insertion-ordered)
+        else:
+            self._chunk_prefill = False
+            self.block_size = None
+            self.n_blocks = None
+            self._alloc = None
+            # slot max_batch is the trash slot padding rows write into
+            self._cache = model.init_cache(self.max_batch + 1,
+                                           device=self._device)
+            self._prefilling = {}
         self._aot = aot if aot is not None else AotCache("serve.aot")
         # gauges are namespaced per replica: engines share one process-wide
         # registry, and a global "serve.queue_depth" written by N scheduler
@@ -286,39 +402,130 @@ class ServingEngine:
         # bench accounting (host-side, touched only by the scheduler)
         self.stats = {"decode_steps": 0, "decode_rows": 0,
                       "decode_padded": 0, "prefills": 0, "completed": 0,
-                      "tokens": 0}
+                      "tokens": 0, "prefill_chunks": 0, "preemptions": 0,
+                      "alloc_denied": 0, "max_concurrent": 0,
+                      "blocks_free_min": (self._alloc.free_blocks
+                                          if self._paged else None)}
 
     # -- program building --------------------------------------------------
+    _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
+
+    def _sample_placeholders(self, b):
+        """Per-row sampling arrays for lowering/watch signatures — empty
+        when sampling programs are disabled (the PR-7 signatures)."""
+        if not self._sampling:
+            return ()
+        return (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
+                np.ones((b,), np.float32), np.zeros((b,), np.uint32))
+
+    def _pick(self, logits, samp, newpos):
+        """The compiled program's token-selection tail.  ``newpos`` is
+        the absolute position the chosen token will occupy — the RNG
+        fold key, so chunked/unchunked prefill and preempt-resume draw
+        identical sequences.  Greedy-only programs argmax (bit-for-bit
+        the PR-7 tail)."""
+        if not self._sampling:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        temp, top_k, top_p, seed = samp
+        return sample_tokens(logits, temp, top_k, top_p, seed, newpos)
+
     def _compiled_prefill(self, s_bucket):
+        if self._paged:
+            def build():
+                def prog(params, pool, tokens, start, length, tables,
+                         *samp):
+                    logits, pool = self.model.prefill_paged(
+                        params, pool, tokens, start, length, tables)
+                    return self._pick(logits, samp, start + length), pool
+
+                fn = jax.jit(prog, donate_argnums=(1,))
+                toks = self._put(np.zeros((1, s_bucket), np.int32))
+                zero = self._put(np.zeros((1,), np.int32))
+                one = self._put(np.ones((1,), np.int32))
+                tables = self._put(np.zeros((1, self._n_table), np.int32))
+                samp = tuple(self._put(a)
+                             for a in self._sample_placeholders(1))
+                return fn.lower(self._params, self._cache, toks, zero,
+                                one, tables, *samp).compile()
+
+            return self._aot.get(("prefill_paged", 1, s_bucket), build)
+
         def build():
-            def prog(params, cache, tokens, length, slot):
+            def prog(params, cache, tokens, length, slot, *samp):
                 logits, kv = self.model.prefill(params, tokens, length)
                 cache = self.model.write_prefill(cache, kv, length, slot)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                return self._pick(logits, samp, length), cache
 
             fn = jax.jit(prog, donate_argnums=(1,))
             toks = self._put(np.zeros((1, s_bucket), np.int32))
             one = self._put(np.ones((1,), np.int32))
+            samp = tuple(self._put(a) for a in self._sample_placeholders(1))
             return fn.lower(self._params, self._cache, toks, one,
-                            one).compile()
+                            one, *samp).compile()
 
         return self._aot.get(("prefill", 1, s_bucket), build)
 
     def _compiled_decode(self, b_bucket):
+        if self._paged:
+            def build():
+                def prog(params, pool, token, pos, tables, *samp):
+                    logits, pool = self.model.decode_paged(
+                        params, pool, token, pos, tables)
+                    return self._pick(logits, samp, pos + 1), pool
+
+                fn = jax.jit(prog, donate_argnums=(1,))
+                z = self._put(np.zeros((b_bucket,), np.int32))
+                tables = self._put(np.zeros((b_bucket, self._n_table),
+                                            np.int32))
+                samp = tuple(self._put(a)
+                             for a in self._sample_placeholders(b_bucket))
+                return fn.lower(self._params, self._cache, z, z, tables,
+                                *samp).compile()
+
+            return self._aot.get(("decode_paged", b_bucket, 1), build)
+
         def build():
-            def prog(params, cache, token, pos, slots):
+            def prog(params, cache, token, pos, slots, *samp):
                 logits, cache = self.model.decode(params, cache, token,
                                                   pos, slots)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                return self._pick(logits, samp, pos + 1), cache
 
             fn = jax.jit(prog, donate_argnums=(1,))
             z = self._put(np.zeros((b_bucket,), np.int32))
-            return fn.lower(self._params, self._cache, z, z, z).compile()
+            samp = tuple(self._put(a)
+                         for a in self._sample_placeholders(b_bucket))
+            return fn.lower(self._params, self._cache, z, z, z,
+                            *samp).compile()
 
         return self._aot.get(("decode", b_bucket, 1), build)
 
     def _put(self, a):
         return jax.device_put(a, self._device)
+
+    def _prefill_watch_arrays(self, s):
+        """(arrays, names) of a prefill launch at bucket ``s`` — the
+        watchdog signature warmup seeds and live launches must match."""
+        toks = np.zeros((1, s), np.int32)
+        one = np.ones((1,), np.int32)
+        samp = self._sample_placeholders(1)
+        if self._paged:
+            tables = np.zeros((1, self._n_table), np.int32)
+            return ((toks, one, one, tables) + samp,
+                    ("tokens", "start", "length", "tables")
+                    + self._SAMPLE_NAMES[:len(samp)])
+        return ((toks, one, one) + samp,
+                ("tokens", "length", "slot") + self._SAMPLE_NAMES[:len(samp)])
+
+    def _decode_watch_arrays(self, b):
+        z = np.zeros((b,), np.int32)
+        samp = self._sample_placeholders(b)
+        if self._paged:
+            tables = np.zeros((b, self._n_table), np.int32)
+            return ((z, z, tables) + samp,
+                    ("token", "pos", "tables")
+                    + self._SAMPLE_NAMES[:len(samp)])
+        return ((z, z, z) + samp,
+                ("token", "pos", "slots") + self._SAMPLE_NAMES[:len(samp)])
 
     def warmup(self):
         """AOT-compile every bucket shape up front, and pre-seed the
@@ -328,20 +535,24 @@ class ServingEngine:
         bucketing fires an event).  After warmup, `serve.aot.compiles`
         advancing or a `serving.*` retrace event means exactly that bug.
         A respawned replica warms from the dead incarnation's shared
-        AotCache, so recovery hits every key and compiles nothing."""
+        AotCache, so recovery hits every key and compiles nothing.
+        The cache is also FROZEN here: any later build additionally
+        counts `serve.aot.frozen_compiles` — the zero-steady-state-
+        compile gate, asserted at the cache itself.  Chunked prefill
+        adds no shapes: every chunk is one of these prefill buckets."""
         for s in self.prefill_buckets:
             self._compiled_prefill(s)
-            toks = np.zeros((1, s), np.int32)
-            one = np.ones((1,), np.int32)
-            self._watch("prefill", (toks, one, one),
-                        ("tokens", "length", "slot"), s, seed=True)
+            arrays, names = self._prefill_watch_arrays(s)
+            self._watch("prefill", arrays, names, s, seed=True)
         for b in self.decode_buckets:
             self._compiled_decode(b)
-            z = np.zeros((b,), np.int32)
-            self._watch("decode", (z, z, z), ("token", "pos", "slots"), b,
-                        seed=True)
+            arrays, names = self._decode_watch_arrays(b)
+            self._watch("decode", arrays, names, b, seed=True)
+        self._aot.freeze()
         return {"prefill": list(self.prefill_buckets),
-                "decode": list(self.decode_buckets)}
+                "decode": list(self.decode_buckets),
+                "cache": "paged" if self._paged else "slot",
+                "block_size": self.block_size, "n_blocks": self.n_blocks}
 
     def respawn(self):
         """A replacement engine for this (dead) replica: same device,
@@ -357,11 +568,15 @@ class ServingEngine:
             max_new_tokens=self.max_new_default, eos_id=self.eos_id,
             name=self.name, queue_max=self._queue_max,
             overload=self._overload,
-            deadline_ms=self._deadline_ms_default, aot=self._aot)
+            deadline_ms=self._deadline_ms_default, aot=self._aot,
+            paged=self._paged, block_size=self.block_size,
+            n_blocks=self.n_blocks, chunk_prefill=self._chunk_prefill,
+            sampling=self._sampling)
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, _count_shed=True):
+               deadline_ms=None, temperature=0.0, top_k=0, top_p=1.0,
+               seed=None, _count_shed=True):
         if max_new_tokens is None:
             max_new_tokens = self.max_new_default
         elif int(max_new_tokens) < 1:
@@ -371,10 +586,19 @@ class ServingEngine:
                              "got %s" % max_new_tokens)
         if deadline_ms is None:
             deadline_ms = self._deadline_ms_default
+        if temperature and not self._sampling:
+            raise MXNetError(
+                "ServingEngine: sampling programs are disabled "
+                "(MXNET_SERVE_SAMPLING=0) — temperature > 0 unsupported")
         req = ServeRequest(prompt, max_new_tokens,
                            self.eos_id if eos_id is None else eos_id,
-                           deadline_ms=deadline_ms)
-        if len(req.prompt) > self.prefill_buckets[-1]:
+                           deadline_ms=deadline_ms,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed)
+        if not (self._paged and self._chunk_prefill) and \
+                len(req.prompt) > self.prefill_buckets[-1]:
+            # chunked prefill streams any prompt through bucket-sized
+            # chunks; without it the largest bucket is the hard ceiling
             raise MXNetError(
                 "ServingEngine: prompt length %d exceeds the largest "
                 "prefill bucket %d" % (len(req.prompt),
@@ -384,6 +608,24 @@ class ServingEngine:
                 "ServingEngine: prompt length %d leaves no room to "
                 "generate (seq_len %d)" % (len(req.prompt),
                                            self.model.seq_len))
+        if self._paged:
+            # a request whose WORST-CASE footprint exceeds the whole pool
+            # can only ever end in a preemption livelock — reject typed
+            # at the door (transient pressure is not this: it queues,
+            # retries, or preempts+requeues instead)
+            worst = min(len(req.prompt) + req.max_new_tokens,
+                        self.model.seq_len)
+            need = self._alloc.blocks_for(worst)
+            if need > self._alloc.capacity:
+                telemetry.inc("serve.blocks_rejected")
+                raise ServeBlocksExhausted(
+                    "ServingEngine %s: request needs up to %d cache "
+                    "blocks but the pool only has %d usable "
+                    "(n_blocks=%d, block_size=%d)"
+                    % (self.name, need, self._alloc.capacity,
+                       self.n_blocks, self.block_size))
+        telemetry.inc("serve.sampled_requests" if req.temperature > 0
+                      else "serve.greedy_requests")
         if self._queue_max > 0 and self._overload == "block":
             self._enqueue_blocking(req)
         else:
@@ -486,9 +728,11 @@ class ServingEngine:
         `_admitting` covers the window between the scheduler popping a
         request and its prefill landing in `_active` (or finishing) —
         without it a thread-driven `run_until_idle` could read depth 0
-        and declare idle while a prefill is in flight."""
+        and declare idle while a prefill is in flight.  `_prefilling`
+        (paged chunked prefills mid-stream) counts the same way."""
         with self._qlock:
-            return len(self._queue) + self._admitting + len(self._active)
+            return len(self._queue) + self._admitting + \
+                len(self._active) + len(self._prefilling)
 
     # -- scheduling --------------------------------------------------------
     def _bucket_for(self, n, buckets):
@@ -550,23 +794,92 @@ class ServingEngine:
                                request=req.id, error=msg[:200])
         req._finish(error=ServeQuarantined(msg[:500]))
 
+    def _release_blocks(self, holder):
+        """Return a seq/prefill's blocks to the pool exactly once (every
+        path a sequence leaves the cache by funnels through here — the
+        leak check is `free_blocks` returning to its initial value after
+        a drain)."""
+        if self._paged and holder.blocks is not None:
+            self._alloc.free(holder.blocks)
+            holder.blocks = None
+            self._block_gauges()
+
+    def _block_gauges(self):
+        if not self._paged:
+            return
+        free = self._alloc.free_blocks
+        if self.stats["blocks_free_min"] is None \
+                or free < self.stats["blocks_free_min"]:
+            self.stats["blocks_free_min"] = free
+        # a seq at `pos` has cached rows 0..pos-1 (its `last` token is
+        # only written at `pos` by the NEXT decode step)
+        used_tokens = sum(s.pos for s in self._active.values()) + \
+            sum(p.done for p in self._prefilling.values())
+        telemetry.set_gauge(self._gauge + "blocks_free", free)
+        telemetry.set_gauge(self._gauge + "blocks_frag",
+                            round(self._alloc.fragmentation(used_tokens),
+                                  4))
+
     def _rebuild_cache(self, reason):
         """The donated K/V buffer was consumed by a failed launch: every
         ADMITTED sequence lost its context (typed failure), the cache is
         reallocated, and the engine keeps serving its queue — scoped
-        failure, not an engine death."""
+        failure, not an engine death.  On the paged path the whole pool
+        + every block table is rebuilt: the allocator resets, active
+        sequences fail typed, and mid-prefill requests requeue for one
+        retry against the fresh pool (their cached chunks died with it)."""
         err = ServeCacheInvalidated(
             "ServingEngine %s: K/V cache invalidated (%s)"
             % (self.name, reason[:300]))
         for slot, seq in list(self._active.items()):
+            seq.blocks = None  # the pool they pointed into is gone
             self._retire_error(slot, seq, err)
-        self._cache = self.model.init_cache(self.max_batch + 1,
-                                            device=self._device)
+        if self._paged:
+            for row, pf in list(self._prefilling.items()):
+                del self._prefilling[row]
+                self._free.append(row)
+                pf.blocks = None
+                if pf.req._requeues < 1:
+                    pf.req._requeues += 1
+                    with self._qlock:
+                        self._queue.appendleft(pf.req)
+                else:
+                    self._quarantine(pf.req, "prefill lost to a cache "
+                                     "rebuild twice: %s" % reason[:200])
+            self._alloc.reset()
+            self._cache = self.model.init_block_pool(
+                self.n_blocks, self.block_size, device=self._device)
+            self._block_gauges()
+        else:
+            self._cache = self.model.init_cache(self.max_batch + 1,
+                                                device=self._device)
         self._count("cache_rebuilds")
         telemetry.record_event("serve_cache_rebuild", replica=self.name,
                                reason=reason[:200])
 
+    def _samp_device(self, reqs, b):
+        """Per-row device sampling arrays for rows ``reqs`` padded to
+        bucket ``b`` (padding rows: temperature 0 = greedy, output
+        discarded).  () when sampling programs are disabled."""
+        if not self._sampling:
+            return ()
+        temp = np.zeros((b,), np.float32)
+        tk = np.zeros((b,), np.int32)
+        tp = np.ones((b,), np.float32)
+        seed = np.zeros((b,), np.uint32)
+        for i, r in enumerate(reqs):
+            temp[i] = r.temperature
+            tk[i] = r.top_k
+            tp[i] = r.top_p
+            seed[i] = r.seed
+        return tuple(self._put(a) for a in (temp, tk, tp, seed))
+
     def _admit_one(self, req):
+        """Admit one queued request.  Returns False ONLY when a paged
+        block allocation was denied (the request went back to the queue
+        front — stop admitting this iteration)."""
+        if self._paged:
+            return self._admit_one_paged(req)
         slot = self._free.pop()
         try:
             plen = len(req.prompt)
@@ -576,8 +889,10 @@ class ServingEngine:
             toks_d = self._put(toks)
             length = self._put(np.array([plen], np.int32))
             slot_d = self._put(np.array([slot], np.int32))
-            self._watch("prefill", (toks_d, length, slot_d),
-                        ("tokens", "length", "slot"), s)
+            samp = self._samp_device([req], 1)
+            self._watch("prefill", (toks_d, length, slot_d) + samp,
+                        ("tokens", "length", "slot")
+                        + self._SAMPLE_NAMES[:len(samp)], s)
             compiled = self._compiled_prefill(s)
             if chaos.serve_launch_error():
                 raise chaos.ChaosError("chaos: injected prefill launch "
@@ -586,10 +901,10 @@ class ServingEngine:
             # nothing launched: the fault is this request's alone
             self._free.append(slot)
             self._quarantine(req, "prefill setup failed: %s" % e)
-            return
+            return True
         try:
             first, self._cache = compiled(self._params, self._cache, toks_d,
-                                          length, slot_d)
+                                          length, slot_d, *samp)
             first = int(np.asarray(first)[0])
         except Exception as e:
             self._free.append(slot)
@@ -609,9 +924,9 @@ class ServingEngine:
                 else:
                     self._quarantine(req, "prefill launch failed twice "
                                      "across a cache rebuild: %s" % e)
-                return
+                return True
             self._quarantine(req, "prefill launch failed: %s" % e)
-            return
+            return True
         telemetry.observe("serve.queue_age_ms",
                           1e3 * (time.perf_counter() - req.t_submit))
         req.t_first = time.perf_counter()
@@ -625,6 +940,166 @@ class ServingEngine:
             self._retire(slot, seq, enter=False)
         else:
             self._active[slot] = seq
+        return True
+
+    # -- paged admission / chunked prefill ---------------------------------
+    def _admit_one_paged(self, req):
+        """Paged admission: blocks for the full prompt (+ the first
+        decode write) up front, then the prompt streams through the pool
+        in bucket-sized chunks.  A denied allocation — pool pressure or
+        a `block_exhaust` chaos clause — is a typed requeue: the request
+        goes BACK to the queue front and admission stops this iteration
+        (free blocks can only appear when something retires)."""
+        row = self._free.pop()
+        tokens = req.prompt if req._resume is None else req._resume[0]
+        blocks = self._alloc.alloc(self._alloc.blocks_for(len(tokens) + 1))
+        if blocks is None:
+            self._free.append(row)
+            self.stats["alloc_denied"] += 1
+            self._count("alloc_denied")
+            with self._qlock:
+                self._queue.appendleft(req)
+            return False
+        self._block_gauges()
+        pf = _Prefill(req, row, list(tokens), blocks,
+                      resume=None if req._resume is None
+                      else req._resume[1:])
+        self._prefilling[row] = pf
+        self._advance_chunk(pf)
+        return True
+
+    def _drop_prefill(self, pf):
+        """Remove a mid-stream prefill: row and blocks return to their
+        pools; the caller resolves the request."""
+        self._prefilling.pop(pf.row, None)
+        self._free.append(pf.row)
+        self._release_blocks(pf)
+
+    def _advance_prefills(self):
+        """Advance every mid-stream chunked prefill by ONE chunk (the
+        Sarathi-style piggyback bound: a long prompt costs each decode
+        iteration at most one chunk of ttft interference per prefilling
+        request, instead of monopolizing the device until it lands)."""
+        for pf in list(self._prefilling.values()):
+            if pf.row in self._prefilling:
+                self._advance_chunk(pf)
+
+    def _advance_chunk(self, pf):
+        """Launch one prefill chunk; the final chunk moves the sequence
+        to the active set.  Failure scoping mirrors the slot path:
+        setup/scoped faults quarantine the request, cache loss rebuilds
+        the pool (requeueing every mid-prefill request, this one
+        included), device death is scheduler-fatal."""
+        req = pf.req
+        total = len(pf.tokens)
+        remaining = total - pf.done
+        largest = self.prefill_buckets[-1]
+        bucket = largest if remaining > largest else \
+            self._bucket_for(remaining, self.prefill_buckets)
+        chunk = min(remaining, bucket)
+        try:
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :chunk] = pf.tokens[pf.done:pf.done + chunk]
+            table = np.full((1, self._n_table), TRASH_BLOCK, np.int32)
+            table[0, :len(pf.blocks)] = pf.blocks
+            toks_d = self._put(toks)
+            start_d = self._put(np.array([pf.done], np.int32))
+            length_d = self._put(np.array([chunk], np.int32))
+            table_d = self._put(table)
+            samp = self._samp_device([req], 1)
+            self._watch("prefill",
+                        (toks_d, start_d, length_d, table_d) + samp,
+                        ("tokens", "start", "length", "tables")
+                        + self._SAMPLE_NAMES[:len(samp)], bucket)
+            compiled = self._compiled_prefill(bucket)
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError("chaos: injected prefill launch "
+                                       "error")
+        except Exception as e:
+            self._drop_prefill(pf)
+            self._quarantine(req, "prefill setup failed: %s" % e)
+            return
+        try:
+            tok, self._cache = compiled(self._params, self._cache, toks_d,
+                                        start_d, length_d, table_d, *samp)
+        except Exception as e:
+            kind = self._classify_failure(e)
+            if kind == "device":
+                self._drop_prefill(pf)
+                req._finish(error=ServeEngineDead(
+                    "prefill launch failed: %s" % str(e)[:400]))
+                raise _EngineFatal("prefill launch failed: %s" % e) from e
+            if kind == "cache":
+                self._rebuild_cache("prefill launch failed: %s" % e)
+                return
+            self._drop_prefill(pf)
+            self._quarantine(req, "prefill launch failed: %s" % e)
+            return
+        pf.done += chunk
+        self.stats["prefill_chunks"] += 1
+        telemetry.inc("serve.prefill_chunks")
+        if pf.done < total:
+            return
+        # prefill complete: the row becomes an active decode sequence
+        del self._prefilling[pf.row]
+        blocks, pf.blocks = pf.blocks, None
+        self.stats["prefills"] += 1
+        telemetry.inc("serve.prefills")
+        if pf.resume is None:
+            # fresh admissions only: a preempt-resume re-prefill would
+            # log its pre-preemption DECODE time as queue wait
+            telemetry.observe("serve.queue_age_ms",
+                              1e3 * (time.perf_counter() - req.t_submit))
+        if pf.resume is not None:
+            # preempt-resume: the cache rows are rebuilt; generation
+            # continues from the token the preemption interrupted (no
+            # re-sampling — the interrupted draw never happened)
+            last, pos, n_new = pf.resume
+            seq = _Seq(req, last, pos, blocks=blocks)
+            seq.n_new = n_new
+            req._resume = None
+            self._active[pf.row] = seq
+            return
+        first = int(np.asarray(tok)[0])
+        req.t_first = time.perf_counter()
+        req.tokens.append(first)
+        self.stats["tokens"] += 1
+        telemetry.inc("serve.tokens")
+        seq = _Seq(req, first, total, blocks=blocks)
+        if self._seq_finished(seq, first):
+            self._retire(pf.row, seq, enter=False)
+        else:
+            self._active[pf.row] = seq
+
+    def _grow_active(self):
+        """Before a decode step, every active row must own the block its
+        write position lands in.  A denied growth allocation PREEMPTS
+        the sequence: blocks free, the request requeues at the front
+        carrying its generated tokens, and a later re-prefill (prompt +
+        generated) rebuilds its context — greedy decoding and the
+        position-keyed sampler both replay identically, so preemption is
+        invisible in the output."""
+        for row, seq in list(self._active.items()):
+            need = seq.pos // self.block_size + 1
+            if need <= len(seq.blocks):
+                continue
+            got = self._alloc.alloc(need - len(seq.blocks))
+            if got is not None:
+                seq.blocks.extend(got)
+                self._block_gauges()
+                continue
+            del self._active[row]
+            self._free.append(row)
+            req = seq.req
+            req._resume = (list(req.prompt) + list(req.tokens[:-1]),
+                           seq.last, seq.pos, seq.n_new)
+            self._release_blocks(seq)
+            self.stats["preemptions"] += 1
+            self._count("preempted")
+            telemetry.record_event("serve_preempt", replica=self.name,
+                                   request=req.id, pos=seq.pos)
+            with self._qlock:
+                self._queue.appendleft(req)
 
     def _seq_finished(self, seq, token):
         if seq.req.eos_id is not None and token == seq.req.eos_id:
@@ -642,6 +1117,7 @@ class ServingEngine:
         if enter:
             del self._active[slot]
         self._free.append(slot)
+        self._release_blocks(seq)
         seq.req._finish()
         self.stats["completed"] += 1
         telemetry.inc("serve.completed")
@@ -652,6 +1128,7 @@ class ServingEngine:
     def _retire_error(self, slot, seq, err):
         del self._active[slot]
         self._free.append(slot)
+        self._release_blocks(seq)
         seq.req._finish(error=err)
 
     def _finish_dropped(self, req, now=None):
@@ -691,6 +1168,12 @@ class ServingEngine:
                 dropped.append(r)
                 del self._active[slot]
                 self._free.append(slot)
+                self._release_blocks(seq)
+        for pf in list(self._prefilling.values()):
+            r = pf.req
+            if r._cancelled or r.expired(now):
+                dropped.append(r)
+                self._drop_prefill(pf)
         for r in dropped:
             self._finish_dropped(r, now)
 
@@ -716,6 +1199,8 @@ class ServingEngine:
         if chaos.enabled():
             self._inject_flood()
         self._sweep()
+        if self._paged:
+            self._advance_prefills()
         while self._free:
             with self._qlock:
                 req = self._queue.popleft() if self._queue else None
@@ -729,17 +1214,24 @@ class ServingEngine:
                     # arrived expired between sweeps
                     self._finish_dropped(req)
                     continue
-                self._admit_one(req)
+                if self._admit_one(req) is False:
+                    break  # block pool can't admit more this iteration
             finally:
                 with self._qlock:
                     self._admitting -= 1
         with self._qlock:
             telemetry.set_gauge(self._gauge + "queue_depth",
                                 len(self._queue))
+        if self._paged:
+            self._grow_active()
         n = len(self._active)
+        if n > self.stats["max_concurrent"]:
+            self.stats["max_concurrent"] = n
         telemetry.set_gauge(self._gauge + "active", n)
         if n == 0:
-            return 0
+            # mid-stream chunked prefills still count as work: the
+            # scheduler keeps stepping until they land
+            return len(self._prefilling)
         if chaos.enabled():
             if chaos.serve_engine_crash(self.name):
                 raise chaos.ChaosEngineCrash(
@@ -752,28 +1244,36 @@ class ServingEngine:
         seqs = [self._active[s] for s in slots]
         token = np.zeros((b,), np.int32)
         pos = np.zeros((b,), np.int32)
-        slot_ids = np.full((b,), self.max_batch, np.int32)  # trash slot
-        for i, (slot, seq) in enumerate(zip(slots, seqs)):
-            token[i] = seq.last
-            pos[i] = seq.pos
-            slot_ids[i] = slot
-        tok_d, pos_d, slot_d = (self._put(token), self._put(pos),
-                                self._put(slot_ids))
-        self._watch("decode", (tok_d, pos_d, slot_d),
-                    ("token", "pos", "slots"), b)
+        if self._paged:
+            tables = np.full((b, self._n_table), TRASH_BLOCK, np.int32)
+            for i, seq in enumerate(seqs):
+                token[i] = seq.last
+                pos[i] = seq.pos
+                tables[i, :len(seq.blocks)] = seq.blocks
+            extra, names = (self._put(tables),), ("token", "pos", "tables")
+        else:
+            slot_ids = np.full((b,), self.max_batch, np.int32)  # trash slot
+            for i, (slot, seq) in enumerate(zip(slots, seqs)):
+                token[i] = seq.last
+                pos[i] = seq.pos
+                slot_ids[i] = slot
+            extra, names = (self._put(slot_ids),), ("token", "pos", "slots")
+        samp = self._samp_device([s.req for s in seqs], b)
+        args = (self._put(token), self._put(pos)) + extra + samp
+        self._watch("decode", args,
+                    names + self._SAMPLE_NAMES[:len(samp)], b)
         compiled = self._compiled_decode(b)
         try:
             if chaos.serve_launch_error():
                 raise chaos.ChaosError("chaos: injected decode launch error")
-            nxt, self._cache = compiled(self._params, self._cache, tok_d,
-                                        pos_d, slot_d)
+            nxt, self._cache = compiled(self._params, self._cache, *args)
         except Exception as e:
             kind = self._classify_failure(e)
             if kind == "device":
                 raise _EngineFatal("decode launch failed: %s" % e) from e
             if kind == "cache":
                 self._rebuild_cache("decode launch failed: %s" % e)
-                return len(self._active)
+                return len(self._active) + len(self._prefilling)
             # scoped/transient: the donated cache survived — retry the
             # same decode next iteration, escalate after N consecutive
             self._launch_fails += 1
@@ -782,7 +1282,7 @@ class ServingEngine:
                 raise _EngineFatal(
                     "decode launch failed %d consecutive times (last: %s)"
                     % (self._launch_fails, e)) from e
-            return len(self._active)
+            return len(self._active) + len(self._prefilling)
         self._launch_fails = 0
         nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
         self.stats["decode_steps"] += 1
@@ -801,7 +1301,7 @@ class ServingEngine:
             seq.n_new += 1
             if self._seq_finished(seq, t):
                 self._retire(slot, seq)
-        return len(self._active)
+        return len(self._active) + len(self._prefilling)
 
     # -- worker loop -------------------------------------------------------
     def start(self):
@@ -846,6 +1346,9 @@ class ServingEngine:
                               % (self.name, msg))
         for slot, seq in list(self._active.items()):
             self._retire_error(slot, seq, err)
+        for pf in list(self._prefilling.values()):
+            self._drop_prefill(pf)
+            pf.req._finish(error=err)
         with self._qlock:
             # mark dead and drain atomically: _enqueue checks _dead under
             # this lock, so everything it enqueued is in `pending` and
@@ -891,6 +1394,9 @@ class ServingEngine:
             self._queue.clear()
         for slot, seq in list(self._active.items()):
             self._retire_error(slot, seq, err)
+        for pf in list(self._prefilling.values()):
+            self._drop_prefill(pf)
+            pf.req._finish(error=err)
         for req in stranded:
             req._finish(error=err)
 

@@ -25,7 +25,7 @@ from ..base import MXNetError
 __all__ = [
     "ServeError", "ServeTimeout", "ServeOverload",
     "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
-    "ServeCacheInvalidated", "ServeEngineDead",
+    "ServeBlocksExhausted", "ServeCacheInvalidated", "ServeEngineDead",
 ]
 
 
@@ -58,6 +58,17 @@ class ServeQuarantined(ServeError):
     """This single request poisoned its own launch (bad shape escaping a
     bucket, an injected launch fault) and was quarantined; the rest of
     the batch kept decoding."""
+
+
+class ServeBlocksExhausted(ServeError):
+    """The paged K/V block pool cannot EVER satisfy this request: its
+    worst-case footprint (prompt + max_new_tokens, clipped to the cache
+    depth) exceeds the pool's usable blocks, so admitting it could only
+    end in a guaranteed preemption livelock.  Raised at `submit` —
+    transient pressure (pool momentarily full, or a `block_exhaust`
+    chaos denial) is NOT this error: those requests stay queued and
+    retry, or preempt and requeue, resolving through the deadline/
+    overload machinery instead."""
 
 
 class ServeCacheInvalidated(ServeError):

@@ -1,0 +1,116 @@
+"""Host-side block accounting for the paged K/V cache.
+
+The paged cache (vLLM's PagedAttention idea, Kwon et al. 2023, expressed
+in this repo's primitives) splits the per-replica K/V buffer into a pool
+of fixed-size blocks: `(num_layers, 2, n_blocks, block_size, embed)` on
+the device, an int32 block table per active row, and THIS allocator on
+the host.  A sequence holds `ceil(tokens / block_size)` blocks instead
+of a full `(S_max, embed)` slot row, so HBM admits as many concurrent
+sequences as their actual lengths fit — the slot cache's worst-case
+reservation is exactly what capped batch occupancy under mixed-length
+traffic.
+
+Blocks are interchangeable fixed-size units, so a plain LIFO free list
+is the whole allocator: external fragmentation cannot exist, and the
+`fragmentation()` gauge measures the only waste paging leaves —
+INTERNAL fragmentation, the allocated-but-unwritten token rows in each
+sequence's last block.
+
+Block 0 is reserved as the TRASH block: padding decode rows and the
+unallocated tail entries of every block table point at it, so gathers
+stay in-bounds with fixed shapes and scatters from padding rows land
+somewhere no real sequence reads.  It is never handed out.
+
+Allocation runs under the scheduler thread only (same threading contract
+as the slot free-list it replaces); `alloc` returning None — pool
+exhausted, or the `block_exhaust:P` chaos clause denying the attempt —
+is a NORMAL outcome the engine answers with a typed shed / requeue /
+preemption, never a hang.
+"""
+from __future__ import annotations
+
+from .. import chaos
+from ..base import MXNetError
+
+TRASH_BLOCK = 0
+
+
+class BlockAllocator:
+    """LIFO free-list over the device block pool (block ids 1..n-1)."""
+
+    def __init__(self, n_blocks, block_size):
+        if int(n_blocks) < 2:
+            raise MXNetError(
+                "BlockAllocator: need >= 2 blocks (one is the reserved "
+                "trash block), got %d" % n_blocks)
+        if int(block_size) < 1:
+            raise MXNetError(
+                "BlockAllocator: block_size must be >= 1, got %d"
+                % block_size)
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(self.n_blocks - 1, TRASH_BLOCK, -1))
+        self._held = set()
+
+    @property
+    def capacity(self):
+        """Usable blocks (pool minus the trash block)."""
+        return self.n_blocks - 1
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def used_blocks(self):
+        return len(self._held)
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` cache rows."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, n):
+        """``n`` block ids, or None when the pool cannot serve the request
+        (insufficient free blocks, or a `block_exhaust` chaos denial).
+        Never partial: an allocation either fully lands or leaves the
+        free list untouched, so a denied admit/growth retries cleanly."""
+        n = int(n)
+        if n <= 0:
+            return []
+        if chaos.serve_block_exhaust():
+            return None
+        if n > len(self._free):
+            return None
+        blocks = self._free[-n:]
+        del self._free[-n:]
+        self._held.update(blocks)
+        return list(reversed(blocks))
+
+    def free(self, blocks):
+        """Return blocks to the pool.  Double-free and trash-free raise:
+        both would let two sequences alias one block, which corrupts a
+        neighbour's context silently — the one failure mode a paged
+        cache must make loud."""
+        for b in blocks:
+            if b == TRASH_BLOCK:
+                raise MXNetError("BlockAllocator: freeing the trash block")
+            if b not in self._held:
+                raise MXNetError(
+                    "BlockAllocator: double free of block %d" % b)
+            self._held.discard(b)
+            self._free.append(b)
+
+    def reset(self):
+        """Forget every allocation (the pool-rebuild recovery path: the
+        device buffer was reallocated, so every table is void)."""
+        self._free = list(range(self.n_blocks - 1, TRASH_BLOCK, -1))
+        self._held.clear()
+
+    def fragmentation(self, used_tokens):
+        """Internal fragmentation: the fraction of allocated token rows
+        not holding a live token (``used_tokens`` = sum of tokens cached
+        across live sequences).  0.0 with nothing allocated."""
+        cap = len(self._held) * self.block_size
+        if cap <= 0:
+            return 0.0
+        return max(0.0, 1.0 - float(used_tokens) / cap)

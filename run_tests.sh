@@ -22,6 +22,15 @@ elif [ "$1" = "--serve-smoke" ]; then
     T1=""
     set -- tests/test_serving.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-paged-smoke" ]; then
+    # fast paged-cache smoke: block allocator, paged-vs-slot parity,
+    # chunked prefill, seeded sampling, block-leak and preemption
+    # coverage, and the paged zero-retrace gate (docs/serving.md
+    # "Paged KV cache")
+    shift
+    T1=""
+    set -- tests/test_serve_paged.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

@@ -54,6 +54,8 @@ def _engine(model, params, **kw):
     kw.setdefault("max_batch", 4)
     kw.setdefault("prefill_buckets", [8, 16])
     kw.setdefault("max_new_tokens", 4)
+    # greedy-only programs (sampling coverage: tests/test_serve_paged.py)
+    kw.setdefault("sampling", False)
     return ServingEngine(model, params, **kw)
 
 
@@ -68,12 +70,14 @@ def _chaos(monkeypatch, spec):
 
 def test_serving_clauses_parse(monkeypatch):
     _chaos(monkeypatch, "decode_slow:0.25:15,engine_crash:7:replica1,"
-                        "launch_error:0.1,queue_flood:4:64")
+                        "launch_error:0.1,queue_flood:4:64,"
+                        "block_exhaust:0.3")
     s = chaos.spec()
     assert s.decode_slow == (0.25, 15.0)
     assert s.engine_crash == (7, "replica1")
     assert s.launch_error == 0.1
     assert s.queue_flood == (4, 64)
+    assert s.block_exhaust == 0.3
     _chaos(monkeypatch, "engine_crash:3")
     assert chaos.spec().engine_crash == (3, "replica0")  # default target
     _chaos(monkeypatch, "decode_sloow:1:1")
@@ -173,6 +177,75 @@ def test_launch_error_quarantines_not_kills(model_and_params, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# 4b. block_exhaust -> typed shed/requeue (paged pool)
+# ---------------------------------------------------------------------------
+
+def test_block_exhaust_denials_are_deterministic(monkeypatch):
+    _chaos(monkeypatch, "block_exhaust:0.5")
+    alone = [chaos.serve_block_exhaust() for _ in range(32)]
+    assert any(alone) and not all(alone)
+    _chaos(monkeypatch, "block_exhaust:0.5,decode_slow:0.5:1")
+    assert [chaos.serve_block_exhaust() for _ in range(32)] == alone
+
+
+def test_block_exhaust_total_denial_expires_typed_not_hangs(
+        model_and_params, monkeypatch):
+    """100% allocation denial: no request is ever admitted, every one
+    expires TYPED at its deadline (queued requests retry each iteration
+    and shed through the deadline machinery) — the scheduler never dies
+    and nothing hangs."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    assert eng._paged
+    eng.warmup()
+    _chaos(monkeypatch, "block_exhaust:1.0")
+    reqs = [eng.submit([1 + i, 2], deadline_ms=300) for i in range(3)]
+    t0 = time.perf_counter()
+    while not all(r.done for r in reqs):
+        assert time.perf_counter() - t0 < 60, "denial hung the scheduler"
+        eng.step()
+    for r in reqs:
+        with pytest.raises(ServeDeadlineExceeded):
+            r.result(timeout=1)
+    assert eng._dead is None
+    assert eng._alloc.free_blocks == eng._alloc.capacity
+    reg = telemetry.registry()
+    assert reg.counter("serve.alloc_denied").value >= 3
+    # with the clause gone the same engine serves immediately
+    monkeypatch.delenv("MXNET_CHAOS")
+    chaos.reset()
+    ok = eng.submit([9, 9], max_new_tokens=2)
+    eng.run_until_idle(timeout=300)
+    assert len(ok.result(timeout=1)) == 2
+
+
+def test_block_exhaust_partial_denial_completes_everything(
+        model_and_params, monkeypatch):
+    """50% denial: admissions and growths retry/preempt through the
+    pressure and ALL traffic completes with the exact no-chaos greedy
+    tokens (denial changes scheduling, never content)."""
+    model, params = model_and_params
+    rng = np.random.RandomState(21)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (3, 9, 5, 12)]
+
+    clean_eng = _engine(model, params)
+    clean = []
+    for p in prompts:  # sequential solo runs on ONE engine (greedy truth)
+        r = clean_eng.submit(p, max_new_tokens=6)
+        clean_eng.run_until_idle(timeout=300)
+        clean.append(r.result(1))
+
+    _chaos(monkeypatch, "block_exhaust:0.5")
+    eng = _engine(model, params)
+    eng.warmup()
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle(timeout=300)
+    assert [r.result(1) for r in reqs] == clean
+    assert eng._dead is None
+    assert eng._alloc.free_blocks == eng._alloc.capacity
+
+
+# ---------------------------------------------------------------------------
 # 5. the acceptance gate
 # ---------------------------------------------------------------------------
 
@@ -190,7 +263,8 @@ def test_chaos_failover_acceptance(model_and_params, monkeypatch):
     mesh = make_mesh(shape=(2,), axis_names=("data",))
     router = ReplicaRouter.from_mesh(
         model, params, mesh=mesh, max_batch=2, prefill_buckets=[8, 16],
-        max_new_tokens=4, deadline_ms=deadline_ms, respawn=True)
+        max_new_tokens=4, deadline_ms=deadline_ms, respawn=True,
+        sampling=False)
     router.warmup()
     reg = telemetry.registry()
     compiles = reg.counter("serve.aot.compiles").value

@@ -37,7 +37,12 @@ RECOVERY_COUNTERS = ("dist.rpc_retries", "dist.dup_push_applied",
 SERVE_COUNTERS = ("serve.requests", "serve.completed", "serve.tokens",
                   "serve.prefills", "serve.decode_steps",
                   "serve.decode_padded", "serve.aot.compiles",
-                  "serve.aot.hits", "serve.engine_failures")
+                  "serve.aot.hits", "serve.aot.frozen_compiles",
+                  "serve.engine_failures", "serve.prefill_chunks",
+                  "serve.greedy_requests", "serve.sampled_requests")
+# per-replica paged-cache gauges (serve.<name>.blocks_free/_frag): the
+# final value seen in the stream is the replica's end-of-run state
+SERVE_BLOCK_GAUGE_SUFFIXES = (".blocks_free", ".blocks_frag")
 
 # serving resilience accounting (docs/serving.md "Failure semantics"):
 # the SLO/failover counters + the failover/respawn event kinds
@@ -45,10 +50,12 @@ SERVE_RESILIENCE_COUNTERS = (
     "serve.shed", "serve.expired", "serve.cancelled", "serve.degraded",
     "serve.quarantined", "serve.cache_rebuilds", "serve.launch_errors",
     "serve.failovers", "serve.redispatched", "serve.respawns",
-    "serve.chaos_flooded", "serve.block_waits")
+    "serve.chaos_flooded", "serve.block_waits", "serve.preempted",
+    "serve.alloc_denied", "serve.blocks_rejected")
 SERVE_RESILIENCE_EVENT_KINDS = (
     "serve_failover", "serve_respawn", "serve_respawn_failed",
-    "serve_respawn_compiled", "serve_cache_rebuild", "serve_quarantine")
+    "serve_respawn_compiled", "serve_cache_rebuild", "serve_quarantine",
+    "serve_preempt", "aot_frozen_compile")
 
 
 def load(path):
@@ -182,6 +189,14 @@ def summarize(records):
         serving["steady_state_recompiles"] = len(
             [e for e in retraces
              if str(e.get("site", "")).startswith("serving.")])
+        # paged-cache gauges: last-seen per replica (serve.<name>.*)
+        block_gauges = {}
+        for r in records:
+            for k, v in r.get("gauges", {}).items():
+                if k.startswith("serve.") and \
+                        k.endswith(SERVE_BLOCK_GAUGE_SUFFIXES):
+                    block_gauges[k] = v
+        serving.update(block_gauges)
         for name in ("serve.latency_ms", "serve.ttft_ms"):
             agg = _merge_hists(records, name)
             if agg:
