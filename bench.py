@@ -619,7 +619,7 @@ def serve_bench(record=True, with_chaos=False):
         os.environ.setdefault(
             "MXNET_CHAOS",
             "engine_crash:%d:replica0,decode_slow:0.05:20,"
-            "launch_error:0.02,block_exhaust:0.05"
+            "launch_error:0.02,block_exhaust:0.05,prefix_evict:0.05"
             % max(4, n_requests // 6))
         os.environ.setdefault("SERVE_REPLICAS", "2")
         os.environ.setdefault("SERVE_DEADLINE_MS", "10000")
@@ -658,7 +658,33 @@ def serve_bench(record=True, with_chaos=False):
     compiles_after_warmup = reg.counter("serve.aot.compiles").value
 
     trace = os.environ.get("SERVE_TRACE", "uniform")
-    if trace == "mixed":
+    if trace == "prefix":
+        # shared-system-prompt trace (the traffic cross-request prefix
+        # caching exists for): each prompt is one of SERVE_PREFIX_COUNT
+        # shared system prompts of SERVE_PREFIX_LEN tokens plus a short
+        # unique log-normal tail; output lengths log-normal like `mixed`
+        sigma = float(os.environ.get("SERVE_TRACE_SIGMA", "0.6"))
+        n_sys = int(os.environ.get("SERVE_PREFIX_COUNT", "4"))
+        sys_len = int(os.environ.get("SERVE_PREFIX_LEN",
+                                     str(max(1, (2 * prompt_max) // 3))))
+        sys_prompts = [list(rng.randint(0, vocab, size=sys_len))
+                       for _ in range(n_sys)]
+        tail_cap = max(1, prompt_max - sys_len)
+
+        def _lens(mean, cap, n):
+            mu = np.log(max(mean, 1.5)) - sigma * sigma / 2.0
+            return np.clip(np.round(rng.lognormal(mu, sigma, n)),
+                           1, cap).astype(int)
+
+        tails = _lens(max(1.0, tail_cap / 2.0), tail_cap, n_requests)
+        which = rng.randint(0, n_sys, size=n_requests)
+        prompts = [sys_prompts[w] + list(rng.randint(0, vocab, size=int(t)))
+                   for w, t in zip(which, tails)]
+        plens = np.array([len(p) for p in prompts])
+        newlens = _lens(float(os.environ.get("SERVE_NEW_MEAN",
+                                             str(max(2, max_new // 2)))),
+                        max_new, n_requests)
+    elif trace == "mixed":
         # log-normal prompt/output lengths (the realistic mixed-length
         # traffic paging exists for): most requests short, a heavy tail
         # near the cap — the slot cache reserves for the tail on every
@@ -677,7 +703,8 @@ def serve_bench(record=True, with_chaos=False):
     else:
         plens = rng.randint(1, prompt_max + 1, size=n_requests)
         newlens = np.full(n_requests, max_new)
-    prompts = [list(rng.randint(0, vocab, size=int(n))) for n in plens]
+    if trace != "prefix":
+        prompts = [list(rng.randint(0, vocab, size=int(n))) for n in plens]
     router.start()
     depth_samples = []
     reqs = []
@@ -723,20 +750,42 @@ def serve_bench(record=True, with_chaos=False):
     if paged_engines:
         # leak check runs post-stop: every retired/failed/stranded
         # sequence must have returned its blocks
+        def _sum(key):
+            return sum(e.stats[key] for e in paged_engines)
+
+        # leak check runs post-stop: blocks neither free, nor held, nor
+        # parked in the prefix pool (parked blocks are deliberate cache,
+        # not leaks)
+        looked = _sum("prefix_lookup_tokens")
         blocks = {
             "block_size": paged_engines[0].block_size,
             "n_blocks": sum(e.n_blocks for e in paged_engines),
             "free_min": min(e.stats["blocks_free_min"]
                             for e in paged_engines),
-            "leaked": sum(e._alloc.capacity - e._alloc.free_blocks
-                          for e in paged_engines),
-            "prefill_chunks": sum(e.stats["prefill_chunks"]
-                                  for e in paged_engines),
-            "preemptions": sum(e.stats["preemptions"]
-                               for e in paged_engines),
-            "alloc_denied": sum(e.stats["alloc_denied"]
-                                for e in paged_engines),
+            "leaked": sum(e.leaked_blocks() for e in paged_engines),
+            "parked": sum(e._prefix.parked_count for e in paged_engines
+                          if e._prefix is not None),
+            "prefill_chunks": _sum("prefill_chunks"),
+            "preemptions": _sum("preemptions"),
+            "alloc_denied": _sum("alloc_denied"),
+            "prefix": None if all(e._prefix is None for e in paged_engines)
+            else {
+                "hits": _sum("prefix_hits"),
+                "bootstraps": _sum("prefix_bootstraps"),
+                "tokens_matched": _sum("prefix_tokens"),
+                "hit_rate": round(_sum("prefix_tokens") /
+                                  float(max(looked, 1)), 4),
+                "cow_copies": _sum("cow_copies"),
+                "evictions": _sum("prefix_evictions"),
+            },
         }
+    # token-parity witness across A/B legs run on the same request set:
+    # a digest of every successfully completed request's output (keyed
+    # by submit index, so legs compare request-for-request)
+    import hashlib
+    sig = hashlib.sha1(repr(
+        [(i, tuple(r.tokens)) for i, r in enumerate(reqs)
+         if r.done and r.error is None]).encode()).hexdigest()[:16]
     steady_retraces = [e for e in telemetry.events("retrace")
                        if str(e.get("site", "")).startswith("serving.")]
     compiles_after_run = reg.counter("serve.aot.compiles").value
@@ -797,6 +846,7 @@ def serve_bench(record=True, with_chaos=False):
                        "max": round(lat[-1], 2) if lat else None},
         "ttft_ms": {"p50": pct(ttft, 0.50), "p99": pct(ttft, 0.99)},
         "tokens_generated": n_tokens,
+        "output_sig": sig,
         "batch_occupancy": round(rows / max(rows + padded, 1), 4),
         "max_concurrent": max_concurrent,
         "cache": "paged" if paged_engines else "slot",
@@ -894,6 +944,83 @@ def serve_mixed_bench(record=True):
     return result
 
 
+def serve_prefix_bench(record=True):
+    """Prefix-caching A/B at EQUAL HBM under the shared-system-prompt
+    trace (``python bench.py --serve --prefix``).
+
+    Both legs run the paged cache with the SAME pool
+    (`MXNET_SERVE_N_BLOCKS` — default a pool tight enough that
+    single-owner paging is block-capped below the row ceiling); the
+    `single` leg pins ``MXNET_SERVE_PREFIX=0`` (PR 9 single-owner
+    blocks), the `prefix` leg shares.  The acceptance contract
+    (ISSUE 10, gated nightly): ttft p50 strictly LOWER and admitted
+    concurrency strictly HIGHER with the prefix cache, token-for-token
+    output parity (`output_sig` equal — preemption and block placement
+    are output-invisible), zero leaked blocks, and zero steady-state
+    recompiles on either leg.
+    """
+    from mxnet_tpu import telemetry
+
+    batch = int(os.environ.get("SERVE_PREFIX_BATCH", "8"))
+    bs = int(os.environ.get("MXNET_SERVE_BLOCK_SIZE", "16"))
+    # default pool: ~1.5 private blocks per row + the trash block —
+    # single-owner admissions hit the block cap well below `batch`,
+    # shared-prefix admissions fit the whole row ceiling
+    n_blocks = int(os.environ.get("MXNET_SERVE_N_BLOCKS", "0")) or \
+        (1 + (3 * batch) // 2)
+    runs = {}
+    shared = {"SERVE_TRACE": "prefix", "SERVE_RATE": "0",
+              "MXNET_SERVE_MAX_BATCH": str(batch),
+              "MXNET_SERVE_BLOCK_SIZE": str(bs),
+              "MXNET_SERVE_N_BLOCKS": str(n_blocks)}
+    for mode, env in (("single", {"MXNET_SERVE_PREFIX": "0"}),
+                      ("prefix", {"MXNET_SERVE_PREFIX": "1"})):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    single, prefix = runs["single"], runs["prefix"]
+
+    def _ttft(r):
+        return r["ttft_ms"]["p50"] or 0.0
+
+    result = {
+        "metric": "serve_prefix_vs_single",
+        # the acceptance ratio: ttft p50 at equal HBM (single / prefix —
+        # > 1.0 means the prefix cache answers faster)
+        "value": round(_ttft(single) / max(_ttft(prefix), 1e-9), 3),
+        "unit": "single/prefix ttft p50 ratio (equal HBM: %d blocks x %d, "
+                "row ceiling %d)" % (n_blocks, bs, batch),
+        "single": single,
+        "prefix": prefix,
+        "ttft_p50_ms": {"single": _ttft(single), "prefix": _ttft(prefix)},
+        "ttft_p99_ms": {"single": single["ttft_ms"]["p99"],
+                        "prefix": prefix["ttft_ms"]["p99"]},
+        "concurrency_gain": round(
+            prefix["max_concurrent"] / max(single["max_concurrent"], 1), 3),
+        "token_parity": single["output_sig"] == prefix["output_sig"],
+        "prefix_hit_rate": (prefix["blocks"] or {}).get(
+            "prefix", {}).get("hit_rate"),
+        "tok_s_gain": round(prefix["value"] / max(single["value"], 1e-9), 3),
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -928,6 +1055,8 @@ if __name__ == "__main__":
     elif "--serve" in sys.argv:
         if "--mixed" in sys.argv:
             serve_mixed_bench()
+        elif "--prefix" in sys.argv:
+            serve_prefix_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

@@ -45,9 +45,15 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
     block_exhaust:P       with probability P a paged-KV block allocation
                           attempt is denied as if the pool were empty —
                           admission parks the request for a typed
-                          retry/shed and decode growth preempts the
-                          sequence (requeue), never a hang or a
-                          scheduler death
+                          retry/shed and decode growth (or a denied
+                          copy-on-write) preempts the sequence
+                          (requeue), never a hang, a scheduler death,
+                          or an aliased write into a shared block
+    prefix_evict:P        with probability P a serving scheduler step
+                          force-evicts the LRU parked prefix-cache
+                          block (eviction pressure without real pool
+                          exhaustion) — losing a hot prefix must only
+                          cost a re-prefill, never correctness
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
@@ -75,7 +81,7 @@ __all__ = [
     "ChaosError", "ChaosEngineCrash", "CRASH_EXIT_CODE", "enabled", "spec",
     "reset", "rpc_action", "maybe_crash_server", "grad_poison",
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
-    "serve_queue_flood", "serve_block_exhaust",
+    "serve_queue_flood", "serve_block_exhaust", "serve_prefix_evict",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -110,6 +116,7 @@ class _Spec:
         self.launch_error = 0.0           # probability per launch
         self.queue_flood = None           # (per-step rate, total cap)
         self.block_exhaust = 0.0          # probability per allocation
+        self.prefix_evict = 0.0           # probability per scheduler step
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -140,6 +147,8 @@ class _Spec:
                                     int(parts[2]) if len(parts) > 2 else 256)
             elif kind == "block_exhaust":
                 self.block_exhaust = float(parts[1])
+            elif kind == "prefix_evict":
+                self.prefix_evict = float(parts[1])
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -332,6 +341,19 @@ def serve_block_exhaust():
     with s.lock:
         return bool(s.rng_for("block_exhaust").random_sample()
                     < s.block_exhaust)
+
+
+def serve_prefix_evict():
+    """True when the CURRENT serving scheduler step should force-evict
+    the LRU parked prefix-cache block (`prefix_evict:P`): eviction
+    pressure on demand, without waiting for real pool exhaustion — a
+    lost hot prefix must only cost the next sharer a re-prefill."""
+    s = spec()
+    if s is None or s.prefix_evict <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("prefix_evict").random_sample()
+                    < s.prefix_evict)
 
 
 def serve_queue_flood():

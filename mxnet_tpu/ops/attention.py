@@ -179,6 +179,14 @@ def gather_paged_kv(pool, block_tables):
     Returns (b, m*block_size, embed): the same layout `decode_attention`
     reads from a slot cache, reassembled by gather — paging changes WHERE
     rows live, not what attention sees.
+
+    Tables may ALIAS: with cross-request prefix sharing, several rows of
+    one batch can name the same physical block (and the trash block is
+    aliased by every padding tail).  A pure gather is read-only, so
+    aliasing is safe by construction — each row materializes its own
+    copy of the shared rows (tested in tests/test_serve_prefix.py); the
+    engine's copy-on-write guarantees no WRITE ever targets a block two
+    tables share.
     """
     b, m = block_tables.shape
     _, bs, e = pool.shape

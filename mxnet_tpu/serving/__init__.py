@@ -8,9 +8,16 @@ package is the production path on top of it (ROADMAP item 1):
   functions for `models/transformer.py` graphs (same parameter names, so
   training checkpoints serve directly), over either a slot cache or the
   paged block pool (`prefill_paged`/`decode_paged`).
-* `paged.BlockAllocator` — host-side free list over the fixed device
-  block pool (the vLLM PagedAttention idea): sequences hold blocks for
-  their actual length, so HBM admits by footprint, not worst case.
+* `paged.BlockAllocator` — refcounted host-side free list over the
+  fixed device block pool (the vLLM PagedAttention idea): sequences
+  hold blocks for their actual length, so HBM admits by footprint, not
+  worst case; refcounts let blocks be SHARED across requests.
+* `paged.PrefixCache` — block-aligned radix index over cached K/V
+  prefixes (RadixAttention at block granularity): admission reuses the
+  longest cached full-block prefix instead of re-prefilling it, with
+  copy-on-write for writers and an LRU pool of retired prefix blocks
+  evicted only under allocation pressure (`MXNET_SERVE_PREFIX=0`
+  restores single-owner paging bit-for-bit).
 * `sampling.sample_tokens` — in-graph temperature/top-k/top-p sampling
   with a request-keyed, position-folded RNG (deterministic, batch-
   composition-invariant; temperature 0 = greedy argmax).
@@ -32,7 +39,7 @@ See docs/serving.md.
 """
 from .decode import TransformerKVModel
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
-from .paged import BlockAllocator, TRASH_BLOCK
+from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
@@ -40,7 +47,7 @@ from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeCacheInvalidated, ServeEngineDead)
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
-           "ReplicaRouter", "BlockAllocator", "TRASH_BLOCK",
+           "ReplicaRouter", "BlockAllocator", "PrefixCache", "TRASH_BLOCK",
            "sample_tokens", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
            "ServeBlocksExhausted", "ServeCacheInvalidated",

@@ -242,6 +242,19 @@ class TransformerKVModel:
             return jnp.zeros(shape, self.dtype)
         return jax.device_put(np.zeros(shape, self.dtype), device)
 
+    def copy_block(self, pool, src, dst):
+        """Copy one block's cached rows — every layer, K and V — from
+        block ``src`` to block ``dst`` (both (1,) int32): the
+        copy-on-write body.  A writer about to touch a SHARED block gets
+        a private copy first, so the cached original keeps serving other
+        readers byte-for-byte.  Gather + scatter on the block axis, the
+        same primitives the paged attention path uses; the pool is
+        donated by the engine's compiled wrapper, so the copy is
+        in-place on the device."""
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        return pool.at[:, :, dst].set(pool[:, :, src])
+
     def prefill_paged(self, params, pool, tokens, start, length, tables):
         """One chunked-prefill step over the paged pool.
 

@@ -34,6 +34,23 @@ longer than the largest prefill bucket stream through the pool in
 bucket-sized CHUNKS (one per iteration once decoding — the Sarathi
 ttft-interference bound), so the out-of-range rejection path is gone.
 
+Paged blocks are SHAREABLE across requests (``MXNET_SERVE_PREFIX=0``
+restores single-owner paging bit-for-bit): the allocator refcounts every
+block and a block-aligned radix index (`serving/paged.PrefixCache`, the
+RadixAttention idea at block granularity) maps full-block token runs to
+the physical blocks already holding their K/V.  Admission looks up the
+longest cached prefix, acquires those blocks, and prefills only the
+uncached suffix — a fully-covered prompt skips prefill outright and
+BOOTSTRAPS through one decode step of its last token.  A writer about
+to touch a shared (or index-registered) block gets a private copy first
+(copy-on-write: one tiny block-copy program compiled at warmup, the
+AotCache stays frozen); a denied CoW allocation preempts typed, never
+aliases.  Retired blocks no longer free eagerly: refcount-0 registered
+blocks PARK in an LRU pool evicted only under allocation pressure, so a
+hot system prompt survives across requests — lower ttft and strictly
+more admitted concurrency at equal HBM under shared-prefix traffic
+(``bench.py --serve --prefix`` measures the A/B).
+
 Sampling runs inside the compiled step — greedy argmax, or per-request
 temperature/top-k/top-p with a request-keyed position-folded RNG
 (serving/sampling.py) when ``MXNET_SERVE_SAMPLING`` programs are built —
@@ -70,7 +87,7 @@ from .. import telemetry
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
-from .paged import BlockAllocator, TRASH_BLOCK
+from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
@@ -209,16 +226,22 @@ class _Seq:
     """Scheduler state of one active sequence: `last` is the token that
     will be fed (and cached) at position `pos` on the next decode step.
     ``blocks`` is the paged path's host-side block list (None on the
-    slot path): entry t holds cache positions [t*bs, (t+1)*bs)."""
+    slot path): entry t holds cache positions [t*bs, (t+1)*bs).
+    ``ctx`` (paged only) is the incrementally maintained list of the
+    tokens cached at rows [0, pos) — prefix registration and preemption
+    resume read it directly instead of re-concatenating prompt +
+    generated every time (which would be quadratic over a long
+    generation)."""
 
-    __slots__ = ("req", "last", "pos", "n_new", "blocks")
+    __slots__ = ("req", "last", "pos", "n_new", "blocks", "ctx")
 
-    def __init__(self, req, last, pos, blocks=None):
+    def __init__(self, req, last, pos, blocks=None, ctx=None):
         self.req = req
         self.last = last
         self.pos = pos
         self.n_new = 1  # the prefill already sampled token #1
         self.blocks = blocks
+        self.ctx = ctx
 
 
 class _Prefill:
@@ -265,7 +288,8 @@ class ServingEngine:
                  max_new_tokens=None, eos_id=None, name="replica0",
                  queue_max=None, overload=None, deadline_ms=None, aot=None,
                  paged=None, block_size=None, n_blocks=None,
-                 chunk_prefill=None, sampling=None):
+                 chunk_prefill=None, sampling=None, prefix=None,
+                 prefix_pool=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -371,11 +395,24 @@ class ServingEngine:
             self._cache = model.init_block_pool(nb, bs,
                                                 device=self._device)
             self._prefilling = {}  # row -> _Prefill (insertion-ordered)
+            # cross-request prefix sharing (MXNET_SERVE_PREFIX=0 restores
+            # single-owner paging bit-for-bit; MXNET_SERVE_PREFIX_POOL
+            # caps the parked refcount-0 LRU pool, < 0 = bounded only by
+            # allocation pressure)
+            self._prefix_pool = int(
+                os.environ.get("MXNET_SERVE_PREFIX_POOL", "-1")
+                if prefix_pool is None else prefix_pool)
+            prefix_on = _env_flag("MXNET_SERVE_PREFIX") if prefix is None \
+                else bool(prefix)
+            self._prefix = PrefixCache(bs, self._prefix_pool) \
+                if prefix_on else None
         else:
             self._chunk_prefill = False
             self.block_size = None
             self.n_blocks = None
             self._alloc = None
+            self._prefix = None
+            self._prefix_pool = -1
             # slot max_batch is the trash slot padding rows write into
             self._cache = model.init_cache(self.max_batch + 1,
                                            device=self._device)
@@ -405,7 +442,11 @@ class ServingEngine:
                       "tokens": 0, "prefill_chunks": 0, "preemptions": 0,
                       "alloc_denied": 0, "max_concurrent": 0,
                       "blocks_free_min": (self._alloc.free_blocks
-                                          if self._paged else None)}
+                                          if self._paged else None),
+                      # prefix caching (0s when disabled)
+                      "prefix_hits": 0, "prefix_tokens": 0,
+                      "prefix_lookup_tokens": 0, "prefix_bootstraps": 0,
+                      "cow_copies": 0, "prefix_evictions": 0}
 
     # -- program building --------------------------------------------------
     _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
@@ -499,6 +540,26 @@ class ServingEngine:
 
         return self._aot.get(("decode", b_bucket, 1), build)
 
+    def _compiled_cow(self):
+        """The copy-on-write body: one block's rows copied pool→pool
+        (every layer, K and V) with the pool donated — in-place on the
+        device, zero host traffic.  ONE fixed shape regardless of
+        buckets, compiled at warmup like everything else, so CoW adds
+        nothing to steady state."""
+        def build():
+            def prog(pool, src, dst):
+                return self.model.copy_block(pool, src, dst)
+
+            fn = jax.jit(prog, donate_argnums=(0,))
+            z = self._put(np.zeros((1,), np.int32))
+            return fn.lower(self._cache, z, z).compile()
+
+        return self._aot.get(("cow", 1, 1), build)
+
+    def _cow_watch_arrays(self):
+        z = np.zeros((1,), np.int32)
+        return (z, z), ("src", "dst")
+
     def _put(self, a):
         return jax.device_put(a, self._device)
 
@@ -548,11 +609,16 @@ class ServingEngine:
             self._compiled_decode(b)
             arrays, names = self._decode_watch_arrays(b)
             self._watch("decode", arrays, names, b, seed=True)
+        if self._prefix is not None:
+            self._compiled_cow()
+            arrays, names = self._cow_watch_arrays()
+            self._watch("cow", arrays, names, 1, seed=True)
         self._aot.freeze()
         return {"prefill": list(self.prefill_buckets),
                 "decode": list(self.decode_buckets),
                 "cache": "paged" if self._paged else "slot",
-                "block_size": self.block_size, "n_blocks": self.n_blocks}
+                "block_size": self.block_size, "n_blocks": self.n_blocks,
+                "prefix": self._prefix is not None}
 
     def respawn(self):
         """A replacement engine for this (dead) replica: same device,
@@ -571,7 +637,8 @@ class ServingEngine:
             deadline_ms=self._deadline_ms_default, aot=self._aot,
             paged=self._paged, block_size=self.block_size,
             n_blocks=self.n_blocks, chunk_prefill=self._chunk_prefill,
-            sampling=self._sampling)
+            sampling=self._sampling, prefix=self._prefix is not None,
+            prefix_pool=self._prefix_pool)
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
@@ -795,30 +862,114 @@ class ServingEngine:
         req._finish(error=ServeQuarantined(msg[:500]))
 
     def _release_blocks(self, holder):
-        """Return a seq/prefill's blocks to the pool exactly once (every
-        path a sequence leaves the cache by funnels through here — the
-        leak check is `free_blocks` returning to its initial value after
-        a drain)."""
+        """Drop a seq/prefill's block refs exactly once (every path a
+        sequence leaves the cache by funnels through here).  Refcount-0
+        blocks the prefix index registered PARK in its LRU pool instead
+        of freeing — hot prefixes survive the request — everything else
+        returns to the free list.  The leak check is `leaked_blocks()`
+        returning 0 after a drain."""
         if self._paged and holder.blocks is not None:
-            self._alloc.free(holder.blocks)
+            self._drop_refs(holder.blocks)
             holder.blocks = None
             self._block_gauges()
 
-    def _block_gauges(self):
+    def _drop_refs(self, blocks):
+        """release → park registered / reclaim unregistered, the single
+        refcount-drop site (so a double drop raises in the allocator)."""
+        for b in self._alloc.release(blocks):
+            parked = None if self._prefix is None else self._prefix.park(b)
+            if parked is None:
+                self._alloc.reclaim([b])
+            elif parked:
+                # pool_cap overflow evicted the LRU tail
+                self._alloc.reclaim(parked)
+                self._count_evictions(len(parked))
+
+    def _count_evictions(self, n):
+        self.stats["prefix_evictions"] += n
+        self._count("prefix_evictions", n)
+
+    def _alloc_blocks(self, n):
+        """`BlockAllocator.alloc` with eviction-under-pressure: when the
+        free list alone cannot serve, parked prefix blocks are evicted
+        LRU-first to make room.  None only when live blocks genuinely
+        exhaust the pool (or chaos denies — a denial with enough free
+        blocks is chaos, and deliberately does NOT burn the cache)."""
+        got = self._alloc.alloc(n)
+        if got is not None or self._prefix is None:
+            return got
+        if self._alloc.free_blocks >= n:
+            return None  # chaos denial, not pressure: keep the cache
+        evicted = self._prefix.evict(n - self._alloc.free_blocks)
+        if not evicted:
+            return None
+        self._alloc.reclaim(evicted)
+        self._count_evictions(len(evicted))
+        return self._alloc.alloc(n)
+
+    def leaked_blocks(self):
+        """Blocks neither free, nor held by a live sequence, nor parked
+        in the prefix pool — must be 0 after any drain."""
+        if not self._paged:
+            return 0
+        parked = 0 if self._prefix is None else self._prefix.parked_count
+        return self._alloc.capacity - self._alloc.free_blocks - \
+            self._alloc.used_blocks - parked
+
+    def _register_prefix(self, tokens, blocks, n_tokens):
+        """Register a sequence's newly-FULL blocks in the prefix index
+        (eager: a concurrent request can share them while the writer is
+        still decoding — CoW guards the one block being written)."""
+        if self._prefix is not None:
+            self._prefix.insert(tokens, blocks,
+                                int(n_tokens) // self.block_size)
+
+    def _block_gauges(self, full=False):
+        """Cheap pool gauges on every allocator touch; the per-block
+        fill map behind `blocks_frag` only when ``full`` (once per
+        scheduler iteration — it walks every held block, which is not
+        free at large batch x depth)."""
         if not self._paged:
             return
         free = self._alloc.free_blocks
         if self.stats["blocks_free_min"] is None \
                 or free < self.stats["blocks_free_min"]:
             self.stats["blocks_free_min"] = free
-        # a seq at `pos` has cached rows 0..pos-1 (its `last` token is
-        # only written at `pos` by the NEXT decode step)
-        used_tokens = sum(s.pos for s in self._active.values()) + \
-            sum(p.done for p in self._prefilling.values())
         telemetry.set_gauge(self._gauge + "blocks_free", free)
+        telemetry.set_gauge(self._gauge + "blocks_shared",
+                            self._alloc.shared_blocks)
+        if not full:
+            return
+        # used rows per PHYSICAL block: a block shared by k sequences
+        # counts once (the sharers' fill of it is identical — it is
+        # full), so `blocks_frag` stays meaningful under refcounts > 1;
+        # the trash block never appears in any blocks list.  A seq at
+        # `pos` has cached rows 0..pos-1 (its `last` token is only
+        # written at `pos` by the NEXT decode step).
+        bs = self.block_size
+        filled = {}
+        for holder, n in [(s.blocks, s.pos)
+                          for s in self._active.values()] + \
+                         [(p.blocks, p.done)
+                          for p in self._prefilling.values()]:
+            if holder is None:
+                continue
+            for i, b in enumerate(holder):
+                rows = min(bs, max(0, n - i * bs))
+                if rows > filled.get(b, 0):
+                    filled[b] = rows
+        parked = 0 if self._prefix is None else self._prefix.parked_count
+        used_tokens = sum(filled.values()) + parked * bs
         telemetry.set_gauge(self._gauge + "blocks_frag",
-                            round(self._alloc.fragmentation(used_tokens),
-                                  4))
+                            round(self._alloc.fragmentation(
+                                used_tokens, cached_blocks=parked), 4))
+        if self._prefix is not None:
+            telemetry.set_gauge(self._gauge + "blocks_parked", parked)
+            looked = self.stats["prefix_lookup_tokens"]
+            if looked:
+                telemetry.set_gauge(
+                    self._gauge + "prefix_hit_rate",
+                    round(self.stats["prefix_tokens"] / float(looked), 4))
 
     def _rebuild_cache(self, reason):
         """The donated K/V buffer was consumed by a failed launch: every
@@ -846,6 +997,8 @@ class ServingEngine:
                 else:
                     self._quarantine(pf.req, "prefill lost to a cache "
                                      "rebuild twice: %s" % reason[:200])
+            if self._prefix is not None:
+                self._prefix.clear()  # the pool its nodes point at is gone
             self._alloc.reset()
             self._cache = self.model.init_block_pool(
                 self.n_blocks, self.block_size, device=self._device)
@@ -944,26 +1097,76 @@ class ServingEngine:
 
     # -- paged admission / chunked prefill ---------------------------------
     def _admit_one_paged(self, req):
-        """Paged admission: blocks for the full prompt (+ the first
-        decode write) up front, then the prompt streams through the pool
-        in bucket-sized chunks.  A denied allocation — pool pressure or
-        a `block_exhaust` chaos clause — is a typed requeue: the request
-        goes BACK to the queue front and admission stops this iteration
-        (free blocks can only appear when something retires)."""
+        """Paged admission: look up the longest cached block-aligned
+        prefix, acquire those shared blocks, allocate fresh blocks for
+        the uncached suffix (+ the first decode write), then stream only
+        the SUFFIX through the pool in bucket-sized chunks.  A prompt the
+        index covers completely skips prefill outright: the sequence
+        BOOTSTRAPS straight into the decode set, feeding its last token
+        at its final position (the pre-decode CoW gives it a private
+        copy of the shared block that write lands in).  A denied
+        allocation — pool pressure past what evicting the parked prefix
+        pool can free, or a `block_exhaust` chaos clause — is a typed
+        requeue: the request goes BACK to the queue front and admission
+        stops this iteration (free blocks can only appear when something
+        retires)."""
         row = self._free.pop()
         tokens = req.prompt if req._resume is None else req._resume[0]
-        blocks = self._alloc.alloc(self._alloc.blocks_for(len(tokens) + 1))
-        if blocks is None:
+        shared = [] if self._prefix is None else self._prefix.lookup(tokens)
+        matched = len(shared) * self.block_size
+        # acquire BEFORE allocating: live refs pin the matched blocks so
+        # the fresh allocation's eviction-under-pressure cannot reclaim
+        # them out from under the table we are about to build
+        self._alloc.acquire(shared)
+        if self._prefix is not None:
+            self._prefix.unpark(shared)
+        fresh = self._alloc_blocks(
+            self._alloc.blocks_for(len(tokens) + 1) - len(shared))
+        if fresh is None:
+            self._drop_refs(shared)
             self._free.append(row)
             self.stats["alloc_denied"] += 1
             self._count("alloc_denied")
             with self._qlock:
                 self._queue.appendleft(req)
             return False
+        # hit accounting only for admissions that LAND: a denied-alloc
+        # requeue retries the lookup every iteration and would otherwise
+        # inflate hit_rate exactly when the pool is under pressure
+        if self._prefix is not None:
+            self.stats["prefix_lookup_tokens"] += len(tokens)
+            if shared:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens"] += matched
+                self._count("prefix_hits")
+                telemetry.inc("serve.prefix_tokens", matched)
+        blocks = shared + fresh
         self._block_gauges()
+        if matched >= len(tokens):
+            # full cover (len(tokens) is block-aligned): nothing to
+            # prefill — admit straight to decode, feeding the last
+            # cached token at its own position.  Fresh admissions have
+            # sampled nothing yet (n_new 0, t_first stamps at the first
+            # decode); a resumed preemption continues its own counters.
+            self.stats["prefix_bootstraps"] += 1
+            self._count("prefix_bootstraps")
+            if req._resume is None:
+                last, pos, n_new = int(tokens[-1]), len(tokens) - 1, 0
+                telemetry.observe(
+                    "serve.queue_age_ms",
+                    1e3 * (time.perf_counter() - req.t_submit))
+            else:
+                last, pos, n_new = req._resume[1:]
+                req._resume = None
+            seq = _Seq(req, last, pos, blocks=blocks,
+                       ctx=list(tokens[:pos]))
+            seq.n_new = n_new
+            self._active[row] = seq
+            return True
         pf = _Prefill(req, row, list(tokens), blocks,
                       resume=None if req._resume is None
                       else req._resume[1:])
+        pf.done = matched  # the cached prefix needs no prefill
         self._prefilling[row] = pf
         self._advance_chunk(pf)
         return True
@@ -1038,6 +1241,10 @@ class ServingEngine:
         pf.done += chunk
         self.stats["prefill_chunks"] += 1
         telemetry.inc("serve.prefill_chunks")
+        # publish the chunk's newly-FULL blocks (a block whose bucket
+        # tail is padding garbage stays private: `done` counts only real
+        # tokens, so it rounds down past any partially-written block)
+        self._register_prefix(pf.tokens, pf.blocks, pf.done)
         if pf.done < total:
             return
         # prefill complete: the row becomes an active decode sequence
@@ -1055,7 +1262,7 @@ class ServingEngine:
             # continues from the token the preemption interrupted (no
             # re-sampling — the interrupted draw never happened)
             last, pos, n_new = pf.resume
-            seq = _Seq(req, last, pos, blocks=blocks)
+            seq = _Seq(req, last, pos, blocks=blocks, ctx=pf.tokens)
             seq.n_new = n_new
             req._resume = None
             self._active[pf.row] = seq
@@ -1065,41 +1272,108 @@ class ServingEngine:
         req.tokens.append(first)
         self.stats["tokens"] += 1
         telemetry.inc("serve.tokens")
-        seq = _Seq(req, first, total, blocks=blocks)
+        seq = _Seq(req, first, total, blocks=blocks, ctx=pf.tokens)
         if self._seq_finished(seq, first):
             self._retire(pf.row, seq, enter=False)
         else:
             self._active[pf.row] = seq
 
     def _grow_active(self):
-        """Before a decode step, every active row must own the block its
-        write position lands in.  A denied growth allocation PREEMPTS
-        the sequence: blocks free, the request requeues at the front
-        carrying its generated tokens, and a later re-prefill (prompt +
-        generated) rebuilds its context — greedy decoding and the
+        """Before a decode step, every active row must EXCLUSIVELY own
+        the block its write position lands in.
+
+        * Growth: a row whose write position crossed into an unallocated
+          block allocates it (one block at a time).
+        * Copy-on-write: a row about to write into a block that is
+          shared (refcount > 1) or registered in the prefix index gets a
+          private copy first — fresh block allocated, cached rows copied
+          in-graph (`copy_block`, compiled at warmup), table repointed,
+          shared ref dropped — so the cached original keeps serving its
+          other readers untouched.  Writing in place would alias: the
+          one thing this path must never do.
+
+        A denied allocation (growth or CoW) PREEMPTS the sequence:
+        blocks free, the request requeues at the front carrying its
+        generated tokens, and a later re-prefill (which may itself hit
+        the prefix cache) rebuilds its context — greedy decoding and the
         position-keyed sampler both replay identically, so preemption is
         invisible in the output."""
         for row, seq in list(self._active.items()):
+            if row not in self._active:
+                continue  # a CoW cache-loss rebuild retired the rest
             need = seq.pos // self.block_size + 1
-            if need <= len(seq.blocks):
-                continue
-            got = self._alloc.alloc(need - len(seq.blocks))
-            if got is not None:
-                seq.blocks.extend(got)
-                self._block_gauges()
-                continue
-            del self._active[row]
-            self._free.append(row)
-            req = seq.req
-            req._resume = (list(req.prompt) + list(req.tokens[:-1]),
-                           seq.last, seq.pos, seq.n_new)
-            self._release_blocks(seq)
-            self.stats["preemptions"] += 1
-            self._count("preempted")
-            telemetry.record_event("serve_preempt", replica=self.name,
-                                   request=req.id, pos=seq.pos)
-            with self._qlock:
-                self._queue.appendleft(req)
+            if need > len(seq.blocks):
+                got = self._alloc_blocks(need - len(seq.blocks))
+                if got is not None:
+                    seq.blocks.extend(got)
+                    self._block_gauges()
+                    continue
+            else:
+                wb = seq.blocks[need - 1]
+                if self._alloc.refcount(wb) <= 1 and \
+                        (self._prefix is None
+                         or not self._prefix.contains(wb)):
+                    continue  # sole unregistered owner: write in place
+                got = self._alloc_blocks(1)
+                if got is not None:
+                    if not self._cow(seq, need - 1, got[0]):
+                        return  # cache rebuilt (or fatal raised)
+                    continue
+            self._preempt(row, seq)
+
+    def _cow(self, seq, idx, dst):
+        """Copy block ``seq.blocks[idx]`` into ``dst`` and repoint the
+        table.  Returns False when the launch consumed the pool (cache
+        rebuild ran — every table is void); device death raises."""
+        src = seq.blocks[idx]
+        try:
+            arrays = (self._put(np.array([src], np.int32)),
+                      self._put(np.array([dst], np.int32)))
+            self._watch("cow", arrays, ("src", "dst"), 1)
+            compiled = self._compiled_cow()
+            self._cache = compiled(self._cache, *arrays)
+        except Exception as e:
+            kind = self._classify_failure(e)
+            if kind == "device":
+                raise _EngineFatal("cow copy failed: %s" % e) from e
+            if kind == "cache":
+                self._drop_refs([dst])
+                self._rebuild_cache("cow copy failed: %s" % e)
+                return False
+            # scoped: the pool survived — safest exit is a preemption
+            # (replay rebuilds the context; never write the shared block)
+            self._drop_refs([dst])
+            self._preempt_seq_row(seq)
+            return True
+        seq.blocks[idx] = dst
+        self._drop_refs([src])
+        self.stats["cow_copies"] += 1
+        self._count("cow_copies")
+        self._block_gauges()
+        return True
+
+    def _preempt_seq_row(self, seq):
+        for row, s in list(self._active.items()):
+            if s is seq:
+                self._preempt(row, seq)
+                return
+
+    def _preempt(self, row, seq):
+        del self._active[row]
+        self._free.append(row)
+        req = seq.req
+        # the cache holds rows 0..pos-1: exactly the fed tokens `ctx`
+        # tracks (a bootstrap admission has fed pos of its prompt and
+        # generated nothing; after prefill + k decodes it is prompt +
+        # generated[:-1] — the incremental list covers both)
+        req._resume = (list(seq.ctx), seq.last, seq.pos, seq.n_new)
+        self._release_blocks(seq)
+        self.stats["preemptions"] += 1
+        self._count("preempted")
+        telemetry.record_event("serve_preempt", replica=self.name,
+                               request=req.id, pos=seq.pos)
+        with self._qlock:
+            self._queue.appendleft(req)
 
     def _seq_finished(self, seq, token):
         if seq.req.eos_id is not None and token == seq.req.eos_id:
@@ -1198,6 +1472,14 @@ class ServingEngine:
         self.last_beat = time.monotonic()
         if chaos.enabled():
             self._inject_flood()
+            if self._prefix is not None and chaos.serve_prefix_evict():
+                # `prefix_evict:P` chaos: shove the LRU parked block out
+                # as if allocation pressure claimed it — hot-prefix loss
+                # must only cost a re-prefill, never correctness
+                evicted = self._prefix.evict(1)
+                if evicted:
+                    self._alloc.reclaim(evicted)
+                    self._count_evictions(len(evicted))
         self._sweep()
         if self._paged:
             self._advance_prefills()
@@ -1224,6 +1506,7 @@ class ServingEngine:
                                 len(self._queue))
         if self._paged:
             self._grow_active()
+            self._block_gauges(full=True)
         n = len(self._active)
         if n > self.stats["max_concurrent"]:
             self.stats["max_concurrent"] = n
@@ -1295,10 +1578,22 @@ class ServingEngine:
         telemetry.set_gauge(self._gauge + "batch_occupancy", n / float(b))
         for i, (slot, seq) in enumerate(zip(slots, seqs)):
             t = int(nxt[i])
+            if seq.req.t_first is None:
+                # a prefix-bootstrap admission skipped prefill: THIS is
+                # its first token (ttft = pure cache-hit latency)
+                seq.req.t_first = time.perf_counter()
             seq.req.tokens.append(t)
+            if seq.ctx is not None:
+                seq.ctx.append(seq.last)  # the token cached at old pos
             seq.last = t
             seq.pos += 1
             seq.n_new += 1
+            if self._prefix is not None and \
+                    seq.pos % self.block_size == 0:
+                # the block behind `pos` just filled with real rows:
+                # publish it (eagerly — concurrent requests share it
+                # while this one keeps decoding; CoW guards the writer)
+                self._register_prefix(seq.ctx, seq.blocks, seq.pos)
             if self._seq_finished(seq, t):
                 self._retire(slot, seq)
         return len(self._active) + len(self._prefilling)

@@ -10,11 +10,23 @@ sequences as their actual lengths fit — the slot cache's worst-case
 reservation is exactly what capped batch occupancy under mixed-length
 traffic.
 
-Blocks are interchangeable fixed-size units, so a plain LIFO free list
-is the whole allocator: external fragmentation cannot exist, and the
-`fragmentation()` gauge measures the only waste paging leaves —
-INTERNAL fragmentation, the allocated-but-unwritten token rows in each
-sequence's last block.
+Blocks are interchangeable fixed-size units, so a free list plus a
+per-block REFCOUNT is the whole allocator: external fragmentation cannot
+exist, and the `fragmentation()` gauge measures the only waste paging
+leaves — INTERNAL fragmentation, the allocated-but-unwritten token rows
+in each sequence's last block.
+
+Cross-request prefix sharing (SGLang's RadixAttention, Zheng et al.
+2023, at block granularity) rides the refcounts: `PrefixCache` below is
+a radix tree over FULL-block token runs — node key = the exact
+block_size-token tuple, path = the chained prefix — mapping each cached
+run to the physical block that already holds its K/V.  A new request
+walks its prompt down the tree, `acquire`s every matched block
+(refcount + 1) and prefills only the uncached suffix.  Retired blocks
+whose refcount hits zero do NOT return to the free list while they are
+registered in the tree: they PARK in an LRU pool and are evicted back to
+the free list only under allocation pressure, so a hot system prompt
+survives across requests.
 
 Block 0 is reserved as the TRASH block: padding decode rows and the
 unallocated tail entries of every block table point at it, so gathers
@@ -29,6 +41,8 @@ preemption, never a hang.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
+
 from .. import chaos
 from ..base import MXNetError
 
@@ -36,7 +50,19 @@ TRASH_BLOCK = 0
 
 
 class BlockAllocator:
-    """LIFO free-list over the device block pool (block ids 1..n-1)."""
+    """Refcounted free-list over the device block pool (ids 1..n-1).
+
+    Three disjoint states per usable block, every transition loud:
+
+    * **free**  — on the free list, allocatable (`alloc`).
+    * **held**  — refcount >= 1 (`_ref`); `acquire` adds a reader,
+      `release` drops one.  A block released to refcount 0 is handed
+      BACK to the caller (the engine parks registered prefix blocks,
+      `reclaim`s the rest) — the allocator never decides cache policy.
+    * **parked** — refcount 0 but retained by the prefix cache; not in
+      any allocator structure until `reclaim` returns it to the free
+      list (eviction) or `acquire` revives it (a prefix hit).
+    """
 
     def __init__(self, n_blocks, block_size):
         if int(n_blocks) < 2:
@@ -50,7 +76,8 @@ class BlockAllocator:
         self.n_blocks = int(n_blocks)
         self.block_size = int(block_size)
         self._free = list(range(self.n_blocks - 1, TRASH_BLOCK, -1))
-        self._held = set()
+        self._free_set = set(self._free)
+        self._ref = {}            # block -> refcount (>= 1)
 
     @property
     def capacity(self):
@@ -63,17 +90,29 @@ class BlockAllocator:
 
     @property
     def used_blocks(self):
-        return len(self._held)
+        """Distinct physical blocks with refcount >= 1 (a block shared by
+        k sequences counts ONCE)."""
+        return len(self._ref)
+
+    @property
+    def shared_blocks(self):
+        """Physical blocks currently referenced by more than one holder."""
+        return sum(1 for c in self._ref.values() if c > 1)
+
+    def refcount(self, block):
+        return self._ref.get(block, 0)
 
     def blocks_for(self, n_tokens):
         """Blocks needed to hold ``n_tokens`` cache rows."""
         return -(-int(n_tokens) // self.block_size)
 
     def alloc(self, n):
-        """``n`` block ids, or None when the pool cannot serve the request
-        (insufficient free blocks, or a `block_exhaust` chaos denial).
-        Never partial: an allocation either fully lands or leaves the
-        free list untouched, so a denied admit/growth retries cleanly."""
+        """``n`` fresh block ids at refcount 1, or None when the free list
+        cannot serve the request (insufficient free blocks, or a
+        `block_exhaust` chaos denial).  Never partial: an allocation
+        either fully lands or leaves the free list untouched, so a denied
+        admit/growth retries cleanly.  Parked prefix blocks do NOT count
+        as free — the engine evicts them explicitly under pressure."""
         n = int(n)
         if n <= 0:
             return []
@@ -83,34 +122,261 @@ class BlockAllocator:
             return None
         blocks = self._free[-n:]
         del self._free[-n:]
-        self._held.update(blocks)
+        self._free_set.difference_update(blocks)
+        for b in blocks:
+            self._ref[b] = 1
         return list(reversed(blocks))
 
-    def free(self, blocks):
-        """Return blocks to the pool.  Double-free and trash-free raise:
-        both would let two sequences alias one block, which corrupts a
-        neighbour's context silently — the one failure mode a paged
-        cache must make loud."""
+    def acquire(self, blocks):
+        """Add one reader to each block: a held block's refcount bumps, a
+        parked block (refcount 0, retained by the prefix cache) revives
+        at refcount 1.  Acquiring a FREE block raises — only blocks the
+        prefix index vouches for may gain readers, anything else would
+        alias a future allocation."""
+        for b in blocks:
+            if b == TRASH_BLOCK:
+                raise MXNetError("BlockAllocator: acquiring the trash block")
+            if b in self._free_set:
+                raise MXNetError(
+                    "BlockAllocator: acquiring free block %d (stale "
+                    "prefix-index entry?)" % b)
+            self._ref[b] = self._ref.get(b, 0) + 1
+
+    def release(self, blocks):
+        """Drop one reader from each block; returns the blocks whose
+        refcount hit ZERO (the caller parks or `reclaim`s them).
+        Double-release and trash-release raise: both would let two
+        sequences alias one block, which corrupts a neighbour's context
+        silently — the one failure mode a paged cache must make loud."""
+        zeroed = []
         for b in blocks:
             if b == TRASH_BLOCK:
                 raise MXNetError("BlockAllocator: freeing the trash block")
-            if b not in self._held:
+            c = self._ref.get(b)
+            if c is None:
                 raise MXNetError(
                     "BlockAllocator: double free of block %d" % b)
-            self._held.discard(b)
+            if c == 1:
+                del self._ref[b]
+                zeroed.append(b)
+            else:
+                self._ref[b] = c - 1
+        return zeroed
+
+    def reclaim(self, blocks):
+        """Return refcount-0 blocks to the free list (unregistered
+        releases, prefix-cache evictions).  Reclaiming a held or
+        already-free block raises."""
+        for b in blocks:
+            if b in self._ref:
+                raise MXNetError(
+                    "BlockAllocator: reclaiming held block %d" % b)
+            if b in self._free_set or b == TRASH_BLOCK:
+                raise MXNetError(
+                    "BlockAllocator: reclaiming free block %d" % b)
             self._free.append(b)
+            self._free_set.add(b)
+
+    def free(self, blocks):
+        """Release AND return to the free list in one step (the
+        single-owner path: no prefix cache retains refcount-0 blocks).
+        Raises exactly like `release` on double/trash frees."""
+        self.reclaim(self.release(blocks))
 
     def reset(self):
         """Forget every allocation (the pool-rebuild recovery path: the
         device buffer was reallocated, so every table is void)."""
         self._free = list(range(self.n_blocks - 1, TRASH_BLOCK, -1))
-        self._held.clear()
+        self._free_set = set(self._free)
+        self._ref.clear()
 
-    def fragmentation(self, used_tokens):
+    def fragmentation(self, used_tokens, cached_blocks=0):
         """Internal fragmentation: the fraction of allocated token rows
-        not holding a live token (``used_tokens`` = sum of tokens cached
-        across live sequences).  0.0 with nothing allocated."""
-        cap = len(self._held) * self.block_size
+        not holding a live token.  ``used_tokens`` must count each
+        PHYSICAL block's written rows once — a block shared by k
+        sequences contributes its rows one time, not k (the engine
+        aggregates per block id) — and must exclude the trash block,
+        which is a shape-padding sink, not an allocation.
+        ``cached_blocks`` adds the parked prefix pool to the allocated
+        capacity (parked blocks are full by construction, so callers
+        include ``cached_blocks * block_size`` in ``used_tokens``).
+        0.0 with nothing allocated."""
+        cap = (len(self._ref) + int(cached_blocks)) * self.block_size
         if cap <= 0:
             return 0.0
         return max(0.0, 1.0 - float(used_tokens) / cap)
+
+
+class _PrefixNode:
+    """One cached full-block token run: `key` is the exact block_size-
+    token tuple, `block` the physical block holding its K/V, the parent
+    chain spells the whole prefix."""
+
+    __slots__ = ("key", "block", "parent", "children")
+
+    def __init__(self, key, block, parent):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children = {}
+
+
+class PrefixCache:
+    """Block-aligned radix index over cached K/V prefixes.
+
+    Keys are the exact token tuples of FULL blocks (no lossy hashing:
+    a hash collision would silently alias one prompt's K/V into
+    another's attention — dict equality on the tuple makes the match
+    exact; Python hashes the tuple internally for the walk).  Only full
+    blocks participate: a partially-written block's tail is garbage, so
+    it can never be shared.
+
+    Lifecycle: the engine `insert`s a sequence's blocks as they FILL
+    (eagerly — a concurrent request can share a block its writer still
+    holds, which is where copy-on-write earns its keep), `lookup`s the
+    longest cached prefix at admission, `park`s registered blocks whose
+    refcount hits zero, and `evict`s parked blocks — oldest-first with
+    leaf preference, so a prefix's tail dies before its root — only
+    under allocation pressure (or past ``pool_cap``).
+    """
+
+    def __init__(self, block_size, pool_cap=-1):
+        self.block_size = int(block_size)
+        self.pool_cap = int(pool_cap)     # parked blocks retained; < 0 = all
+        self._root = _PrefixNode(None, None, None)
+        self._by_block = {}               # block -> node
+        self._parked = OrderedDict()      # block -> node, oldest first
+
+    @property
+    def cached_blocks(self):
+        """Registered blocks (live + parked)."""
+        return len(self._by_block)
+
+    @property
+    def parked_count(self):
+        """Refcount-0 blocks retained for reuse (the LRU pool)."""
+        return len(self._parked)
+
+    def _key(self, tokens, i):
+        bs = self.block_size
+        return tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+
+    def lookup(self, tokens):
+        """Block ids of the longest cached FULL-block prefix of
+        ``tokens`` (possibly covering all of them), touching the matched
+        path so hot prefixes move to the MRU end of the parked eviction
+        order (recency IS the `_parked` OrderedDict order).  The caller
+        must `acquire` the result before any operation that could evict
+        (a parked match is still parked until acquired)."""
+        out = []
+        node = self._root
+        for i in range(len(tokens) // self.block_size):
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            out.append(child.block)
+            node = child
+        n = node
+        while n is not self._root:
+            if n.block in self._parked:
+                self._parked.move_to_end(n.block)
+            n = n.parent
+        return out
+
+    def insert(self, tokens, blocks, n_full):
+        """Register the first ``n_full`` blocks of a sequence (its FULL
+        blocks) along the tree path of ``tokens``.  A run already cached
+        under a DIFFERENT physical block keeps the existing copy (the
+        walk continues through it, so deeper runs still register); a
+        run already cached under the SAME block is a no-op.  Returns the
+        number of newly registered blocks."""
+        node = self._root
+        added = 0
+        for i in range(min(int(n_full), len(blocks))):
+            key = self._key(tokens, i)
+            child = node.children.get(key)
+            if child is None:
+                b = blocks[i]
+                if b in self._by_block:
+                    # this physical block already backs another run (it
+                    # must not appear at two tree positions); stop here
+                    break
+                child = _PrefixNode(key, b, node)
+                node.children[key] = child
+                self._by_block[b] = child
+                added += 1
+            node = child
+        return added
+
+    def contains(self, block):
+        return block in self._by_block
+
+    def park(self, block):
+        """A registered block's refcount hit zero: retain it in the LRU
+        pool instead of freeing.  Returns the blocks evicted to honor
+        ``pool_cap`` (the caller reclaims them); [] for an unregistered
+        block — the caller frees it directly."""
+        node = self._by_block.get(block)
+        if node is None:
+            return None
+        self._parked[block] = node
+        self._parked.move_to_end(block)
+        evicted = []
+        if self.pool_cap >= 0:
+            while len(self._parked) > self.pool_cap:
+                evicted.extend(self._evict_one())
+        return evicted
+
+    def unpark(self, blocks):
+        """Blocks re-acquired through a prefix hit leave the LRU pool
+        (they are live again; `acquire` holds the refcount)."""
+        for b in blocks:
+            self._parked.pop(b, None)
+
+    def _evict_one(self):
+        """Evict the oldest parked LEAF (a parked node's children are
+        always parked too — a live child would imply a live holder of
+        the whole prefix — so leaves exist whenever the pool is
+        non-empty; preferring them keeps prefix ROOTS, the shareable
+        part, alive longest)."""
+        for b, node in self._parked.items():
+            if not node.children:
+                del self._parked[b]
+                self._detach(node)
+                return [b]
+        # unreachable while the parked-subtree invariant holds; take the
+        # oldest anyway (detaching orphans its subtree: unregistered,
+        # parked descendants evicted with it) rather than looping
+        b, node = next(iter(self._parked.items()))
+        del self._parked[b]
+        evicted = [b]
+        self._detach(node)
+        stack = list(node.children.values())
+        while stack:
+            d = stack.pop()
+            self._by_block.pop(d.block, None)
+            if self._parked.pop(d.block, None) is not None:
+                evicted.append(d.block)
+            stack.extend(d.children.values())
+        return evicted
+
+    def _detach(self, node):
+        self._by_block.pop(node.block, None)
+        if node.parent is not None:
+            node.parent.children.pop(node.key, None)
+        node.parent = None
+
+    def evict(self, n):
+        """Evict at least ``n`` parked blocks (fewer if the pool runs
+        dry); returns their ids for the caller to `reclaim`."""
+        out = []
+        while len(out) < int(n) and self._parked:
+            out.extend(self._evict_one())
+        return out
+
+    def clear(self):
+        """Drop every cached prefix (the pool-rebuild recovery path:
+        the device blocks the tree points at no longer exist)."""
+        self._root = _PrefixNode(None, None, None)
+        self._by_block.clear()
+        self._parked.clear()

@@ -31,6 +31,15 @@ elif [ "$1" = "--serve-paged-smoke" ]; then
     T1=""
     set -- tests/test_serve_paged.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-prefix-smoke" ]; then
+    # fast prefix-caching smoke: refcounted allocator invariants, the
+    # radix prefix index, shared-prefix admission parity, copy-on-write
+    # (incl. denied-CoW preemption), LRU eviction under pressure, and
+    # the prefix zero-retrace gate (docs/serving.md "Prefix caching")
+    shift
+    T1=""
+    set -- tests/test_serve_prefix.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

@@ -150,6 +150,48 @@ print("paged gate passed: %sx tok/s (%s vs %s), concurrency %s->%s, "
                             rec["occupancy"]["paged"]))
 PY
 
+# -- prefix-caching serve gate (docs/serving.md "Prefix caching") ---------
+# single-owner vs prefix-sharing A/B at EQUAL HBM under the shared-
+# system-prompt trace: the prefix cache must answer strictly faster
+# (ttft p50), admit a strictly higher concurrent batch, reproduce the
+# single-owner outputs token for token, leak no blocks, and compile
+# nothing in steady state on either leg; artifact lands in
+# bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    SERVE_REQUESTS=32 SERVE_SEQ=64 SERVE_NEW=12 SERVE_PROMPT_MAX=24 \
+    SERVE_PREFIX_LEN=16 MXNET_SERVE_BLOCK_SIZE=16 \
+    python bench.py --serve --prefix | tee /tmp/nightly_serve_prefix.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_prefix.log").read().strip().splitlines()[-1])
+single, prefix = rec["single"], rec["prefix"]
+for leg, r in (("single", single), ("prefix", prefix)):
+    assert r["completed"] == r["requests"], \
+        "prefix gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["steady_state_recompiles"] == 0, \
+        "prefix gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "prefix gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+    assert r["blocks"]["leaked"] == 0, \
+        "prefix gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+assert rec["token_parity"], \
+    "prefix gate: outputs diverged between single-owner and prefix legs"
+assert prefix["ttft_ms"]["p50"] < single["ttft_ms"]["p50"], \
+    "prefix gate: ttft p50 %s not below single-owner %s" % (
+        prefix["ttft_ms"]["p50"], single["ttft_ms"]["p50"])
+assert prefix["max_concurrent"] > single["max_concurrent"], \
+    "prefix gate: concurrency %s not above single-owner %s at equal HBM" \
+    % (prefix["max_concurrent"], single["max_concurrent"])
+print("prefix gate passed: ttft p50 %s->%s ms (%sx), concurrency %s->%s, "
+      "hit_rate %s" % (single["ttft_ms"]["p50"], prefix["ttft_ms"]["p50"],
+                       rec["value"], single["max_concurrent"],
+                       prefix["max_concurrent"], rec["prefix_hit_rate"]))
+PY
+
 # -- serve-chaos gate (docs/serving.md "Failure semantics") ---------------
 # the same Poisson run with one replica crashed mid-traffic, slow decode
 # steps, and injected launch errors: every request must RESOLVE (tokens
@@ -160,7 +202,7 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     SERVE_REQUESTS=24 SERVE_RATE=12 SERVE_REPLICAS=2 SERVE_SEQ=64 \
     SERVE_NEW=8 SERVE_PROMPT_MAX=16 SERVE_DEADLINE_MS=30000 \
-    MXNET_CHAOS="engine_crash:6:replica0,decode_slow:0.1:10,launch_error:0.05,block_exhaust:0.1" \
+    MXNET_CHAOS="engine_crash:6:replica0,decode_slow:0.1:10,launch_error:0.05,block_exhaust:0.1,prefix_evict:0.1" \
     python bench.py --serve --chaos | tee /tmp/nightly_serve_chaos.log
 python - <<'PY'
 import json

@@ -39,10 +39,16 @@ SERVE_COUNTERS = ("serve.requests", "serve.completed", "serve.tokens",
                   "serve.decode_padded", "serve.aot.compiles",
                   "serve.aot.hits", "serve.aot.frozen_compiles",
                   "serve.engine_failures", "serve.prefill_chunks",
-                  "serve.greedy_requests", "serve.sampled_requests")
-# per-replica paged-cache gauges (serve.<name>.blocks_free/_frag): the
+                  "serve.greedy_requests", "serve.sampled_requests",
+                  "serve.prefix_hits", "serve.prefix_bootstraps",
+                  "serve.prefix_tokens", "serve.cow_copies",
+                  "serve.prefix_evictions")
+# per-replica paged-cache gauges (serve.<name>.blocks_free/_frag plus the
+# prefix-sharing set blocks_shared/_parked and prefix_hit_rate): the
 # final value seen in the stream is the replica's end-of-run state
-SERVE_BLOCK_GAUGE_SUFFIXES = (".blocks_free", ".blocks_frag")
+SERVE_BLOCK_GAUGE_SUFFIXES = (".blocks_free", ".blocks_frag",
+                              ".blocks_shared", ".blocks_parked",
+                              ".prefix_hit_rate")
 
 # serving resilience accounting (docs/serving.md "Failure semantics"):
 # the SLO/failover counters + the failover/respawn event kinds
