@@ -619,8 +619,8 @@ def serve_bench(record=True, with_chaos=False):
         os.environ.setdefault(
             "MXNET_CHAOS",
             "engine_crash:%d:replica0,decode_slow:0.05:20,"
-            "launch_error:0.02,block_exhaust:0.05,prefix_evict:0.05"
-            % max(4, n_requests // 6))
+            "launch_error:0.02,block_exhaust:0.05,prefix_evict:0.05,"
+            "draft_junk:0.1" % max(4, n_requests // 6))
         os.environ.setdefault("SERVE_REPLICAS", "2")
         os.environ.setdefault("SERVE_DEADLINE_MS", "10000")
         chaos_mod.reset()
@@ -684,6 +684,46 @@ def serve_bench(record=True, with_chaos=False):
         newlens = _lens(float(os.environ.get("SERVE_NEW_MEAN",
                                              str(max(2, max_new // 2)))),
                         max_new, n_requests)
+    elif trace == "spec":
+        # templated traffic for the speculative-decoding A/B: a finite
+        # pool of SERVE_SPEC_POOL distinct prompts (block-aligned
+        # lengths, so repeats bootstrap through the PR-10 prefix cache
+        # instead of re-prefilling) with per-TEMPLATE output lengths —
+        # the workload where deterministic decoding makes a finished
+        # generation an exact oracle for the next identical request.
+        # The first instance of each template submits (and drains)
+        # first; its cold cost is measured inside the window, then the
+        # repeats draft off the replica's generation store.
+        sigma = float(os.environ.get("SERVE_TRACE_SIGMA", "0.6"))
+        # the template pool can never exceed the request budget: the
+        # trace must submit exactly n_requests (the gate asserts
+        # completed == requests against that count)
+        n_pool = max(1, min(int(os.environ.get("SERVE_SPEC_POOL", "8")),
+                            n_requests))
+        bs_align = int(os.environ.get("MXNET_SERVE_BLOCK_SIZE", "0")) or 16
+
+        def _lens(mean, cap, n):
+            mu = np.log(max(mean, 1.5)) - sigma * sigma / 2.0
+            return np.clip(np.round(rng.lognormal(mu, sigma, n)),
+                           1, cap).astype(int)
+
+        cap_aligned = max(bs_align, (prompt_max // bs_align) * bs_align)
+        raw = _lens(max(2.0, prompt_max / 2.0), prompt_max, n_pool)
+        tlens = np.clip((-(-raw // bs_align)) * bs_align, bs_align,
+                        cap_aligned).astype(int)
+        # template outputs cluster near their cap (templated answers
+        # have template-determined lengths): mean = max_new by default
+        tnew = _lens(float(os.environ.get("SERVE_NEW_MEAN", str(max_new))),
+                     max_new, n_pool)
+        templates = [list(rng.randint(0, vocab, size=int(n)))
+                     for n in tlens]
+        which = list(range(n_pool)) + \
+            list(rng.randint(0, n_pool,
+                             size=max(0, n_requests - n_pool)))
+        prompts = [templates[w] for w in which]
+        plens = np.array([len(p) for p in prompts])
+        newlens = np.array([int(tnew[w]) for w in which], dtype=int)
+        phase1 = min(n_pool, n_requests)
     elif trace == "mixed":
         # log-normal prompt/output lengths (the realistic mixed-length
         # traffic paging exists for): most requests short, a heavy tail
@@ -703,8 +743,10 @@ def serve_bench(record=True, with_chaos=False):
     else:
         plens = rng.randint(1, prompt_max + 1, size=n_requests)
         newlens = np.full(n_requests, max_new)
-    if trace != "prefix":
+    if trace not in ("prefix", "spec"):
         prompts = [list(rng.randint(0, vocab, size=int(n))) for n in plens]
+    if trace != "spec":
+        phase1 = None
     router.start()
     depth_samples = []
     reqs = []
@@ -713,7 +755,7 @@ def serve_bench(record=True, with_chaos=False):
     hung = 0
     t_start = time.perf_counter()
     try:
-        for p, m in zip(prompts, newlens):
+        for i, (p, m) in enumerate(zip(prompts, newlens)):
             try:
                 reqs.append(router.submit(p, max_new_tokens=int(m)))
             except ServeOverload:
@@ -724,6 +766,15 @@ def serve_bench(record=True, with_chaos=False):
                 # at the door, not a lost benchmark
                 submit_rejected += 1
             depth_samples.append(router.depth())
+            if phase1 is not None and i == phase1 - 1:
+                # spec trace: drain the cold template instances before
+                # the repeats arrive — the steady-state templated
+                # workload, cold misses measured inside the window
+                try:
+                    router.run_until_idle(timeout=float(
+                        os.environ.get("SERVE_TIMEOUT", "600")))
+                except MXNetError:
+                    pass  # a chaos-dead replica resolves via deadlines
             if rate > 0:
                 time.sleep(rng.exponential(1.0 / rate))
         deadline = float(os.environ.get("SERVE_TIMEOUT", "600"))
@@ -778,6 +829,26 @@ def serve_bench(record=True, with_chaos=False):
                 "cow_copies": _sum("cow_copies"),
                 "evictions": _sum("prefix_evictions"),
             },
+        }
+    spec_engines = [e for e in router.engines if e._spec]
+    spec_stats = None
+    if spec_engines:
+        def _spec_sum(key):
+            return sum(e.stats[key] for e in spec_engines)
+
+        proposed = _spec_sum("spec_proposed")
+        spec_stats = {
+            "k": spec_engines[0]._spec_k,
+            "drafter": spec_engines[0]._drafter.name,
+            "verify_launches": _spec_sum("verify_steps"),
+            "draft_launches": sum(e._drafter.launches
+                                  for e in spec_engines),
+            "proposed": proposed,
+            "accepted": _spec_sum("spec_accepted"),
+            "accept_rate": round(_spec_sum("spec_accepted") /
+                                 float(max(proposed, 1)), 4),
+            "rollback_blocks": _spec_sum("spec_rollbacks"),
+            "junk_rounds": _spec_sum("spec_junk_rounds"),
         }
     # token-parity witness across A/B legs run on the same request set:
     # a digest of every successfully completed request's output (keyed
@@ -851,6 +922,7 @@ def serve_bench(record=True, with_chaos=False):
         "max_concurrent": max_concurrent,
         "cache": "paged" if paged_engines else "slot",
         "blocks": blocks,
+        "spec": spec_stats,
         "trace": trace,
         "prompt_len_mean": round(float(np.mean(plens)), 2),
         "output_len_mean": round(float(np.mean(newlens)), 2),
@@ -1021,6 +1093,80 @@ def serve_prefix_bench(record=True):
     return result
 
 
+def serve_spec_bench(record=True):
+    """Speculative-decoding A/B at EQUAL HBM under the templated
+    mixed-length trace (``python bench.py --serve --spec``).
+
+    Both legs run the paged+prefix engine with identical geometry and
+    block pool (equal HBM is automatic: the pool is sized from
+    max_batch/seq/block_size, none of which differ); the `off` leg pins
+    ``MXNET_SERVE_SPEC=0`` (the PR-10 one-token-per-step decode), the
+    `spec` leg enables draft-verify decoding (default: the zero-launch
+    n-gram/generation-store drafter at k=6 — warm template repeats
+    accept nearly everything, so a deeper draft run amortizes the
+    verify launch further; ``MXNET_SERVE_SPEC_K`` /
+    ``MXNET_SERVE_SPEC_DRAFTER`` override).  The acceptance contract
+    (ISSUE 11, gated nightly): >= 1.5x tok/s/chip with token-for-token
+    output parity (`output_sig` equal — speculation is exact, not
+    approximate), zero leaked blocks, and zero steady-state recompiles
+    on either leg (verify/draft shapes all join the frozen warmup set).
+    """
+    from mxnet_tpu import telemetry
+
+    shared = {"SERVE_TRACE": "spec", "SERVE_RATE": "0",
+              "MXNET_SERVE_BLOCK_SIZE":
+                  os.environ.get("MXNET_SERVE_BLOCK_SIZE", "8"),
+              "SERVE_NEW": os.environ.get("SERVE_NEW", "32"),
+              "SERVE_PROMPT_MAX": os.environ.get("SERVE_PROMPT_MAX", "24")}
+    spec_env = {"MXNET_SERVE_SPEC": "1",
+                "MXNET_SERVE_SPEC_K":
+                    os.environ.get("MXNET_SERVE_SPEC_K", "6"),
+                "MXNET_SERVE_SPEC_DRAFTER":
+                    os.environ.get("MXNET_SERVE_SPEC_DRAFTER", "ngram")}
+    runs = {}
+    for mode, env in (("off", {"MXNET_SERVE_SPEC": "0"}),
+                      ("spec", spec_env)):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    off, spec = runs["off"], runs["spec"]
+    result = {
+        "metric": "serve_spec_vs_decode",
+        # the acceptance ratio: tok/s/chip at equal HBM (spec / off)
+        "value": round(spec["value"] / max(off["value"], 1e-9), 3),
+        "unit": "spec/off tok/s/chip ratio (draft-verify vs one token "
+                "per step, equal HBM, templated mixed trace)",
+        "off": off,
+        "spec": spec,
+        "token_parity": off["output_sig"] == spec["output_sig"],
+        "accept_rate": (spec["spec"] or {}).get("accept_rate"),
+        "drafter": (spec["spec"] or {}).get("drafter"),
+        "k": (spec["spec"] or {}).get("k"),
+        "verify_launches": (spec["spec"] or {}).get("verify_launches"),
+        "draft_launches": (spec["spec"] or {}).get("draft_launches"),
+        "ttft_p50_ms": {"off": off["ttft_ms"]["p50"],
+                        "spec": spec["ttft_ms"]["p50"]},
+        "tok_s": {"off": off["value"], "spec": spec["value"]},
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -1057,6 +1203,8 @@ if __name__ == "__main__":
             serve_mixed_bench()
         elif "--prefix" in sys.argv:
             serve_prefix_bench()
+        elif "--spec" in sys.argv:
+            serve_spec_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

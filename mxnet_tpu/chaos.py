@@ -54,6 +54,12 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           block (eviction pressure without real pool
                           exhaustion) — losing a hot prefix must only
                           cost a re-prefill, never correctness
+    draft_junk:P          with probability P a speculative-decoding
+                          round's draft proposals are deterministically
+                          corrupted before the verify launch — the
+                          engine must still emit parity output (verify
+                          re-derives truth from the target model), only
+                          the accept rate drops
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
@@ -82,6 +88,7 @@ __all__ = [
     "reset", "rpc_action", "maybe_crash_server", "grad_poison",
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
     "serve_queue_flood", "serve_block_exhaust", "serve_prefix_evict",
+    "serve_draft_junk",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -117,6 +124,7 @@ class _Spec:
         self.queue_flood = None           # (per-step rate, total cap)
         self.block_exhaust = 0.0          # probability per allocation
         self.prefix_evict = 0.0           # probability per scheduler step
+        self.draft_junk = 0.0             # probability per spec round
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -149,6 +157,8 @@ class _Spec:
                 self.block_exhaust = float(parts[1])
             elif kind == "prefix_evict":
                 self.prefix_evict = float(parts[1])
+            elif kind == "draft_junk":
+                self.draft_junk = float(parts[1])
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -354,6 +364,20 @@ def serve_prefix_evict():
     with s.lock:
         return bool(s.rng_for("prefix_evict").random_sample()
                     < s.prefix_evict)
+
+
+def serve_draft_junk():
+    """True when the CURRENT speculative-decoding round's draft
+    proposals should be corrupted (`draft_junk:P`): a drafter gone
+    rogue is a QUALITY fault, never a correctness one — verify accepts
+    only tokens the target itself would have picked, so the engine must
+    keep emitting parity output at a (much) lower accept rate."""
+    s = spec()
+    if s is None or s.draft_junk <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("draft_junk").random_sample()
+                    < s.draft_junk)
 
 
 def serve_queue_flood():

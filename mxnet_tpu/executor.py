@@ -402,6 +402,13 @@ class AotCache:
                                    key=str(key)[:200])
         return winner
 
+    def keys(self):
+        """Snapshot of the cached executable keys (introspection: the
+        serving tests assert the warmup bucket set — e.g. that the
+        speculative verify/draft shapes joined it before `freeze`)."""
+        with self._lock:
+            return sorted(self._cache)
+
     def freeze(self):
         """Declare the compiled set complete (the serving engine calls
         this after `warmup()`): any later build is counted in

@@ -250,6 +250,66 @@ def chunk_attention(q, k_cache, v_cache, start, num_heads, *, scale=None):
     return out.reshape(b, c, e).astype(q.dtype)
 
 
+def verify_attention(q, k_cache, v_cache, start, length, num_heads, *,
+                     scale=None):
+    """Length-masked multi-query verify attention (speculative decoding).
+
+    The draft-verify generalization of `chunk_attention`: a c-token
+    query chunk at absolute positions ``start .. start+c-1`` attends to
+    the cached prefix plus itself causally, but only the first
+    ``length[b]`` chunk tokens of each row are REAL — chunk keys at
+    offsets >= length are masked for every query (padding rows, or
+    speculative positions clipped at the cache end), with each query's
+    own position kept visible so fully-masked queries stay finite
+    (their outputs are don't-cares the engine never emits).
+
+    ``length == c`` reproduces `chunk_attention` bit-for-bit (every
+    real query already attends only keys <= its own position, all of
+    which are real), so the speculative verify step and chunked prefill
+    share one masking contract; c=1 with length=1 degenerates to
+    `decode_attention` — one launch scores a whole draft run with the
+    numerics single-token decode would have produced.
+
+    q:        (b, c, embed)  — query projections of the fed chunk
+              (row's last emitted token + its k draft proposals)
+    k_cache:  (b, S, embed)  — keys, the chunk's own rows already
+              scattered in by the caller
+    v_cache:  (b, S, embed)
+    start:    (b,) int       — absolute position of each row's chunk
+    length:   (b,) int       — real fed tokens per row (1 <= length <= c)
+    Returns (b, c, embed).  f32 softmax statistics like the siblings.
+    """
+    b, c, e = q.shape
+    s = k_cache.shape[1]
+    if e % num_heads != 0:
+        raise MXNetError(
+            "verify_attention: embed %d not divisible by num_heads %d"
+            % (e, num_heads))
+    hd = e // num_heads
+    if scale is None:
+        scale = 1.0 / float(hd) ** 0.5
+    qh = q.reshape(b, c, num_heads, hd)
+    kh = k_cache.reshape(b, s, num_heads, hd)
+    vh = v_cache.reshape(b, s, num_heads, hd)
+    scores = jnp.einsum(
+        "bchd,bshd->bhcs", qh.astype(jnp.float32), kh.astype(jnp.float32),
+        preferred_element_type=jnp.float32) * scale
+    start = start.astype(jnp.int32)
+    qpos = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]  # (b, c)
+    j = jnp.arange(s, dtype=jnp.int32)[None, None, :]
+    causal = j <= qpos[:, :, None]                       # (b, c, s)
+    # chunk keys past each row's real length are garbage; a query's own
+    # position stays visible so out-of-length queries keep a finite
+    # softmax (their outputs are discarded, never attended again)
+    real = (j < (start + length.astype(jnp.int32))[:, None, None]) | \
+        (j == qpos[:, :, None])
+    scores = jnp.where((causal & real)[:, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhcs,bshd->bchd", p, vh.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, c, e).astype(q.dtype)
+
+
 class DecodeAttention(OpDef):
     """Symbol-level wrapper of `decode_attention` so KV-cache decode graphs
     can be expressed with the op registry (query (batch, embed), caches

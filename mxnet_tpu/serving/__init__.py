@@ -29,6 +29,12 @@ package is the production path on top of it (ROADMAP item 1):
   through the same bucket shapes).  Per-request deadlines, cancellation,
   and a bounded queue with configurable overload policy
   (``MXNET_SERVE_OVERLOAD=shed|block|degrade``) make it SLO-grade.
+* `spec.Drafter` / `NgramDrafter` / `ModelDrafter` — speculative
+  decoding (`MXNET_SERVE_SPEC`): a drafter proposes k tokens per row,
+  one batched verify launch scores them against the target over the
+  same paged blocks, and accepted prefixes advance rows 1..k+1 tokens
+  per iteration at exact output parity (the position-folded sampler
+  makes the accept rule deterministic at any temperature).
 * `engine.ReplicaRouter` — least-depth dispatch over per-device engine
   replicas (the mesh scale-out path) with heartbeat monitoring, failover
   of a dead replica's queued requests to survivors, and background
@@ -41,6 +47,7 @@ from .decode import TransformerKVModel
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
+from .spec import Drafter, NgramDrafter, ModelDrafter, make_drafter
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
                      ServeQuarantined, ServeBlocksExhausted,
@@ -48,7 +55,8 @@ from .errors import (ServeError, ServeTimeout, ServeOverload,
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
            "ReplicaRouter", "BlockAllocator", "PrefixCache", "TRASH_BLOCK",
-           "sample_tokens", "ServeError", "ServeTimeout", "ServeOverload",
+           "sample_tokens", "Drafter", "NgramDrafter", "ModelDrafter",
+           "make_drafter", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
            "ServeBlocksExhausted", "ServeCacheInvalidated",
            "ServeEngineDead"]

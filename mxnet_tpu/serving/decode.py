@@ -38,7 +38,8 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ops.attention import (gather_paged_kv, paged_decode_attention,
-                             decode_attention, chunk_attention)
+                             decode_attention, chunk_attention,
+                             verify_attention)
 from ..ops.pallas_kernels.flash_attention import flash_attention
 from ..ops.pallas_kernels.layer_norm import layer_norm
 
@@ -343,13 +344,21 @@ class TransformerKVModel:
         """
         e = self.num_embed
         bs = pool.shape[3]
+        m = tables.shape[1]
         pos = pos.astype(jnp.int32)
         tables = tables.astype(jnp.int32)
-        blk = jnp.take_along_axis(tables, (pos // bs)[:, None],
+        # positions past the table's coverage redirect to the trash
+        # block EXPLICITLY (the speculative drafter's in-graph scan can
+        # run a row past the cache end; clamping the table lookup would
+        # scatter into a REAL tail block instead)
+        ent = pos // bs
+        blk = jnp.take_along_axis(tables, jnp.minimum(ent, m - 1)[:, None],
                                   axis=1)[:, 0]               # (b,)
+        blk = jnp.where(ent < m, blk, 0)
         off = pos % bs
         x = jnp.take(params["embed_weight"], token.astype(jnp.int32), axis=0)
-        x = x + jnp.take(params["pos_embed_weight"][0], pos, axis=0)
+        x = x + jnp.take(params["pos_embed_weight"][0],
+                         jnp.minimum(pos, self.seq_len - 1), axis=0)
         for i in range(self.num_layers):
             p = "layer%d_" % i
             hn = layer_norm(x, params[p + "ln1_gamma"],
@@ -367,6 +376,74 @@ class TransformerKVModel:
             f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
             x = x + self._proj(params, f, p + "ffn2")
         return self._head(params, x), pool
+
+    def verify_paged(self, params, pool, tokens, pos, length, tables):
+        """Speculative-decoding verify: score a whole draft run with ONE
+        launch (the draft-verify counterpart of `decode_paged`).
+
+        tokens: (b, c) int32 — column 0 is each row's last emitted token
+                (what single-token decode would feed), columns 1..c-1
+                its draft proposals.
+        pos:    (b,) int32 — the absolute position column 0 occupies;
+                tokens[:, j] is fed at pos + j.
+        length: (b,) int32 — real fed tokens per row (rows clipped at
+                the cache end feed fewer; padding rows feed 1).
+        tables: (b, m) int32 block tables; blocks covering
+                pos .. pos+length-1 must be EXCLUSIVELY owned (the
+                engine's span-grow/CoW guarantees it — this scatters).
+        Returns (logits (b, c, vocab), pool): logits at EVERY fed
+        position, so the accept rule can compare the target's own pick
+        at pos+j against draft j+1 — identical context to sequential
+        decode up to the first rejection, hence token-for-token parity.
+
+        Unlike `prefill_paged`, c need not be block-aligned and pos is
+        arbitrary: K/V scatter by per-position (block, offset) pairs,
+        exactly `decode_paged`'s addressing vectorized over the chunk.
+        Positions past the table's coverage (speculation clipped at the
+        cache end) redirect to the trash block explicitly.
+        """
+        b, c = tokens.shape
+        h, e = self.num_heads, self.num_embed
+        bs = pool.shape[3]
+        m = tables.shape[1]
+        pos = pos.astype(jnp.int32)
+        length = length.astype(jnp.int32)
+        tables = tables.astype(jnp.int32)
+        positions = pos[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+        ent = positions // bs                                  # (b, c)
+        blk = jnp.take_along_axis(tables, jnp.minimum(ent, m - 1), axis=1)
+        blk = jnp.where(ent < m, blk, 0)
+        off = positions % bs
+        x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
+                     axis=0)
+        x = x + jnp.take(params["pos_embed_weight"][0],
+                         jnp.minimum(positions, self.seq_len - 1), axis=0)
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            hn = layer_norm(x, params[p + "ln1_gamma"],
+                            params[p + "ln1_beta"], self.eps)
+            hf = hn.reshape(-1, e)
+            q = self._proj(params, hf, p + "q").reshape(b, c, e)
+            k = self._proj(params, hf, p + "k").reshape(b, c, e)
+            v = self._proj(params, hf, p + "v").reshape(b, c, e)
+            # scatter the whole fed span, then gather the context: the
+            # draft tokens attend to each other causally, exactly as
+            # sequential decode would have cached them one by one
+            pool = pool.at[i, 0, blk, off].set(k.astype(pool.dtype))
+            pool = pool.at[i, 1, blk, off].set(v.astype(pool.dtype))
+            kc = gather_paged_kv(pool[i, 0], tables)
+            vc = gather_paged_kv(pool[i, 1], tables)
+            attn = verify_attention(q, kc, vc, pos, length, h)
+            x = x + self._proj(params, attn.reshape(-1, e),
+                               p + "attn_out").reshape(b, c, e)
+            hn = layer_norm(x, params[p + "ln2_gamma"],
+                            params[p + "ln2_beta"], self.eps)
+            f = jax.nn.gelu(self._proj(params, hn.reshape(-1, e),
+                                       p + "ffn1"))
+            x = x + self._proj(params, f, p + "ffn2").reshape(b, c, e)
+        logits = self._head(params, x.reshape(-1, e)).reshape(
+            b, c, self.vocab_size)
+        return logits, pool
 
     def write_prefill(self, cache, kv, length, slots):
         """Scatter a prefill's (num_layers, 2, b, s, embed) K/V block into

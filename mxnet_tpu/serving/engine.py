@@ -89,6 +89,7 @@ from ..context import Context
 from ..executor import AotCache
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
+from .spec import make_drafter
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
                      ServeQuarantined, ServeBlocksExhausted,
@@ -289,7 +290,8 @@ class ServingEngine:
                  queue_max=None, overload=None, deadline_ms=None, aot=None,
                  paged=None, block_size=None, n_blocks=None,
                  chunk_prefill=None, sampling=None, prefix=None,
-                 prefix_pool=None):
+                 prefix_pool=None, spec=None, spec_k=None,
+                 spec_drafter=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -417,6 +419,26 @@ class ServingEngine:
             self._cache = model.init_cache(self.max_batch + 1,
                                            device=self._device)
             self._prefilling = {}
+        # speculative decoding (MXNET_SERVE_SPEC, default off: the
+        # PR-10 single-token decode path is bit-for-bit untouched at 0)
+        self._spec = _env_flag("MXNET_SERVE_SPEC", "0") if spec is None \
+            else bool(spec)
+        self._spec_k = int(os.environ.get("MXNET_SERVE_SPEC_K", "4")
+                           if spec_k is None else spec_k)
+        self._drafter_arg = spec_drafter
+        self._drafter = None
+        if self._spec:
+            if not self._paged:
+                raise MXNetError(
+                    "ServingEngine: speculative decoding needs the paged "
+                    "cache (MXNET_SERVE_SPEC=1 with MXNET_SERVE_PAGED=0)")
+            if self._spec_k < 1:
+                raise MXNetError("ServingEngine: MXNET_SERVE_SPEC_K must "
+                                 "be >= 1, got %d" % self._spec_k)
+            self._drafter = make_drafter(
+                os.environ.get("MXNET_SERVE_SPEC_DRAFTER", "ngram")
+                if spec_drafter is None else spec_drafter)
+            self._drafter.bind(self)
         self._aot = aot if aot is not None else AotCache("serve.aot")
         # gauges are namespaced per replica: engines share one process-wide
         # registry, and a global "serve.queue_depth" written by N scheduler
@@ -446,7 +468,11 @@ class ServingEngine:
                       # prefix caching (0s when disabled)
                       "prefix_hits": 0, "prefix_tokens": 0,
                       "prefix_lookup_tokens": 0, "prefix_bootstraps": 0,
-                      "cow_copies": 0, "prefix_evictions": 0}
+                      "cow_copies": 0, "prefix_evictions": 0,
+                      # speculative decoding (0s when disabled)
+                      "verify_steps": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "spec_rollbacks": 0,
+                      "spec_junk_rounds": 0}
 
     # -- program building --------------------------------------------------
     _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
@@ -540,6 +566,63 @@ class ServingEngine:
 
         return self._aot.get(("decode", b_bucket, 1), build)
 
+    def _pick_cols(self, logits, samp, pos):
+        """`_pick` over a (b, c, vocab) verify chunk: column j's token
+        will occupy absolute position pos + j + 1 — the same RNG fold
+        keys sequential decode would have used, which is exactly why a
+        verified prefix is bit-identical to the non-speculative path."""
+        b, c, v = logits.shape
+        if not self._sampling:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        newpos = pos.astype(jnp.int32)[:, None] + 1 + \
+            jnp.arange(c, dtype=jnp.int32)[None]
+        temp, top_k, top_p, seed = (jnp.repeat(a, c, axis=0) for a in samp)
+        flat = sample_tokens(logits.reshape(b * c, v), temp, top_k, top_p,
+                             seed, newpos.reshape(-1))
+        return flat.reshape(b, c)
+
+    def _compiled_verify(self, b_bucket):
+        """The draft-verify step: ONE launch scores a whole draft run
+        (`verify_paged`), picks the target's own token at every fed
+        position, and counts in-graph how many leading drafts match.
+        Output rows are [picked_0 .. picked_k, n_accepted] — a single
+        (b, k+2) host fetch, the same per-step traffic discipline as
+        plain decode."""
+        c = self._spec_k + 1
+
+        def build():
+            def prog(params, pool, tokens, pos, length, tables, *samp):
+                logits, pool = self.model.verify_paged(
+                    params, pool, tokens, pos, length, tables)
+                picked = self._pick_cols(logits, samp, pos)
+                draft = tokens[:, 1:].astype(jnp.int32)
+                match = (picked[:, :-1] == draft).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(match, axis=1),
+                              axis=1).astype(jnp.int32)
+                return jnp.concatenate([picked, acc[:, None]], axis=1), pool
+
+            fn = jax.jit(prog, donate_argnums=(1,))
+            toks = self._put(np.zeros((b_bucket, c), np.int32))
+            z = self._put(np.zeros((b_bucket,), np.int32))
+            one = self._put(np.ones((b_bucket,), np.int32))
+            tables = self._put(np.zeros((b_bucket, self._n_table),
+                                        np.int32))
+            samp = tuple(self._put(a)
+                         for a in self._sample_placeholders(b_bucket))
+            return fn.lower(self._params, self._cache, toks, z, one,
+                            tables, *samp).compile()
+
+        return self._aot.get(("verify", b_bucket, c), build)
+
+    def _verify_watch_arrays(self, b):
+        toks = np.zeros((b, self._spec_k + 1), np.int32)
+        z = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self._n_table), np.int32)
+        samp = self._sample_placeholders(b)
+        return ((toks, z, z, tables) + samp,
+                ("tokens", "pos", "length", "tables")
+                + self._SAMPLE_NAMES[:len(samp)])
+
     def _compiled_cow(self):
         """The copy-on-write body: one block's rows copied pool→pool
         (every layer, K and V) with the pool donated — in-place on the
@@ -609,6 +692,20 @@ class ServingEngine:
             self._compiled_decode(b)
             arrays, names = self._decode_watch_arrays(b)
             self._watch("decode", arrays, names, b, seed=True)
+        if self._spec:
+            # the verify (b, k+1) shapes — and the drafter's own
+            # programs — JOIN the decode bucket set (plain decode stays
+            # compiled: it is the no-usable-draft fallback round), all
+            # compiled and watchdog-seeded here so `AotCache.freeze()`
+            # still means "steady state compiles nothing" with
+            # speculation on
+            for b in self.decode_buckets:
+                self._compiled_verify(b)
+                arrays, names = self._verify_watch_arrays(b)
+                self._watch("verify", arrays, names, b, seed=True)
+                darrays, dnames = self._decode_watch_arrays(b)
+                self._watch("draft", darrays, dnames, b, seed=True)
+            self._drafter.warmup()
         if self._prefix is not None:
             self._compiled_cow()
             arrays, names = self._cow_watch_arrays()
@@ -618,7 +715,9 @@ class ServingEngine:
                 "decode": list(self.decode_buckets),
                 "cache": "paged" if self._paged else "slot",
                 "block_size": self.block_size, "n_blocks": self.n_blocks,
-                "prefix": self._prefix is not None}
+                "prefix": self._prefix is not None,
+                "spec": None if not self._spec else
+                {"k": self._spec_k, "drafter": self._drafter.name}}
 
     def respawn(self):
         """A replacement engine for this (dead) replica: same device,
@@ -638,7 +737,11 @@ class ServingEngine:
             paged=self._paged, block_size=self.block_size,
             n_blocks=self.n_blocks, chunk_prefill=self._chunk_prefill,
             sampling=self._sampling, prefix=self._prefix is not None,
-            prefix_pool=self._prefix_pool)
+            prefix_pool=self._prefix_pool, spec=self._spec,
+            spec_k=self._spec_k,
+            spec_drafter=self._drafter_arg if self._drafter_arg is not None
+            else (self._drafter.name if self._drafter is not None
+                  else None))
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
@@ -1002,6 +1105,8 @@ class ServingEngine:
             self._alloc.reset()
             self._cache = self.model.init_block_pool(
                 self.n_blocks, self.block_size, device=self._device)
+            if self._drafter is not None:
+                self._drafter.on_cache_rebuild()
             self._block_gauges()
         else:
             self._cache = self.model.init_cache(self.max_batch + 1,
@@ -1238,6 +1343,12 @@ class ServingEngine:
             self._drop_prefill(pf)
             self._quarantine(req, "prefill launch failed: %s" % e)
             return
+        if self._drafter is not None:
+            # the draft cache prefills in lockstep over the SAME chunk
+            # arrays and block table — positions the draft never cached
+            # would otherwise cost accept rate on every token after them
+            self._drafter.on_prefill_chunk(toks_d, start_d, length_d,
+                                           table_d)
         pf.done += chunk
         self.stats["prefill_chunks"] += 1
         telemetry.inc("serve.prefill_chunks")
@@ -1298,28 +1409,36 @@ class ServingEngine:
         the prefix cache) rebuilds its context — greedy decoding and the
         position-keyed sampler both replay identically, so preemption is
         invisible in the output."""
+        # speculation writes a whole span per step (the fed token plus k
+        # drafts, clipped at the cache end), so every block the span
+        # lands in — not just one — must exist and be exclusively owned
+        span = self._spec_k + 1 if self._spec else 1
         for row, seq in list(self._active.items()):
             if row not in self._active:
                 continue  # a CoW cache-loss rebuild retired the rest
-            need = seq.pos // self.block_size + 1
+            last_write = min(seq.pos + span, self.model.seq_len) - 1
+            need = last_write // self.block_size + 1
             if need > len(seq.blocks):
                 got = self._alloc_blocks(need - len(seq.blocks))
-                if got is not None:
-                    seq.blocks.extend(got)
-                    self._block_gauges()
+                if got is None:
+                    self._preempt(row, seq)
                     continue
-            else:
-                wb = seq.blocks[need - 1]
-                if self._alloc.refcount(wb) <= 1 and \
+                seq.blocks.extend(got)
+                self._block_gauges()
+            for idx in range(seq.pos // self.block_size, need):
+                if row not in self._active:
+                    break  # a scoped CoW failure preempted this row
+                wb = seq.blocks[idx]
+                if self._alloc.exclusive(wb) and \
                         (self._prefix is None
                          or not self._prefix.contains(wb)):
                     continue  # sole unregistered owner: write in place
                 got = self._alloc_blocks(1)
-                if got is not None:
-                    if not self._cow(seq, need - 1, got[0]):
-                        return  # cache rebuilt (or fatal raised)
-                    continue
-            self._preempt(row, seq)
+                if got is None:
+                    self._preempt(row, seq)
+                    break
+                if not self._cow(seq, idx, got[0]):
+                    return  # cache rebuilt (or fatal raised)
 
     def _cow(self, seq, idx, dst):
         """Copy block ``seq.blocks[idx]`` into ``dst`` and repoint the
@@ -1345,6 +1464,11 @@ class ServingEngine:
             self._drop_refs([dst])
             self._preempt_seq_row(seq)
             return True
+        if self._drafter is not None:
+            # mirror the copy in the draft pool: the draft rows live at
+            # the same (block, offset) coordinates (accept-rate hygiene
+            # only — a stale draft block cannot corrupt output)
+            self._drafter.on_cow(*arrays)
         seq.blocks[idx] = dst
         self._drop_refs([src])
         self.stats["cow_copies"] += 1
@@ -1391,6 +1515,11 @@ class ServingEngine:
         if enter:
             del self._active[slot]
         self._free.append(slot)
+        if self._drafter is not None and seq.ctx is not None:
+            # learning drafters index completed generations (the REST-
+            # style store): deterministic decoding makes a finished
+            # stream an exact oracle for the next identical request
+            self._drafter.on_retire(seq.ctx + [seq.last])
         self._release_blocks(seq)
         seq.req._finish()
         self.stats["completed"] += 1
@@ -1522,6 +1651,17 @@ class ServingEngine:
             ms = chaos.serve_decode_slow()
             if ms:
                 time.sleep(ms / 1e3)
+        if self._spec:
+            return self._decode_spec()
+        return self._decode_plain()
+
+    def _decode_plain(self):
+        """One single-token decode launch over the active set (the
+        PR-10 iteration body; also the speculative mode's fallback when
+        no row has a usable draft — a verify launch that can only
+        accept zero drafts would pay the k+1-wide program for the same
+        one token per row this computes)."""
+        n = len(self._active)
         b = self._bucket_for(n, self.decode_buckets)
         slots = list(self._active)
         seqs = [self._active[s] for s in slots]
@@ -1551,20 +1691,9 @@ class ServingEngine:
                 raise chaos.ChaosError("chaos: injected decode launch error")
             nxt, self._cache = compiled(self._params, self._cache, *args)
         except Exception as e:
-            kind = self._classify_failure(e)
-            if kind == "device":
-                raise _EngineFatal("decode launch failed: %s" % e) from e
-            if kind == "cache":
-                self._rebuild_cache("decode launch failed: %s" % e)
-                return len(self._active) + len(self._prefilling)
             # scoped/transient: the donated cache survived — retry the
             # same decode next iteration, escalate after N consecutive
-            self._launch_fails += 1
-            self._count("launch_errors")
-            if self._launch_fails >= self._launch_retries:
-                raise _EngineFatal(
-                    "decode launch failed %d consecutive times (last: %s)"
-                    % (self._launch_fails, e)) from e
+            self._handle_launch_failure(e, "decode")
             return len(self._active) + len(self._prefilling)
         self._launch_fails = 0
         nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
@@ -1577,25 +1706,194 @@ class ServingEngine:
         telemetry.inc("serve.decode_padded", b - n)
         telemetry.set_gauge(self._gauge + "batch_occupancy", n / float(b))
         for i, (slot, seq) in enumerate(zip(slots, seqs)):
-            t = int(nxt[i])
-            if seq.req.t_first is None:
-                # a prefix-bootstrap admission skipped prefill: THIS is
-                # its first token (ttft = pure cache-hit latency)
-                seq.req.t_first = time.perf_counter()
-            seq.req.tokens.append(t)
-            if seq.ctx is not None:
-                seq.ctx.append(seq.last)  # the token cached at old pos
-            seq.last = t
-            seq.pos += 1
-            seq.n_new += 1
-            if self._prefix is not None and \
-                    seq.pos % self.block_size == 0:
-                # the block behind `pos` just filled with real rows:
-                # publish it (eagerly — concurrent requests share it
-                # while this one keeps decoding; CoW guards the writer)
-                self._register_prefix(seq.ctx, seq.blocks, seq.pos)
-            if self._seq_finished(seq, t):
+            finished = self._advance_one(seq, int(nxt[i]))
+            if not finished and self._drafter is not None \
+                    and seq.ctx is not None:
+                # adaptive-fallback rounds still feed the drafter's
+                # store: a staggered twin drafts off this row's stream
+                self._drafter.observe(seq.ctx + [seq.last], 1)
+            if finished:
                 self._retire(slot, seq)
+        return len(self._active) + len(self._prefilling)
+
+    def _advance_one(self, seq, t):
+        """Advance one sequence by ONE emitted token ``t`` — the single
+        bookkeeping site both the plain decode loop and the speculative
+        accept loop run, so stopping, truncation, ctx order and prefix
+        registration cannot diverge between them.  Returns True when
+        the sequence finished with this token."""
+        if seq.req.t_first is None:
+            # a prefix-bootstrap admission skipped prefill: THIS is its
+            # first token (ttft = pure cache-hit latency)
+            seq.req.t_first = time.perf_counter()
+        seq.req.tokens.append(t)
+        if seq.ctx is not None:
+            seq.ctx.append(seq.last)  # the token cached at the old pos
+        seq.last = t
+        seq.pos += 1
+        seq.n_new += 1
+        if self._prefix is not None and seq.pos % self.block_size == 0:
+            # the block behind `pos` just filled with real rows: publish
+            # it (eagerly — concurrent requests share it while this one
+            # keeps decoding; CoW guards the writer)
+            self._register_prefix(seq.ctx, seq.blocks, seq.pos)
+        return self._seq_finished(seq, t)
+
+    def _handle_launch_failure(self, e, what):
+        """The decode/verify launch failure ladder, shared so the two
+        iteration modes cannot drift: device death raises
+        `_EngineFatal`, a consumed cache rebuilds (returns True), a
+        scoped/transient fault counts toward the consecutive-failure
+        escalation and retries next iteration (returns False)."""
+        kind = self._classify_failure(e)
+        if kind == "device":
+            raise _EngineFatal("%s launch failed: %s" % (what, e)) from e
+        if kind == "cache":
+            self._rebuild_cache("%s launch failed: %s" % (what, e))
+            return True
+        self._launch_fails += 1
+        self._count("launch_errors")
+        if self._launch_fails >= self._launch_retries:
+            raise _EngineFatal(
+                "%s launch failed %d consecutive times (last: %s)"
+                % (what, self._launch_fails, e)) from e
+        return False
+
+    # -- speculative decode (draft -> verify -> accept/rollback) -----------
+    def _rewind_blocks(self, seq):
+        """Release the speculative tail past the ACCEPTED frontier: the
+        row keeps exactly the blocks covering its cached rows 0..pos-1,
+        everything beyond holds rejected-draft garbage and goes back
+        through `_drop_refs` — the same exactly-one-ref drop site every
+        other release uses.  That routing is the whole safety argument:
+        a tail block another request shares (refcount > 1) loses only
+        THIS row's reference, and a tail block the prefix index
+        registered parks instead of returning to the free list, so a
+        rewind can never free or alias a block someone else still
+        reads.  The floor at `blocks_for(pos)` means accepted context
+        is never rewound, shared prefix blocks included."""
+        keep = max(1, self._alloc.blocks_for(seq.pos))
+        if len(seq.blocks) <= keep:
+            return
+        tail = seq.blocks[keep:]
+        del seq.blocks[keep:]
+        self._drop_refs(tail)
+        self.stats["spec_rollbacks"] += len(tail)
+        self._count("spec.rollbacks", len(tail))
+        self._block_gauges()
+
+    def _decode_spec(self):
+        """One draft-verify-accept iteration over the active set (the
+        MXNET_SERVE_SPEC replacement for the single-token decode step).
+
+        The drafter proposes k tokens per row; ONE verify launch feeds
+        [last, d_1..d_k] at positions pos..pos+k, scatters their K/V
+        through the block tables (the span `_grow_active` secured), and
+        returns the target's own pick at every position plus the count
+        of leading drafts that match those picks.  Accepted tokens are
+        then consumed host-side ONE AT A TIME through the exact
+        bookkeeping the sequential path uses — ctx/pos/n_new advance,
+        blocks register on fill, `_seq_finished` checks EOS/max_new/
+        depth per token — so stopping, truncation and prefix
+        registration are bit-identical to non-speculative decode.
+        Rejected positions hold garbage K/V the next round overwrites
+        before attending; their tail blocks rewind via `_drop_refs`."""
+        n = len(self._active)
+        b = self._bucket_for(n, self.decode_buckets)
+        k = self._spec_k
+        c = k + 1
+        rows = list(self._active)
+        seqs = [self._active[r] for r in rows]
+        token = np.zeros((b, c), np.int32)
+        pos = np.zeros((b,), np.int32)
+        length = np.ones((b,), np.int32)
+        tables = np.full((b, self._n_table), TRASH_BLOCK, np.int32)
+        for i, seq in enumerate(seqs):
+            token[i, 0] = seq.last
+            pos[i] = seq.pos
+            length[i] = min(c, self.model.seq_len - seq.pos)
+            tables[i, :len(seq.blocks)] = seq.blocks
+        pos_d = self._put(pos)
+        tables_d = self._put(tables)
+        samp = self._samp_device([s.req for s in seqs], b)
+        tok0 = token[:, 0].copy()
+        dev = (self._put(tok0), pos_d, tables_d) \
+            if self._drafter.needs_device else None
+        drafts = self._drafter.propose(seqs, k, b, host=(tok0, pos, tables),
+                                       dev=dev, samp=samp)
+        if isinstance(drafts, tuple):
+            drafts, confident = drafts
+            if not np.asarray(confident)[:n].any():
+                # adaptive speculation: with no usable draft anywhere in
+                # the batch a verify could only advance one token per
+                # row — run the (cheaper) plain decode round instead
+                return self._decode_plain()
+        if chaos.enabled() and chaos.serve_draft_junk():
+            # `draft_junk:P`: deterministically corrupt the round's
+            # proposals — parity must hold, only the accept rate drops
+            drafts = (np.asarray(drafts, np.int64) + 1
+                      + np.arange(k, dtype=np.int64)[None]) \
+                % self.model.vocab_size
+            self.stats["spec_junk_rounds"] += 1
+            telemetry.inc("serve.chaos_draft_junk")
+        token[:, 1:] = np.asarray(drafts, np.int32)[:b]
+        token_d = self._put(token)
+        length_d = self._put(length)
+        args = (token_d, pos_d, length_d, tables_d) + samp
+        self._watch("verify", args,
+                    ("tokens", "pos", "length", "tables")
+                    + self._SAMPLE_NAMES[:len(samp)], b)
+        compiled = self._compiled_verify(b)
+        try:
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError("chaos: injected verify launch "
+                                       "error")
+            out, self._cache = compiled(self._params, self._cache, *args)
+        except Exception as e:
+            self._handle_launch_failure(e, "verify")
+            return len(self._active) + len(self._prefilling)
+        self._launch_fails = 0
+        out = np.asarray(out)  # (b, k+2): picks then n_accepted
+        self.stats["verify_steps"] += 1
+        self.stats["decode_rows"] += n
+        self.stats["decode_padded"] += b - n
+        telemetry.inc("serve.verify_steps")
+        telemetry.inc("serve.decode_padded", b - n)
+        telemetry.set_gauge(self._gauge + "batch_occupancy", n / float(b))
+        emitted_total = 0
+        seqs_n_new = [s.n_new for s in seqs]
+        for i, (row, seq) in enumerate(zip(rows, seqs)):
+            # drafts past this row's in-range span can never be emitted
+            # (their K/V went to the trash block); clamp acceptance so
+            # the host loop below cannot walk into them
+            n_acc = min(int(out[i, c]), int(length[i]) - 1)
+            self.stats["spec_proposed"] += k
+            self.stats["spec_accepted"] += n_acc
+            self._count("spec.proposed", k)
+            if n_acc:
+                self._count("spec.accepted", n_acc)
+            finished = False
+            for j in range(n_acc + 1):
+                emitted_total += 1
+                if self._advance_one(seq, int(out[i, j])):
+                    finished = True
+                    break
+            if finished:
+                self._retire(row, seq)
+            else:
+                if seq.n_new > seqs_n_new[i]:
+                    # let a learning drafter see this row's fresh tokens
+                    # now (a concurrent twin drafts off them next round)
+                    self._drafter.observe(seq.ctx + [seq.last],
+                                          seq.n_new - seqs_n_new[i])
+                self._rewind_blocks(seq)
+        self.stats["tokens"] += emitted_total
+        telemetry.inc("serve.tokens", emitted_total)
+        if self.stats["spec_proposed"]:
+            telemetry.set_gauge(
+                self._gauge + "spec_accept_rate",
+                round(self.stats["spec_accepted"]
+                      / float(self.stats["spec_proposed"]), 4))
         return len(self._active) + len(self._prefilling)
 
     # -- worker loop -------------------------------------------------------

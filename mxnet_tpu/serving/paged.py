@@ -28,6 +28,17 @@ registered in the tree: they PARK in an LRU pool and are evicted back to
 the free list only under allocation pressure, so a hot system prompt
 survives across requests.
 
+Speculative decoding (serving/spec.py) rides the same invariants: a
+verify round writes a whole k+1-position span, so the engine allocates
+(and CoW-copies to exclusive ownership) every block the span lands in
+BEFORE the launch, and afterwards REWINDS the tail past the accepted
+frontier.  The rewind is a plain `release` per tail block — never a
+direct `reclaim` — so a tail block another request acquired meanwhile
+loses exactly ONE reference, and a block the prefix index registered
+parks instead of returning to the free list.  Only full blocks of
+ACCEPTED tokens ever register in the `PrefixCache`; speculative garbage
+is structurally unshareable.
+
 Block 0 is reserved as the TRASH block: padding decode rows and the
 unallocated tail entries of every block table point at it, so gathers
 stay in-bounds with fixed shapes and scatters from padding rows land
@@ -101,6 +112,13 @@ class BlockAllocator:
 
     def refcount(self, block):
         return self._ref.get(block, 0)
+
+    def exclusive(self, block):
+        """True when exactly one holder owns ``block`` — the write
+        precondition every scatter target must satisfy (the engine
+        additionally requires the block to be absent from the prefix
+        index: a registered block may gain readers at any moment)."""
+        return self._ref.get(block, 0) == 1
 
     def blocks_for(self, n_tokens):
         """Blocks needed to hold ``n_tokens`` cache rows."""

@@ -40,6 +40,15 @@ elif [ "$1" = "--serve-prefix-smoke" ]; then
     T1=""
     set -- tests/test_serve_prefix.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-spec-smoke" ]; then
+    # fast speculative-decoding smoke: verify-attention numerics, T=0/T>0
+    # token parity for both drafters, the rewind-sharing regression,
+    # draft_junk/block_exhaust chaos with speculation on, and the spec
+    # zero-retrace gate (docs/serving.md "Speculative decoding")
+    shift
+    T1=""
+    set -- tests/test_serve_spec.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

@@ -192,6 +192,50 @@ print("prefix gate passed: ttft p50 %s->%s ms (%sx), concurrency %s->%s, "
                        prefix["max_concurrent"], rec["prefix_hit_rate"]))
 PY
 
+# -- speculative-decoding serve gate (docs/serving.md "Speculative
+# decoding") --------------------------------------------------------------
+# draft-verify vs one-token-per-step A/B at EQUAL HBM on the templated
+# mixed-length trace: the spec leg must deliver >= 1.5x tok/s/chip with
+# token-for-token output parity at temperature 0 (speculation is exact,
+# not approximate), zero leaked blocks, and zero steady-state recompiles
+# on either leg (the verify/draft shapes all join the frozen warmup
+# set); artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    SERVE_REQUESTS=64 \
+    python bench.py --serve --spec | tee /tmp/nightly_serve_spec.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_spec.log").read().strip().splitlines()[-1])
+off, spec = rec["off"], rec["spec"]
+for leg, r in (("off", off), ("spec", spec)):
+    assert r["completed"] == r["requests"], \
+        "spec gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["steady_state_recompiles"] == 0, \
+        "spec gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "spec gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+    assert r["blocks"]["leaked"] == 0, \
+        "spec gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+assert rec["token_parity"], \
+    "spec gate: outputs diverged between spec and non-spec legs"
+assert rec["value"] >= 1.5, \
+    "spec gate: %sx tok/s/chip below the 1.5x acceptance floor " \
+    "(accept_rate %s)" % (rec["value"], rec["accept_rate"])
+print("spec gate passed: %sx tok/s (%s -> %s), accept_rate %s, "
+      "drafter %s k=%s" % (rec["value"], rec["tok_s"]["off"],
+                           rec["tok_s"]["spec"], rec["accept_rate"],
+                           rec["drafter"], rec["k"]))
+PY
+
+# -- speculative-decoding chaos smoke: draft_junk + block_exhaust +
+# prefix_evict with speculation ON must keep token parity (run_tests.sh
+# --serve-spec-smoke runs the same clauses as unit tests)
+./run_tests.sh --serve-spec-smoke -k "chaos or preemption"
+
 # -- serve-chaos gate (docs/serving.md "Failure semantics") ---------------
 # the same Poisson run with one replica crashed mid-traffic, slow decode
 # steps, and injected launch errors: every request must RESOLVE (tokens

@@ -63,6 +63,14 @@ SERVE_RESILIENCE_EVENT_KINDS = (
     "serve_respawn_compiled", "serve_cache_rebuild", "serve_quarantine",
     "serve_preempt", "aot_frozen_compile")
 
+# speculative decoding accounting (docs/serving.md "Speculative
+# decoding"): serve.spec.* counters + the per-replica accept-rate gauge
+# (serve.<name>.spec_accept_rate) and draft-degradation events
+SERVE_SPEC_COUNTERS = (
+    "serve.spec.proposed", "serve.spec.accepted", "serve.spec.rollbacks",
+    "serve.verify_steps", "serve.chaos_draft_junk", "serve.draft_degraded")
+SERVE_SPEC_GAUGE_SUFFIX = ".spec_accept_rate"
+
 
 def load(path):
     records = []
@@ -208,6 +216,23 @@ def summarize(records):
             if agg:
                 serving[name] = agg
         out["serving"] = serving
+    speculation = {k: int(final.get(k, 0)) for k in SERVE_SPEC_COUNTERS
+                   if final.get(k)}
+    if speculation:
+        prop = speculation.get("serve.spec.proposed", 0)
+        if prop:
+            speculation["accept_rate"] = round(
+                speculation.get("serve.spec.accepted", 0) / float(prop), 4)
+        for r in records:
+            for k, v in r.get("gauges", {}).items():
+                if k.startswith("serve.") and \
+                        k.endswith(SERVE_SPEC_GAUGE_SUFFIX):
+                    speculation[k] = v  # last-seen per replica
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == "serve_draft_degraded")
+        if n:
+            speculation["serve_draft_degraded_events"] = n
+        out["speculation"] = speculation
     resilience = {k: int(final.get(k, 0))
                   for k in SERVE_RESILIENCE_COUNTERS if final.get(k)}
     for kind in SERVE_RESILIENCE_EVENT_KINDS:
@@ -260,6 +285,11 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %s" % (key, v))
+    speculation = summary.get("speculation")
+    if speculation:
+        lines.append("  speculation:")
+        for key in sorted(speculation):
+            lines.append("    %-24s %s" % (key, speculation[key]))
     resilience = summary.get("resilience")
     if resilience:
         lines.append("  resilience:")
