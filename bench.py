@@ -878,7 +878,9 @@ def serve_bench(record=True, with_chaos=False):
                             "serve.launch_errors", "serve.failovers",
                             "serve.redispatched", "serve.respawns",
                             "serve.chaos_flooded", "serve.preempted",
-                            "serve.alloc_denied")
+                            "serve.alloc_denied", "serve.migrated",
+                            "serve.replays", "serve.drained",
+                            "serve.stalled", "serve.thrash_trips")
                   if reg.counter(k).value}
     result = {
         "metric": "serve_tokens_per_sec_per_chip",
@@ -1167,6 +1169,160 @@ def serve_spec_bench(record=True):
     return result
 
 
+def serve_durability_bench(record=True):
+    """Durability gate (``python bench.py --serve --durability``): the
+    ISSUE-12 kill-one-of-two-replicas exact-replay acceptance.
+
+    Three legs over ONE fixed greedy (T=0) request set:
+
+    1. **oracle** — 1 replica, no chaos: per-request token truth.
+    2. **crash** — 2 replicas, ``engine_crash`` kills replica0
+       mid-Poisson with the request journal on: 100% of requests —
+       including the admitted in-flight ones on the dead replica, which
+       MIGRATE via journal replay — must complete OK with
+       token-for-token parity vs the oracle leg (replay, not
+       re-generation divergence).
+    3. **drain** — 2 replicas, no chaos: a rolling restart
+       (`router.drain` of each replica in turn, tiny budgets so
+       stragglers really migrate) during the same traffic; zero failed
+       requests, same parity.
+
+    Gate fields (tests/nightly.sh): ``parity`` per leg, ``completed ==
+    requests``, ``hung == 0``, ``leaked == 0``,
+    ``steady_state_recompiles == 0``, and nonzero
+    ``migrated``/``replays`` (crash leg) and ``drained`` (drain leg).
+    """
+    import jax
+
+    from mxnet_tpu import chaos as chaos_mod
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.serving import ReplicaRouter, TransformerKVModel
+
+    n_requests = int(os.environ.get("SERVE_REQUESTS", "24"))
+    rate = float(os.environ.get("SERVE_RATE", "24"))
+    vocab = int(os.environ.get("SERVE_VOCAB", "512"))
+    seq = int(os.environ.get("SERVE_SEQ", "128"))
+    layers = int(os.environ.get("SERVE_LAYERS", "2"))
+    heads = int(os.environ.get("SERVE_HEADS", "4"))
+    embed = int(os.environ.get("SERVE_EMBED", "128"))
+    prompt_max = int(os.environ.get("SERVE_PROMPT_MAX", "24"))
+    max_new = int(os.environ.get("SERVE_NEW", "12"))
+    timeout = float(os.environ.get("SERVE_TIMEOUT", "600"))
+    rng = np.random.RandomState(int(os.environ.get("SERVE_SEED", "0")))
+
+    model = TransformerKVModel(vocab, seq, num_layers=layers,
+                               num_heads=heads, num_embed=embed)
+    params = model.init_params(rng)
+    plens = rng.randint(1, prompt_max + 1, size=n_requests)
+    prompts = [list(rng.randint(0, vocab, size=int(n))) for n in plens]
+    newlens = rng.randint(1, max_new + 1, size=n_requests)
+    n_replicas = min(2, len(jax.devices()))
+
+    def leg(name, replicas, chaos_spec, drain_at=()):
+        old_chaos = os.environ.get("MXNET_CHAOS")
+        if chaos_spec:
+            os.environ["MXNET_CHAOS"] = chaos_spec
+        else:
+            os.environ.pop("MXNET_CHAOS", None)
+        chaos_mod.reset()
+        telemetry.reset()
+        arrivals = np.random.RandomState(1)
+        try:
+            router = ReplicaRouter.from_mesh(model, params,
+                                             n_replicas=replicas)
+            router.warmup()
+            reg = telemetry.registry()
+            compiles = reg.counter("serve.aot.compiles").value
+            router.start()
+            reqs, outs, hung, failed = [], [], 0, 0
+            t0 = time.perf_counter()
+            try:
+                for i, (p, m) in enumerate(zip(prompts, newlens)):
+                    reqs.append(router.submit(p, max_new_tokens=int(m)))
+                    if i in drain_at:
+                        # rolling restart mid-traffic: replica names are
+                        # stable across respawn, so draining the same
+                        # name twice restarts both original incarnations
+                        router.drain("replica%d" % (drain_at.index(i)
+                                                    % replicas),
+                                     deadline_ms=5)
+                    if rate > 0:
+                        time.sleep(arrivals.exponential(1.0 / rate))
+                for r in reqs:
+                    try:
+                        outs.append(r.result(timeout=max(
+                            1.0, timeout - (time.perf_counter() - t0))))
+                    except MXNetError:
+                        outs.append(None)
+                        if r.done:
+                            failed += 1
+                        else:
+                            hung += 1
+            finally:
+                router.stop()
+            leaked = sum(e.leaked_blocks() for e in router.engines
+                         if e._dead is None)
+            steady = reg.counter("serve.aot.compiles").value - compiles
+            counters = {k.split(".", 1)[1]: int(reg.counter(k).value)
+                        for k in ("serve.migrated", "serve.replays",
+                                  "serve.drained", "serve.failovers",
+                                  "serve.respawns", "serve.thrash_trips")
+                        if reg.counter(k).value}
+        finally:
+            # the armed chaos spec must never leak past the leg — a later
+            # in-process bench would otherwise run with crash injection on
+            if old_chaos is None:
+                os.environ.pop("MXNET_CHAOS", None)
+            else:
+                os.environ["MXNET_CHAOS"] = old_chaos
+            chaos_mod.reset()
+        return outs, {
+            "leg": name, "replicas": replicas, "chaos": chaos_spec,
+            "completed": sum(1 for o in outs if o is not None),
+            "failed": failed, "hung": hung, "leaked": leaked,
+            "steady_state_recompiles": steady, "counters": counters,
+        }
+
+    crash_at = max(4, int(os.environ.get(
+        "SERVE_CRASH_STEP", str(n_requests // 3))))
+    oracle, oracle_stats = leg("oracle", 1, None)
+    crash, crash_stats = leg(
+        "crash", n_replicas,
+        "engine_crash:%d:replica0" % crash_at if n_replicas > 1 else None)
+    drain, drain_stats = leg(
+        "drain", n_replicas, None,
+        drain_at=(n_requests // 3, (2 * n_requests) // 3)
+        if n_replicas > 1 else ())
+
+    result = {
+        "metric": "serve_durability",
+        # the headline gate: fraction of requests with exact token
+        # parity vs the undisturbed oracle across BOTH disturbed legs
+        "value": round(sum(
+            1 for legout in (crash, drain)
+            for o, t in zip(legout, oracle) if o == t and o is not None)
+            / float(2 * n_requests), 4),
+        "unit": "oracle-parity fraction (crash + rolling-restart legs, "
+                "T=0 exact replay)",
+        "requests": n_requests,
+        "parity_crash": crash == oracle,
+        "parity_drain": drain == oracle,
+        "oracle": oracle_stats, "crash": crash_stats,
+        "drain": drain_stats,
+        "journal": os.environ.get("MXNET_SERVE_JOURNAL", "1"),
+        "backend": jax.default_backend(),
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -1205,6 +1361,8 @@ if __name__ == "__main__":
             serve_prefix_bench()
         elif "--spec" in sys.argv:
             serve_spec_bench()
+        elif "--durability" in sys.argv:
+            serve_durability_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

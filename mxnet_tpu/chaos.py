@@ -34,6 +34,10 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           `ChaosEngineCrash` at its Nth decode-bearing
                           step — classified as a dead device, so the
                           engine dies and the router's failover path runs
+                          (with the request journal enabled, the dead
+                          replica's ADMITTED in-flight requests migrate
+                          to survivors with exact-replay token parity;
+                          MXNET_SERVE_JOURNAL=0 restores fail-typed)
     launch_error:P        with probability P a serving prefill/decode
                           launch raises `ChaosError` BEFORE the compiled
                           call (the donated cache survives): prefill hits
@@ -48,7 +52,11 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           retry/shed and decode growth (or a denied
                           copy-on-write) preempts the sequence
                           (requeue), never a hang, a scheduler death,
-                          or an aliased write into a shared block
+                          or an aliased write into a shared block; the
+                          anti-thrash policy STALLS a protected row
+                          through a chaos denial (free blocks exist)
+                          instead of burning a replay, so sustained
+                          denial keeps net forward progress
     prefix_evict:P        with probability P a serving scheduler step
                           force-evicts the LRU parked prefix-cache
                           block (eviction pressure without real pool

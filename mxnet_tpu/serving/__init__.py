@@ -39,12 +39,22 @@ package is the production path on top of it (ROADMAP item 1):
   replicas (the mesh scale-out path) with heartbeat monitoring, failover
   of a dead replica's queued requests to survivors, and background
   respawn off the shared AOT cache (recovery compiles nothing).
+* `journal.RequestJournal` — router-owned durability ledger
+  (`MXNET_SERVE_JOURNAL`): a dead or draining replica's ADMITTED
+  in-flight requests migrate to survivors via the exact-replay
+  `(prompt+generated)[:pos]` resume formula — token-for-token identical
+  continuation at any temperature — and `ReplicaRouter.drain` turns
+  that into zero-loss rolling restarts.  Anti-thrash preemption
+  (`MXNET_SERVE_MIN_PROGRESS`, oldest-request protection, a
+  preemption-storm detector tripping the degrade path) guarantees net
+  forward progress under sustained block-pool pressure.
 * `errors` — the typed failure taxonomy every request resolves to.
 
 See docs/serving.md.
 """
 from .decode import TransformerKVModel
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
+from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
 from .spec import Drafter, NgramDrafter, ModelDrafter, make_drafter
@@ -54,7 +64,8 @@ from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeCacheInvalidated, ServeEngineDead)
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
-           "ReplicaRouter", "BlockAllocator", "PrefixCache", "TRASH_BLOCK",
+           "ReplicaRouter", "RequestJournal", "journal_enabled",
+           "BlockAllocator", "PrefixCache", "TRASH_BLOCK",
            "sample_tokens", "Drafter", "NgramDrafter", "ModelDrafter",
            "make_drafter", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",

@@ -68,6 +68,25 @@ rebuilt, only a dead device kills the scheduler); and a dead replica's
 queued-but-not-admitted requests fail over to surviving replicas while
 the `ReplicaRouter` respawns a replacement that re-warms from the SHARED
 AOT cache — recovery compiles nothing.
+
+DURABILITY (docs/serving.md "Durability"): replica death and planned
+restarts are additionally output-invisible for ADMITTED requests.  The
+router's request journal (serving/journal.py, ``MXNET_SERVE_JOURNAL``)
+migrates a dead replica's in-flight requests to survivors through the
+same `(prompt+generated)[:pos]` exact-replay resume the preemption path
+already uses — deterministic request-keyed sampling makes the
+continuation token-for-token identical at any temperature — and
+`engine.drain`/`router.drain` turn that into zero-loss rolling restarts
+(admission closes, in-flight work serves out, stragglers migrate, the
+replacement warms off the shared AotCache and compiles nothing).
+Anti-thrash preemption keeps sustained `block_exhaust` pressure from
+degenerating into preempt/replay churn: a resumed sequence is exempt
+from re-preemption until it advances ``MXNET_SERVE_MIN_PROGRESS``
+tokens (a denied-but-protected row STALLS in place instead — no replay
+burned), the oldest in-flight request is never preempted (livelock
+breaker: someone always finishes), and a preemption storm
+(``MXNET_SERVE_THRASH_TRIP`` preemptions with no completion) trips the
+PR-8 degrade path until the pool drains.
 """
 from __future__ import annotations
 
@@ -87,6 +106,7 @@ from .. import telemetry
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
+from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
 from .spec import make_drafter
@@ -172,6 +192,11 @@ class ServeRequest:
         self._cancelled = False
         self._requeues = 0        # cache-loss retries already burned
         self._waker = None        # set by the owning engine at enqueue
+        self._preempt_n_new = None  # n_new at the last preemption: a
+        #                           resumed request is exempt from another
+        #                           preemption until it advances
+        #                           MXNET_SERVE_MIN_PROGRESS tokens past it
+        self._migrated = False    # journal migration pending its replay
 
     @property
     def done(self):
@@ -291,7 +316,7 @@ class ServingEngine:
                  paged=None, block_size=None, n_blocks=None,
                  chunk_prefill=None, sampling=None, prefix=None,
                  prefix_pool=None, spec=None, spec_k=None,
-                 spec_drafter=None):
+                 spec_drafter=None, min_progress=None, thrash_trip=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -452,11 +477,28 @@ class ServingEngine:
         self._active = {}         # slot -> _Seq (insertion-ordered)
         self._free = list(range(self.max_batch))
         self._stopped = threading.Event()
+        self._draining = False    # drain(): admission closed, queue serves out
         self._wake = threading.Event()  # set by submit(): work arrived
         self._thread = None
         self._dead = None         # scheduler-fatal error message, if any
-        self._on_death = None     # router failover hook: fn(engine, pending, msg)
+        self._on_death = None     # router failover hook:
+        #                           fn(engine, pending, inflight, msg)
         self._launch_fails = 0    # consecutive decode launch failures
+        # anti-thrash preemption (docs/serving.md "Durability"): a resumed
+        # sequence is exempt from re-preemption until it advances
+        # min_progress tokens (0 = PR-9 preempt-on-every-denial), the
+        # oldest in-flight request is never chosen as a victim, and
+        # thrash_trip preemptions without a completion trip the PR-8
+        # degrade path (0 = never trip)
+        self._min_progress = int(
+            os.environ.get("MXNET_SERVE_MIN_PROGRESS", "4")
+            if min_progress is None else min_progress)
+        self._thrash_trip = int(
+            os.environ.get("MXNET_SERVE_THRASH_TRIP", "8")
+            if thrash_trip is None else thrash_trip)
+        self._stalled = set()     # rows sitting out THIS decode step
+        self._preempts_since_retire = 0
+        self._storm = False       # preemption storm: degrade admissions
         self.last_beat = time.monotonic()  # scheduler heartbeat
         # bench accounting (host-side, touched only by the scheduler)
         self.stats = {"decode_steps": 0, "decode_rows": 0,
@@ -472,7 +514,9 @@ class ServingEngine:
                       # speculative decoding (0s when disabled)
                       "verify_steps": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_rollbacks": 0,
-                      "spec_junk_rounds": 0}
+                      "spec_junk_rounds": 0,
+                      # durability (journal replay / drain / anti-thrash)
+                      "replays": 0, "stalls": 0, "thrash_trips": 0}
 
     # -- program building --------------------------------------------------
     _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
@@ -741,7 +785,8 @@ class ServingEngine:
             spec_k=self._spec_k,
             spec_drafter=self._drafter_arg if self._drafter_arg is not None
             else (self._drafter.name if self._drafter is not None
-                  else None))
+                  else None),
+            min_progress=self._min_progress, thrash_trip=self._thrash_trip)
 
     # -- request intake ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
@@ -839,6 +884,12 @@ class ServingEngine:
         if self._dead is not None:
             raise ServeEngineDead("ServingEngine %s: scheduler died: %s"
                                   % (self.name, self._dead))
+        if self._draining:
+            # rolling restart: this replica serves out its in-flight work
+            # but admits nothing new — a router routes around it (checked
+            # before `stopped`, which drain sets once the serve-out ends)
+            raise ServeEngineDead("ServingEngine %s: draining for restart"
+                                  % self.name)
         if self._stopped.is_set():
             raise ServeEngineDead("ServingEngine %s: engine stopped"
                                   % self.name)
@@ -857,7 +908,16 @@ class ServingEngine:
             self._check_alive_locked()
             cap = self._admission_shed(len(self._queue),
                                        count_global=count_shed_global)
-            if cap is not None and req.max_new_tokens > cap:
+            if cap is None and self._storm:
+                # preemption storm (thrash detector): admit new work at
+                # the PR-8 degrade cap — shorter answers shrink the
+                # churning footprint instead of feeding the livelock
+                cap = max(1, self.max_new_default // 4)
+            if cap is not None and req.max_new_tokens > cap \
+                    and req._resume is None and not req._migrated:
+                # never degrade a resumed/migrated request: its output is
+                # already promised (and partially delivered) — capping it
+                # would truncate the exact-replay continuation
                 req.max_new_tokens = cap
                 self._count("degraded")
             self._queue.append(req)
@@ -1247,6 +1307,12 @@ class ServingEngine:
                 telemetry.inc("serve.prefix_tokens", matched)
         blocks = shared + fresh
         self._block_gauges()
+        if req._migrated:
+            # a journal-migrated request's exact-replay admission landed
+            # on this survivor (counted once, at the landing)
+            req._migrated = False
+            self.stats["replays"] += 1
+            self._count("replays")
         if matched >= len(tokens):
             # full cover (len(tokens) is block-aligned): nothing to
             # prefill — admit straight to decode, feeding the last
@@ -1263,6 +1329,11 @@ class ServingEngine:
             else:
                 last, pos, n_new = req._resume[1:]
                 req._resume = None
+                if self._drafter is not None and n_new:
+                    # seed the survivor's drafter with the replayed
+                    # generation: speculation recovers its accept rate on
+                    # the first post-resume round instead of re-learning
+                    self._drafter.on_resume(list(tokens) + [last])
             seq = _Seq(req, last, pos, blocks=blocks,
                        ctx=list(tokens[:pos]))
             seq.n_new = n_new
@@ -1376,6 +1447,10 @@ class ServingEngine:
             seq = _Seq(req, last, pos, blocks=blocks, ctx=pf.tokens)
             seq.n_new = n_new
             req._resume = None
+            if self._drafter is not None and n_new:
+                # replayed generation seeds the drafter store (migration
+                # and preempt-resume alike): full accept rate immediately
+                self._drafter.on_resume(list(pf.tokens) + [last])
             self._active[pf.row] = seq
             return
         first = int(np.asarray(tok)[0])
@@ -1413,32 +1488,158 @@ class ServingEngine:
         # drafts, clipped at the cache end), so every block the span
         # lands in — not just one — must exist and be exclusively owned
         span = self._spec_k + 1 if self._spec else 1
+        self._stalled.clear()
+        oldest = self._oldest_inflight()
         for row, seq in list(self._active.items()):
             if row not in self._active:
                 continue  # a CoW cache-loss rebuild retired the rest
             last_write = min(seq.pos + span, self.model.seq_len) - 1
             need = last_write // self.block_size + 1
             if need > len(seq.blocks):
-                got = self._alloc_blocks(need - len(seq.blocks))
+                got = self._grow_alloc(row, seq, need - len(seq.blocks),
+                                       oldest)
                 if got is None:
-                    self._preempt(row, seq)
-                    continue
+                    continue  # preempted or stalled out of this step
                 seq.blocks.extend(got)
                 self._block_gauges()
             for idx in range(seq.pos // self.block_size, need):
-                if row not in self._active:
-                    break  # a scoped CoW failure preempted this row
+                if row not in self._active or row in self._stalled:
+                    break  # a scoped CoW failure preempted this row (or
+                    #        a denied CoW alloc stalled it)
                 wb = seq.blocks[idx]
                 if self._alloc.exclusive(wb) and \
                         (self._prefix is None
                          or not self._prefix.contains(wb)):
                     continue  # sole unregistered owner: write in place
-                got = self._alloc_blocks(1)
+                got = self._grow_alloc(row, seq, 1, oldest)
                 if got is None:
-                    self._preempt(row, seq)
                     break
                 if not self._cow(seq, idx, got[0]):
                     return  # cache rebuilt (or fatal raised)
+
+    # -- anti-thrash preemption policy -------------------------------------
+    def _oldest_inflight(self):
+        """Request id of the oldest admitted request (active or
+        mid-prefill) — the one the anti-thrash policy never preempts, so
+        under sustained pressure at least one request always runs to
+        completion (the livelock breaker)."""
+        reqs = [s.req for s in self._active.values()] + \
+               [p.req for p in self._prefilling.values()]
+        if not reqs:
+            return None
+        return min(reqs, key=lambda r: (r.t_submit, r.id)).id
+
+    def _protected(self, seq, oldest):
+        """Whether the anti-thrash policy exempts ``seq`` from
+        preemption: the oldest in-flight request always, and a resumed
+        sequence until it has advanced `MXNET_SERVE_MIN_PROGRESS` tokens
+        past its last preemption point (so preempt-replay cycles are
+        guaranteed net progress instead of churn).  0 disables both —
+        the PR-9 preempt-on-every-denial behavior."""
+        if self._min_progress <= 0:
+            return False
+        if seq.req.id == oldest:
+            return True
+        base = seq.req._preempt_n_new
+        return base is not None and seq.n_new - base < self._min_progress
+
+    def _grow_alloc(self, row, seq, n, oldest):
+        """Allocate ``n`` blocks for an active row's growth or CoW under
+        the anti-thrash policy.  Returns the blocks, or None after
+        either preempting the row (unprotected — the PR-9 path) or
+        STALLING it: a protected row whose allocation is denied keeps
+        its blocks and context and simply sits out this decode step,
+        retrying next iteration — a replay-free wait.  Real pressure
+        against a protected row first preempts a younger, unprotected
+        victim to free room (never the oldest); with no victim to
+        yield, protection defers to the self-preempt rather than
+        deadlock a sole sequence."""
+        got = self._alloc_blocks(n)
+        if got is not None:
+            return got
+        if not self._protected(seq, oldest):
+            self._preempt(row, seq)
+            return None
+        if not self._alloc.can_serve(n):
+            # real exhaustion (eviction already ran inside _alloc_blocks)
+            if self._preempt_victim(row, oldest):
+                got = self._alloc_blocks(n)
+                if got is not None:
+                    return got
+            else:
+                self._preempt(row, seq)
+                return None
+        # chaos denial with free blocks on hand, or the freed room was
+        # denied again: wait in place instead of burning a replay
+        self._stall(row)
+        return None
+
+    def _preempt_victim(self, protect_row, oldest):
+        """Free pool room for a protected row by preempting the
+        cheapest younger holder: a fresh mid-chunked-prefill admission
+        first (nothing sampled yet, and its partial context is already
+        in the prefix index, so the retry is mostly a lookup), then the
+        youngest unprotected active sequence.  Never the oldest
+        in-flight request.  Returns True when a victim yielded."""
+        for pf in reversed(list(self._prefilling.values())):
+            r = pf.req
+            if r.id == oldest or r._preempt_n_new is not None:
+                continue  # resumed prefills are protected like seqs
+            self._preempt_prefill(pf)
+            return True
+        cands = [(row, s) for row, s in self._active.items()
+                 if row != protect_row
+                 and not self._protected(s, oldest)]
+        if not cands:
+            return False
+        row, seq = max(cands, key=lambda rs: (rs[1].req.t_submit,
+                                              rs[1].req.id))
+        self._preempt(row, seq)
+        return True
+
+    def _preempt_prefill(self, pf):
+        """Preempt a mid-chunked-prefill admission (victim path): its
+        partially-cached context is released EXACTLY ONCE
+        (`_release_blocks` nulls ``pf.blocks``, so no later sweep or
+        drop can double-free) and the request requeues at the front.  A
+        fresh admission (no sampled tokens) replays its prompt from
+        scratch; one that was already resuming still carries
+        ``req._resume``, so its re-admission replays the same context —
+        output-invisible either way."""
+        del self._prefilling[pf.row]
+        self._free.append(pf.row)
+        req = pf.req
+        req._preempt_n_new = pf.resume[2] if pf.resume is not None else 0
+        self._release_blocks(pf)
+        self.stats["preemptions"] += 1
+        self._count("preempted")
+        self._note_preempt()
+        telemetry.record_event("serve_preempt", replica=self.name,
+                               request=req.id, pos=pf.done, prefill=True)
+        with self._qlock:
+            self._queue.appendleft(req)
+
+    def _stall(self, row):
+        """Sit ``row`` out of this iteration's decode launch: blocks and
+        cached context stay put, the allocation retries next step."""
+        self._stalled.add(row)
+        self.stats["stalls"] += 1
+        self._count("stalled")
+
+    def _note_preempt(self):
+        """Preemption-storm detector: `MXNET_SERVE_THRASH_TRIP`
+        preemptions with no completed request in between trips the PR-8
+        degrade path (new admissions capped at max_new_default/4) until
+        something completes — pressure drains instead of thrashing."""
+        self._preempts_since_retire += 1
+        if self._thrash_trip > 0 and not self._storm and \
+                self._preempts_since_retire >= self._thrash_trip:
+            self._storm = True
+            self.stats["thrash_trips"] += 1
+            self._count("thrash_trips")
+            telemetry.record_event(
+                "serve_thrash_trip", replica=self.name,
+                preempts=self._preempts_since_retire)
 
     def _cow(self, seq, idx, dst):
         """Copy block ``seq.blocks[idx]`` into ``dst`` and repoint the
@@ -1491,9 +1692,11 @@ class ServingEngine:
         # generated nothing; after prefill + k decodes it is prompt +
         # generated[:-1] — the incremental list covers both)
         req._resume = (list(seq.ctx), seq.last, seq.pos, seq.n_new)
+        req._preempt_n_new = seq.n_new
         self._release_blocks(seq)
         self.stats["preemptions"] += 1
         self._count("preempted")
+        self._note_preempt()
         telemetry.record_event("serve_preempt", replica=self.name,
                                request=req.id, pos=seq.pos)
         with self._qlock:
@@ -1523,6 +1726,9 @@ class ServingEngine:
         self._release_blocks(seq)
         seq.req._finish()
         self.stats["completed"] += 1
+        # a completion proves the pool drains: reset the storm detector
+        self._preempts_since_retire = 0
+        self._storm = False
         telemetry.inc("serve.completed")
         telemetry.observe("serve.latency_ms", seq.req.latency_ms)
         if seq.req.ttft_ms is not None:
@@ -1661,9 +1867,15 @@ class ServingEngine:
         no row has a usable draft — a verify launch that can only
         accept zero drafts would pay the k+1-wide program for the same
         one token per row this computes)."""
-        n = len(self._active)
+        slots = [s for s in self._active if s not in self._stalled]
+        n = len(slots)
+        if n == 0:
+            # every active row is stalled on a denied allocation: nothing
+            # to launch — back off briefly so the retry loop doesn't spin
+            # the host while it waits for room (or a deadline) to resolve
+            time.sleep(0.001)
+            return len(self._active) + len(self._prefilling)
         b = self._bucket_for(n, self.decode_buckets)
-        slots = list(self._active)
         seqs = [self._active[s] for s in slots]
         token = np.zeros((b,), np.int32)
         pos = np.zeros((b,), np.int32)
@@ -1798,11 +2010,14 @@ class ServingEngine:
         registration are bit-identical to non-speculative decode.
         Rejected positions hold garbage K/V the next round overwrites
         before attending; their tail blocks rewind via `_drop_refs`."""
-        n = len(self._active)
+        rows = [r for r in self._active if r not in self._stalled]
+        n = len(rows)
+        if n == 0:
+            time.sleep(0.001)  # all rows stalled: retry next iteration
+            return len(self._active) + len(self._prefilling)
         b = self._bucket_for(n, self.decode_buckets)
         k = self._spec_k
         c = k + 1
-        rows = list(self._active)
         seqs = [self._active[r] for r in rows]
         token = np.zeros((b, c), np.int32)
         pos = np.zeros((b,), np.int32)
@@ -1931,17 +2146,17 @@ class ServingEngine:
                     self._wake.wait(0.05)
 
     def _die(self, msg):
-        """Scheduler death: fail every ADMITTED request (their K/V context
-        is unrecoverable), mark dead, and hand the queued-but-not-admitted
-        requests to the router's failover hook (failed typed when no
-        router owns this engine)."""
+        """Scheduler death: release every admitted request's cache state
+        (the blocks died with the device anyway; releasing keeps the
+        accounting honest), mark dead, and hand BOTH the in-flight
+        (admitted) and the queued-but-not-admitted requests to the
+        router's failover hook.  A journal-owning router migrates the
+        in-flight ones to survivors via exact replay; without a journal
+        (or without a router) they fail typed — their K/V context alone
+        is unrecoverable — exactly the PR-11 contract."""
         err = ServeEngineDead("ServingEngine %s: scheduler died: %s"
                               % (self.name, msg))
-        for slot, seq in list(self._active.items()):
-            self._retire_error(slot, seq, err)
-        for pf in list(self._prefilling.values()):
-            self._drop_prefill(pf)
-            pf.req._finish(error=err)
+        inflight = self._sweep_inflight()
         with self._qlock:
             # mark dead and drain atomically: _enqueue checks _dead under
             # this lock, so everything it enqueued is in `pending` and
@@ -1953,14 +2168,36 @@ class ServingEngine:
         handler = self._on_death
         if handler is not None:
             try:
-                handler(self, pending, msg)
+                handler(self, pending, inflight, msg)
                 return
             except Exception:  # failover must never strand requests
                 pass
-        for req in pending:
+        for req in inflight + pending:
             req._finish(error=err)
 
-    def stop(self):
+    def _sweep_inflight(self):
+        """Remove every admitted sequence and mid-stream prefill, release
+        their cache state (rows freed, block refs dropped exactly once),
+        and return their requests UNRESOLVED — the shared walk under
+        `_die` (hook migrates or fails them) and `drain` (router
+        migrates the stragglers), so the release accounting cannot
+        diverge between the two exits."""
+        inflight = []
+        for slot, seq in list(self._active.items()):
+            del self._active[slot]
+            self._free.append(slot)
+            self._release_blocks(seq)
+            inflight.append(seq.req)
+        for pf in list(self._prefilling.values()):
+            del self._prefilling[pf.row]
+            self._free.append(pf.row)
+            self._release_blocks(pf)
+            inflight.append(pf.req)
+        return inflight
+
+    def _join_thread(self):
+        """Stop and join the scheduler thread (after which the caller
+        owns every piece of scheduler state)."""
         self._stopped.set()
         self._wake.set()
         with self._qcond:
@@ -1976,6 +2213,9 @@ class ServingEngine:
                     "ServingEngine %s: scheduler thread did not stop "
                     "within 30s (wedged launch?)" % self.name)
             self._thread = None
+
+    def stop(self):
+        self._join_thread()
         # every-request-resolves contract: anything still queued or
         # admitted when the scheduler stopped gets a typed error instead
         # of a result() that hangs forever (drained under the same lock
@@ -1992,6 +2232,61 @@ class ServingEngine:
             pf.req._finish(error=err)
         for req in stranded:
             req._finish(error=err)
+
+    def drain(self, deadline_ms=None):
+        """Graceful drain (rolling-restart half of the durability story):
+        close admission — new `submit`s raise typed `ServeEngineDead`
+        and a router routes around this replica — keep serving the work
+        already here until it finishes or ``deadline_ms`` expires
+        (default ``MXNET_SERVE_DRAIN_MS``; 0/None = wait for idle), then
+        stop the scheduler and return the STRAGGLERS: every request
+        still in flight, unfinished, each reconstructible through the
+        journal's exact-replay formula.  `ReplicaRouter.drain` migrates
+        them to survivors; a standalone caller may resubmit or fail
+        them.  In-flight stragglers come first (they carry progress),
+        then the still-queued tail."""
+        if deadline_ms is None:
+            dl = float(os.environ.get("MXNET_SERVE_DRAIN_MS", "0"))
+            deadline_ms = dl if dl > 0 else None
+        with self._qcond:
+            self._draining = True
+            self._qcond.notify_all()  # blocked submitters resolve typed
+        self._wake.set()
+        telemetry.record_event("serve_drain_begin", replica=self.name,
+                               depth=self.depth())
+        t0 = time.monotonic()
+        budget_s = None if deadline_ms is None else float(deadline_ms) / 1e3
+        while self._dead is None and not self._stopped.is_set():
+            if self._thread is not None and self._thread.is_alive():
+                if self.depth() == 0:
+                    break
+                time.sleep(0.005)
+            else:
+                try:
+                    n = self.step()
+                except Exception as e:  # noqa: BLE001 — same as _loop
+                    telemetry.inc("serve.engine_failures")
+                    self._die(str(e)[:500])
+                    break
+                if n == 0:
+                    with self._qlock:
+                        if not self._queue:
+                            break
+            if budget_s is not None and time.monotonic() - t0 > budget_s:
+                break
+        # quiesce the scheduler so the straggler walk owns the state
+        self._join_thread()
+        stragglers = self._sweep_inflight()
+        with self._qlock:
+            stragglers.extend(self._queue)
+            self._queue.clear()
+            self._qcond.notify_all()
+        self._count("drained")
+        telemetry.record_event("serve_drain", replica=self.name,
+                               stragglers=len(stragglers),
+                               waited_ms=round(1e3 * (time.monotonic()
+                                                      - t0), 1))
+        return stragglers
 
     def run_until_idle(self, timeout=None):
         """Drive the scheduler until the queue and active set drain;
@@ -2055,21 +2350,26 @@ class ReplicaRouter:
     one engine per device of a mesh (row-major over the first axis).
 
     Partial failure is the normal case: when a replica's scheduler dies,
-    its queued-but-not-admitted requests re-dispatch to survivors (the
-    admitted ones fail typed — their K/V context died with the cache),
-    and a background monitor respawns a replacement on the same device
-    behind a capped-exponential-backoff circuit breaker (the PR-3
-    `parallel/dist.py` pattern).  The replacement warms from the dead
-    incarnation's SHARED AotCache, so failover compiles nothing —
-    `serve.aot.compiles` stays at its warmup value (asserted by the chaos
-    acceptance test).  ``respawn=False`` (or ``MXNET_SERVE_RESPAWN=0``)
-    disables respawn; failover re-dispatch still runs.
+    its queued-but-not-admitted requests re-dispatch to survivors, its
+    ADMITTED in-flight requests MIGRATE to survivors through the request
+    journal's exact-replay path (``MXNET_SERVE_JOURNAL=0`` restores the
+    PR-11 fail-typed contract), and a background monitor respawns a
+    replacement on the same device behind a capped-exponential-backoff
+    circuit breaker (the PR-3 `parallel/dist.py` pattern).  The
+    replacement warms from the dead incarnation's SHARED AotCache, so
+    failover compiles nothing — `serve.aot.compiles` stays at its warmup
+    value (asserted by the chaos acceptance test).  ``respawn=False``
+    (or ``MXNET_SERVE_RESPAWN=0``) disables respawn; failover
+    re-dispatch still runs.  `drain` is the planned-restart counterpart:
+    one replica serves out its work, stragglers migrate the same way,
+    and the replacement compiles nothing — a rolling restart of N
+    replicas loses zero requests.
     """
 
     _MONITOR_PERIOD = 0.2
     _BREAKER_RESET_S = 10.0   # healthy-for-this-long clears the breaker
 
-    def __init__(self, engines, respawn=None):
+    def __init__(self, engines, respawn=None, journal=None):
         if not engines:
             raise MXNetError("ReplicaRouter: need at least one engine")
         self.engines = list(engines)
@@ -2078,6 +2378,9 @@ class ReplicaRouter:
             respawn = os.environ.get("MXNET_SERVE_RESPAWN", "1").lower() \
                 not in ("0", "false", "no")
         self._respawn = bool(respawn)
+        if journal is None:
+            journal = journal_enabled()
+        self.journal = RequestJournal() if journal else None
         self._stopped = False
         self._monitor = None
         self._mon_stop = threading.Event()
@@ -2087,7 +2390,7 @@ class ReplicaRouter:
 
     @classmethod
     def from_mesh(cls, model, params, mesh=None, n_replicas=None,
-                  respawn=None, **kw):
+                  respawn=None, journal=None, **kw):
         devices = (list(np.asarray(mesh.devices).reshape(-1))
                    if mesh is not None else jax.devices())
         if n_replicas is not None:
@@ -2095,7 +2398,7 @@ class ReplicaRouter:
         engines = [ServingEngine(model, params, ctx=d,
                                  name="replica%d" % i, **kw)
                    for i, d in enumerate(devices)]
-        return cls(engines, respawn=respawn)
+        return cls(engines, respawn=respawn, journal=journal)
 
     def warmup(self):
         return [e.warmup() for e in self.engines]
@@ -2106,22 +2409,33 @@ class ReplicaRouter:
             engines = list(self.engines)
         return [e for e in engines
                 if e is not exclude and e._dead is None
-                and not e._stopped.is_set()]
+                and not e._stopped.is_set() and not e._draining]
 
-    def _handle_death(self, engine, pending, msg):
+    def _handle_death(self, engine, pending, inflight, msg):
         """Engine death hook (runs on the dying scheduler's thread):
-        re-dispatch its queued-but-not-admitted requests to survivors.
-        Resolution is guaranteed PER REQUEST: a surprise mid-list must
-        not abort the loop — `_die`'s fallback would then fail the whole
-        pending list typed, including requests already successfully
-        enqueued on healthy survivors."""
+        MIGRATE its admitted in-flight requests to survivors via the
+        journal's exact-replay path (fail-typed without a journal — the
+        PR-11 contract), and re-dispatch its queued-but-not-admitted
+        requests.  Resolution is guaranteed PER REQUEST: a surprise
+        mid-list must not abort the loop — `_die`'s fallback would then
+        fail the whole list typed, including requests already
+        successfully moved to healthy survivors."""
         try:
             telemetry.inc("serve.failovers")
             telemetry.inc("serve.%s.failover" % engine.name)
             telemetry.record_event("serve_failover", replica=engine.name,
-                                   pending=len(pending), error=msg[:200])
+                                   pending=len(pending),
+                                   inflight=len(inflight), error=msg[:200])
         except Exception:  # accounting must not abort failover
             pass
+        err = ServeEngineDead("ServingEngine %s: scheduler died: %s"
+                              % (engine.name, msg))
+        for req in inflight:
+            try:
+                if not self._migrate(req, exclude=engine):
+                    req._finish(error=err)
+            except Exception:
+                req._finish(error=err)
         err = ServeEngineDead(
             "ServingEngine %s: scheduler died: %s (no live replica to "
             "fail over to)" % (engine.name, msg))
@@ -2131,6 +2445,46 @@ class ReplicaRouter:
                     req._finish(error=err)
             except Exception:
                 req._finish(error=err)
+
+    def _migrate(self, req, exclude=None):
+        """Move an ADMITTED in-flight request off a dead/draining
+        replica with token-for-token exactness: the journal rebuilds the
+        uniform ``(prompt+generated)[:pos]`` resume state, the request
+        (same object — deadline age and latency stamps never reset)
+        enqueues on the least-loaded survivor, and the survivor's
+        ordinary resume admission chunk-prefills the replayed context
+        and re-enters decode at the same position with the same
+        request-keyed RNG.  Returns False when nothing can take it (no
+        journal, no paged survivor, or every survivor shed)."""
+        if self.journal is None:
+            return False  # PR-11: in-flight context dies with the replica
+        if req.done:
+            return True   # resolved in the window: nothing to move
+        state = self.journal.replay_state(req)
+        survivors = self._live_engines(exclude=exclude)
+        if state is not None:
+            # exact replay rides the paged resume path
+            survivors = [e for e in survivors if e._paged]
+        if not survivors:
+            return False
+        if state is not None:
+            req._resume = state
+            req._migrated = True
+        for eng in sorted(survivors, key=lambda e: e.depth()):
+            try:
+                eng._enqueue(req, count_shed_global=False)
+            except ServeError:
+                continue  # died or shed in the window: try the next
+            self.journal.migrations += 1
+            telemetry.inc("serve.migrated")
+            telemetry.record_event(
+                "serve_migrate", request=req.id, target=eng.name,
+                pos=0 if state is None else state[2],
+                generated=len(req.tokens))
+            return True
+        req._migrated = False
+        req._resume = None if state is not None else req._resume
+        return False
 
     def _redispatch(self, req, exclude=None):
         """Move an un-admitted request (same object: deadline and latency
@@ -2214,7 +2568,13 @@ class ReplicaRouter:
             shed = 0
             for eng in sorted(live, key=lambda e: e.depth()):
                 try:
-                    return eng.submit(prompt, _count_shed=False, **kw)
+                    req = eng.submit(prompt, _count_shed=False, **kw)
+                    if self.journal is not None:
+                        # the handle the caller gets back IS the journal
+                        # entry: it survives the replica it landed on
+                        telemetry.set_gauge("serve.journal_depth",
+                                            self.journal.record(req))
+                    return req
                 except ServeOverload as e:
                     last_err = e
                     shed += 1
@@ -2234,6 +2594,82 @@ class ReplicaRouter:
         raise ServeEngineDead(
             "ReplicaRouter: no live replica among %d (%s)"
             % (len(self.engines), last_err))
+
+    def _resolve_engine(self, replica):
+        """An engine by object, index, or replica name."""
+        with self._lock:
+            engines = list(self.engines)
+        if isinstance(replica, ServingEngine):
+            if replica in engines:
+                return replica
+            raise MXNetError("ReplicaRouter: engine %s is not (or no "
+                             "longer) one of this router's replicas"
+                             % replica.name)
+        if isinstance(replica, int):
+            if not 0 <= replica < len(engines):
+                raise MXNetError(
+                    "ReplicaRouter: replica index %d out of range "
+                    "(have %d replicas)" % (replica, len(engines)))
+            return engines[replica]
+        for e in engines:
+            if e.name == replica:
+                return e
+        raise MXNetError("ReplicaRouter: no replica named %r (have %s)"
+                         % (replica, [e.name for e in engines]))
+
+    def drain(self, replica, deadline_ms=None, respawn=True):
+        """Gracefully restart ONE replica (the rolling-restart
+        primitive): close its admission, let its in-flight work finish
+        within ``deadline_ms``, MIGRATE the stragglers to survivors
+        through the journal's exact-replay path, stop it, and (by
+        default) swap in a respawned replacement warmed from the shared
+        AotCache — so draining every replica in turn restarts the fleet
+        with zero failed requests and zero new compiles.  Returns the
+        replacement engine (None with ``respawn=False``)."""
+        eng = self._resolve_engine(replica)
+        stragglers = eng.drain(deadline_ms=deadline_ms)  # counts drained
+        err = ServeEngineDead(
+            "ServingEngine %s: drained for restart with no live replica "
+            "to migrate to" % eng.name)
+        for req in stragglers:
+            if req.done:
+                continue
+            try:
+                if self._migrate(req, exclude=eng):
+                    continue
+                # no journal (or no paged survivor): a straggler with no
+                # generated tokens needs no replay — the PR-8 redispatch
+                # keeps it alive losslessly; only in-flight progress that
+                # cannot be replayed has to fail typed
+                if not req.tokens and self._redispatch(req, exclude=eng):
+                    continue
+                req._finish(error=err)
+            except Exception:
+                req._finish(error=err)
+        fresh = None
+        if respawn and not self._stopped:
+            try:
+                fresh = eng.respawn()
+                fresh.warmup()  # pure AotCache hits: the restart compiles 0
+            except Exception as ex:  # noqa: BLE001
+                # don't strand the fleet a replica short: mark the drained
+                # engine dead so the monitor's breaker-backed respawn path
+                # retries, exactly like a crashed replica
+                eng._dead = "drain respawn failed: %s" % str(ex)[:300]
+                telemetry.record_event("serve_respawn_failed",
+                                       replica=eng.name,
+                                       error=str(ex)[:200])
+                return None
+            fresh._on_death = self._handle_death
+            with self._lock:
+                try:
+                    self.engines[self.engines.index(eng)] = fresh
+                except ValueError:  # raced with a concurrent swap
+                    fresh.stop()
+                    return None
+            if self._monitor is not None and self._monitor.is_alive():
+                fresh.start()
+        return fresh
 
     def start(self):
         self._stopped = False

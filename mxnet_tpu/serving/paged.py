@@ -124,6 +124,16 @@ class BlockAllocator:
         """Blocks needed to hold ``n_tokens`` cache rows."""
         return -(-int(n_tokens) // self.block_size)
 
+    def can_serve(self, n):
+        """Whether the free list alone could serve ``n`` blocks right
+        now.  After a denied `alloc` this distinguishes REAL exhaustion
+        (True means the denial was a `block_exhaust` chaos draw — the
+        free list was never touched) so the engine's anti-thrash policy
+        can stall-and-retry a chaos denial instead of burning a
+        preemption, and go hunting for a victim only when the pool is
+        genuinely out of room."""
+        return int(n) <= len(self._free)
+
     def alloc(self, n):
         """``n`` fresh block ids at refcount 1, or None when the free list
         cannot serve the request (insufficient free blocks, or a

@@ -136,6 +136,18 @@ class Drafter:
         generations mid-flight (a concurrent twin of a slow request can
         then draft off its progress instead of waiting for a retire)."""
 
+    def on_resume(self, hist):
+        """A preempted — or journal-MIGRATED — request re-entered decode
+        with replayed context ``hist`` (everything its cache now holds,
+        plus the pending feed token).  Speculation state is never
+        carried across a migration: a device drafter's mirrored pool
+        refilled in lockstep with the replay prefill chunks, and a
+        learning drafter may index the replayed generation here so its
+        accept rate recovers on the first post-resume round instead of
+        re-learning token by token.  Default: no-op — draft state is
+        never correctness-critical, so forgetting everything is always
+        safe."""
+
 
 class NgramDrafter(Drafter):
     """Model-free n-gram drafting: prompt-lookup (Saxena 2023) plus a
@@ -200,6 +212,13 @@ class NgramDrafter(Drafter):
 
     def observe(self, hist, new):
         self._index(hist, len(hist) - int(new))
+
+    def on_resume(self, hist):
+        # a replayed (preempted or migrated-in) generation seeds the
+        # store wholesale: deterministic decoding makes it an exact
+        # oracle for its own continuation, so the first post-resume
+        # speculation round already drafts at full accept rate
+        self._index(hist, 0)
 
     def _lookup(self, hist, k):
         """(k proposals, confident) — ``confident`` means the match is

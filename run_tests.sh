@@ -49,6 +49,16 @@ elif [ "$1" = "--serve-spec-smoke" ]; then
     T1=""
     set -- tests/test_serve_spec.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-durability-smoke" ]; then
+    # fast serving-durability smoke: journal exact-replay migration on
+    # replica death, rolling-restart drain, anti-thrash preemption
+    # (min-progress stall, oldest-request protection, storm -> degrade),
+    # the mid-prefill victim regression, and the 3-clause chaos
+    # composition run (docs/serving.md "Durability")
+    shift
+    T1=""
+    set -- tests/test_serve_durability.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

@@ -270,4 +270,55 @@ print("serve-chaos gate passed: %s/%s resolved, resilience %s, "
                                 rec["resilience"],
                                 rec["deadline"]["hit_rate"]))
 PY
+
+# -- serve-durability gate (docs/serving.md "Durability") -----------------
+# kill-one-of-two-replicas mid-Poisson with the request journal ON: 100%
+# of requests — including the dead replica's ADMITTED in-flight ones,
+# which migrate via exact journal replay — must complete OK with
+# token-for-token parity vs an undisturbed oracle run (T=0: replay, not
+# re-generation divergence), and a rolling restart (router.drain of each
+# replica in turn, mid-traffic) must lose nothing; zero leaked blocks,
+# zero steady-state compiles on every leg (respawned/drained replicas
+# warm from the shared AOT cache); artifact lands in
+# bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SERVE_REQUESTS=24 \
+    python bench.py --serve --durability | tee /tmp/nightly_serve_durab.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_durab.log").read().strip().splitlines()[-1])
+for leg in ("oracle", "crash", "drain"):
+    r = rec[leg]
+    assert r["hung"] == 0, \
+        "durability gate (%s): %d hung requests" % (leg, r["hung"])
+    assert r["failed"] == 0, \
+        "durability gate (%s): %d failed requests" % (leg, r["failed"])
+    assert r["completed"] == rec["requests"], \
+        "durability gate (%s): %s/%s completed" % (
+            leg, r["completed"], rec["requests"])
+    assert r["leaked"] == 0, \
+        "durability gate (%s): %d blocks leaked" % (leg, r["leaked"])
+    assert r["steady_state_recompiles"] == 0, \
+        "durability gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+assert rec["parity_crash"] and rec["parity_drain"], \
+    "durability gate: tokens diverged from the oracle run " \
+    "(crash parity %s, drain parity %s)" % (
+        rec["parity_crash"], rec["parity_drain"])
+assert rec["crash"]["counters"].get("migrated", 0) >= 1, \
+    "durability gate: the crash leg never migrated an in-flight request"
+assert rec["crash"]["counters"].get("replays", 0) >= 1, \
+    "durability gate: no migrated request replayed on a survivor"
+assert rec["drain"]["counters"].get("drained", 0) >= 2, \
+    "durability gate: the rolling restart drained %s replicas, want 2" \
+    % rec["drain"]["counters"].get("drained", 0)
+print("durability gate passed: parity %s, crash counters %s, "
+      "drain counters %s" % (rec["value"], rec["crash"]["counters"],
+                             rec["drain"]["counters"]))
+PY
+
+# -- serve-durability smoke: migration/drain/anti-thrash unit coverage ----
+./run_tests.sh --serve-durability-smoke
 echo "nightly: all gates passed"

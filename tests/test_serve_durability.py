@@ -1,0 +1,485 @@
+"""Serving durability (ISSUE-12): exact-replay request migration,
+rolling-restart drain, and anti-thrash preemption.
+
+Contracts under test:
+
+1. `RequestJournal.replay_state` is the uniform resume formula: the
+   cache must hold ``(prompt + generated)[:pos]`` and the last generated
+   token re-enters decode at ``pos`` — None before anything generated.
+2. Migration: a dead replica's ADMITTED in-flight requests move to a
+   survivor and complete with token-for-token parity vs an undisturbed
+   oracle; the caller's handle keeps working across the swap (no
+   `ServeEngineDead`), the deadline budget stays anchored at the
+   original submit, and `serve.migrated`/`serve.replays` count it.
+3. Kill-switch: `MXNET_SERVE_JOURNAL=0` restores the PR-11 contract —
+   admitted requests fail typed on replica death.
+4. Drain: `engine.drain` closes admission typed, serves out in-flight
+   work, and returns unfinished stragglers; `router.drain` migrates
+   them and swaps in a respawned replacement that compiles NOTHING —
+   a 2-replica rolling restart finishes with zero failed requests.
+5. Anti-thrash: a protected row STALLS through chaos `block_exhaust`
+   denials instead of burning preempt/replay churn (strictly fewer
+   preemptions than the `MXNET_SERVE_MIN_PROGRESS=0` leg, same
+   tokens); the oldest in-flight request is never preempted; a
+   preemption storm trips the PR-8 degrade path
+   (`serve.thrash_trips`) and clears on the next completion.
+6. Regression (ISSUE-12 satellite): a mid-chunked-prefill admission
+   preempted as a pool-pressure victim releases its partial prefill
+   exactly once and requeues — zero leaks, oracle tokens.
+7. Chaos composition: `engine_crash` + `block_exhaust` + `draft_junk`
+   live simultaneously in one 2-replica Poisson run with speculation
+   on — zero hung handles, every request resolved or typed, zero
+   leaked blocks on survivors, compiles frozen at warmup.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, telemetry
+from mxnet_tpu.serving import (ReplicaRouter, RequestJournal, ServeRequest,
+                               ServingEngine, TransformerKVModel,
+                               ServeError, ServeEngineDead, ServeTimeout)
+
+V, S, L, H, E = 61, 32, 2, 2, 32
+
+
+@pytest.fixture
+def model_and_params():
+    model = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E)
+    return model, model.init_params(np.random.RandomState(7))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("MXNET_CHAOS", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_JOURNAL", raising=False)
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "0")
+    telemetry.reset()
+    chaos.reset()
+    yield
+    telemetry.reset()
+    chaos.reset()
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("sampling", False)
+    return ServingEngine(model, params, **kw)
+
+
+def _drain(eng, reqs, timeout=300):
+    eng.run_until_idle(timeout=timeout)
+    return [r.result(1) for r in reqs]
+
+
+def _chaos(monkeypatch, spec):
+    monkeypatch.setenv("MXNET_CHAOS", spec)
+    chaos.reset()
+
+
+_oracle_state = {}
+
+
+def _oracle(model, params, prompt, max_new):
+    key = (tuple(prompt), max_new)
+    if key not in _oracle_state:
+        eng = _oracle_state.get("engine")
+        if eng is None:
+            eng = _oracle_state["engine"] = _engine(model, params,
+                                                    max_batch=1)
+        req = eng.submit(prompt, max_new_tokens=max_new)
+        eng.run_until_idle(timeout=300)
+        _oracle_state[key] = req.result(1)
+    return _oracle_state[key]
+
+
+# ---------------------------------------------------------------------------
+# 1. the replay formula
+# ---------------------------------------------------------------------------
+
+def test_replay_state_formula():
+    req = ServeRequest([5, 6, 7], max_new_tokens=8)
+    assert RequestJournal.replay_state(req) is None  # nothing generated
+    req.tokens = [11]
+    # right after prefill: cache holds the prompt, token 11 is fed at 3
+    assert RequestJournal.replay_state(req) == ([5, 6, 7], 11, 3, 1)
+    req.tokens = [11, 12, 13]
+    # mid-decode: generated[:-1] were fed, the last re-enters at pos
+    assert RequestJournal.replay_state(req) == \
+        ([5, 6, 7, 11, 12], 13, 5, 3)
+
+
+# ---------------------------------------------------------------------------
+# 2. exact-replay migration on replica death
+# ---------------------------------------------------------------------------
+
+def test_migration_resumes_inflight_token_exact(model_and_params,
+                                                monkeypatch):
+    """engine_crash kills replica0 after its in-flight request generated
+    a partial answer: the request MIGRATES to replica1, replays
+    `(prompt+generated)[:pos]`, and finishes with the undisturbed
+    oracle's exact tokens — the handle never raises, and the deadline
+    budget stays anchored at the original submit."""
+    model, params = model_and_params
+    prompt = [3, 4, 5]
+    oracle = _oracle(model, params, prompt, 6)
+    engines = [_engine(model, params, max_batch=2, max_new_tokens=6)
+               for _ in range(2)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()
+    _chaos(monkeypatch, "engine_crash:2:replica0")
+    req = engines[0].submit(prompt, deadline_ms=60000)
+    router.start()
+    try:
+        assert req.result(timeout=120) == oracle
+    finally:
+        router.stop()
+    assert engines[0]._dead is not None        # the crash really happened
+    assert len(req.tokens) == 6
+    # deadline anchored at the ORIGINAL submit, not re-stamped on move
+    assert abs((req.t_deadline - req.t_submit) - 60.0) < 1e-6
+    reg = telemetry.registry()
+    assert reg.counter("serve.migrated").value == 1
+    assert reg.counter("serve.replays").value == 1
+    assert router.journal.migrations == 1
+    assert engines[1].stats["replays"] == 1
+    assert engines[1].leaked_blocks() == 0
+
+
+def test_journal_kill_switch_restores_pr11(model_and_params, monkeypatch):
+    """MXNET_SERVE_JOURNAL=0: replica death fails the admitted in-flight
+    request typed (`ServeEngineDead`) exactly as PR-8/11 did."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_JOURNAL", "0")
+    engines = [_engine(model, params, max_batch=2, max_new_tokens=6)
+               for _ in range(2)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=False)
+    assert router.journal is None
+    router.warmup()
+    _chaos(monkeypatch, "engine_crash:2:replica0")
+    req = engines[0].submit([3, 4, 5])
+    router.start()
+    try:
+        with pytest.raises(ServeEngineDead):
+            req.result(timeout=120)
+    finally:
+        router.stop()
+    assert telemetry.registry().counter("serve.migrated").value == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. graceful drain + rolling restart
+# ---------------------------------------------------------------------------
+
+def test_engine_drain_serves_out_then_closes_typed(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params, max_new_tokens=4)
+    reqs = [eng.submit([3 + i, 4]) for i in range(2)]
+    stragglers = eng.drain()           # no deadline: waits for idle
+    assert stragglers == []
+    assert [r.result(1) for r in reqs] == \
+        [_oracle(model, params, [3 + i, 4], 4) for i in range(2)]
+    with pytest.raises(ServeEngineDead, match="draining"):
+        eng.submit([9, 9])
+    assert eng.leaked_blocks() == 0
+    assert telemetry.registry().counter("serve.replica0.drained").value == 1
+
+
+def test_engine_drain_deadline_returns_live_stragglers(model_and_params):
+    """A drain whose budget expires hands back the unfinished requests
+    mid-generation — unresolved, blocks released, replayable through the
+    journal formula."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_batch=2, max_new_tokens=30)
+    reqs = [eng.submit([3 + i, 4]) for i in range(4)]
+    eng.step()  # at least one admitted and decoding
+    stragglers = eng.drain(deadline_ms=1)
+    assert stragglers, "deadline drain should strand work"
+    assert all(not r.done for r in stragglers)
+    assert eng.leaked_blocks() == 0
+    lively = [r for r in stragglers if r.tokens]
+    assert lively, "an admitted straggler carries its partial progress"
+    state = RequestJournal.replay_state(lively[0])
+    assert state[0] == list(lively[0].prompt) + lively[0].tokens[:-1]
+    # unfinished stragglers are the CALLER's to resolve (router.drain
+    # migrates them); finish them here so nothing dangles
+    for r in reqs:
+        if not r.done:
+            r._finish(error=ServeEngineDead("test cleanup"))
+
+
+def test_router_drain_rolling_restart_zero_failures(model_and_params):
+    """The durability-gate drain clause: drain both replicas of a loaded
+    2-replica router in turn (1 ms budgets force mid-flight stragglers).
+    Every request completes with oracle tokens, nothing fails, the
+    replacements warm from the shared AotCache and compile NOTHING."""
+    model, params = model_and_params
+    rng = np.random.RandomState(11)
+    prompts = [list(rng.randint(0, V, size=int(n)))
+               for n in rng.randint(2, 8, size=6)]
+    oracle = [_oracle(model, params, p, 8) for p in prompts]
+    engines = [_engine(model, params, max_batch=2, max_new_tokens=8)
+               for _ in range(2)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    router.start()
+    try:
+        reqs = [router.submit(p) for p in prompts]
+        fresh0 = router.drain("replica0", deadline_ms=1)
+        assert fresh0 is not None and fresh0.name == "replica0"
+        fresh1 = router.drain("replica1", deadline_ms=1)
+        assert fresh1 is not None
+        outs = [r.result(timeout=120) for r in reqs]
+    finally:
+        router.stop()
+    assert outs == oracle                       # zero failed, exact tokens
+    assert reg.counter("serve.drained").value == 2
+    assert reg.counter("serve.aot.compiles").value == compiles
+    serving_events = [e for e in telemetry.events("retrace")
+                      if str(e.get("site", "")).startswith("serving.")]
+    assert serving_events == []
+    for e in (fresh0, fresh1):
+        assert e.leaked_blocks() == 0
+
+
+def test_degrade_cap_never_truncates_replayed_requests(model_and_params):
+    """Review regression: the PR-8 `degrade` overload cap (and the storm
+    cap) must not shorten a migrated/resumed request — its output is
+    already promised and partially delivered, so capping it would
+    truncate the exact-replay continuation."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_new_tokens=8, queue_max=1,
+                  overload="degrade")
+    eng._queue.append(ServeRequest([1], 1))      # queue at the cap
+    fresh = ServeRequest([2, 3], 8)
+    eng._enqueue(fresh)
+    assert fresh.max_new_tokens == 2             # new work degrades (8/4)
+    moved = ServeRequest([2, 3], 8)
+    moved.tokens = [5, 6, 7]
+    moved._resume = ([2, 3, 5, 6], 7, 4, 3)      # mid-replay migration
+    moved._migrated = True
+    eng._enqueue(moved)
+    assert moved.max_new_tokens == 8             # contract preserved
+
+
+def test_journal_off_drain_redispatches_queued_stragglers(
+        model_and_params, monkeypatch):
+    """Review regression: with the journal disabled, `router.drain` must
+    not be lossier than a crash — queued-never-admitted stragglers (no
+    tokens generated, nothing to replay) redispatch to survivors like
+    the PR-8 death path; only in-flight progress fails typed."""
+    model, params = model_and_params
+    engines = [_engine(model, params, max_batch=1, max_new_tokens=30),
+               _engine(model, params, max_batch=2, max_new_tokens=30)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=False, journal=False)
+    router.warmup()
+    # pin every decode step at 50 ms so the drain budget reliably
+    # strands work: the single admitted request (max_batch=1) is still
+    # mid-generation, the other two still queued
+    _chaos(monkeypatch, "decode_slow:1.0:50")
+    reqs = [engines[0].submit([3 + i, 4], max_new_tokens=30)
+            for i in range(3)]
+    engines[0].start()
+    engines[1].start()
+    while not reqs[0].tokens:                    # admitted + prefilled
+        time.sleep(0.01)
+    router.drain("replica0", deadline_ms=1, respawn=False)
+    monkeypatch.delenv("MXNET_CHAOS")
+    chaos.reset()
+    resolved_ok, typed = 0, 0
+    for r in reqs:
+        try:
+            r.result(timeout=120)
+            resolved_ok += 1
+        except ServeEngineDead:
+            typed += 1
+    assert resolved_ok + typed == 3
+    assert typed == 1, "only the in-flight request may fail typed"
+    assert resolved_ok == 2, "queued stragglers must redispatch"
+    assert telemetry.registry().counter("serve.redispatched").value >= 2
+    router.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. anti-thrash preemption
+# ---------------------------------------------------------------------------
+
+def test_min_progress_stalls_instead_of_churning(model_and_params,
+                                                 monkeypatch):
+    """Sustained chaos `block_exhaust` denial: the PR-9 engine
+    (min_progress=0) burns a preempt+replay on every denied growth; the
+    anti-thrash engine stalls protected rows in place and preempts
+    strictly less — same tokens, net forward progress."""
+    model, params = model_and_params
+    rng = np.random.RandomState(21)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (3, 9, 5)]
+    oracle = [_oracle(model, params, p, 16) for p in prompts]
+
+    def leg(min_progress):
+        _chaos(monkeypatch, "block_exhaust:0.7")
+        eng = _engine(model, params, max_batch=3, max_new_tokens=16,
+                      min_progress=min_progress)
+        reqs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        outs = _drain(eng, reqs, timeout=300)
+        assert outs == oracle
+        assert eng.leaked_blocks() == 0
+        return eng.stats
+
+    churn = leg(0)
+    calm = leg(4)
+    assert churn["preemptions"] > calm["preemptions"]
+    assert calm["stalls"] > 0
+    assert churn["stalls"] == 0  # the kill-switch leg never stalls
+
+
+def test_oldest_request_never_preempted(model_and_params):
+    """Real pool pressure with competing growers: victims are younger
+    requests — the oldest in-flight request's id never appears in a
+    `serve_preempt` event, so at least one request always runs straight
+    to completion (the livelock breaker)."""
+    model, params = model_and_params
+    rng = np.random.RandomState(22)
+    prompts = [list(rng.randint(0, V, size=7)) for _ in range(3)]
+    oracle = [_oracle(model, params, p, 12) for p in prompts]
+    # 5 usable blocks of 8: three 1-block admissions fit, but growth past
+    # pos 8 (a 2nd block each) cannot be granted to all three at once
+    eng = _engine(model, params, max_batch=3, n_blocks=6,
+                  max_new_tokens=12)
+    reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    outs = _drain(eng, reqs, timeout=300)
+    assert outs == oracle
+    assert eng.stats["preemptions"] >= 1     # pressure actually bit
+    preempted = {e.get("request")
+                 for e in telemetry.events("serve_preempt")}
+    assert reqs[0].id not in preempted
+    assert eng.leaked_blocks() == 0
+
+
+def test_thrash_storm_trips_degrade_path(model_and_params, monkeypatch):
+    """A preemption storm (thrash_trip preempts, zero completions) trips
+    the PR-8 degrade path: new admissions are capped at max_new/4 until
+    a completion clears the storm."""
+    model, params = model_and_params
+    _chaos(monkeypatch, "block_exhaust:0.9")
+    eng = _engine(model, params, max_batch=3, max_new_tokens=8,
+                  min_progress=0, thrash_trip=2)
+    reqs = [eng.submit([3 + i, 4]) for i in range(3)]
+    t0 = time.perf_counter()
+    while eng.stats["thrash_trips"] < 1:
+        assert time.perf_counter() - t0 < 60, "storm never tripped"
+        eng.step()
+    assert eng._storm
+    probe = eng.submit([9, 9], max_new_tokens=8)
+    monkeypatch.delenv("MXNET_CHAOS")
+    chaos.reset()
+    _drain(eng, reqs)
+    assert len(probe.result(300)) == 2        # admitted at max_new/4
+    assert not eng._storm                     # a completion cleared it
+    assert telemetry.registry().counter("serve.thrash_trips").value >= 1
+    assert telemetry.registry().counter("serve.degraded").value >= 1
+    assert eng.leaked_blocks() == 0
+
+
+def test_prefill_victim_preempt_releases_partial_exactly_once(
+        model_and_params):
+    """ISSUE-12 satellite regression: a mid-chunked-prefill admission
+    (no generated tokens yet) chosen as a pool-pressure victim requeues
+    with its partial prefill released EXACTLY ONCE — no allocator
+    double-free, no leak, oracle tokens for both requests."""
+    model, params = model_and_params
+    rng = np.random.RandomState(23)
+    pa = list(rng.randint(0, V, size=7))
+    pb = list(rng.randint(0, V, size=24))     # 2 chunks at bucket 16
+    oracle_a = _oracle(model, params, pa, 6)
+    oracle_b = _oracle(model, params, pb, 4)
+    # 5 usable blocks: A admits with 1, B's admission takes the other 4;
+    # A's first growth (pos 8) then finds the pool empty while B is
+    # still mid-prefill — A is oldest/protected, so B is the victim
+    eng = _engine(model, params, max_batch=2, n_blocks=6,
+                  max_new_tokens=6)
+    ra = eng.submit(pa, max_new_tokens=6)
+    eng.step()                                # A admitted and decoding
+    rb = eng.submit(pb, max_new_tokens=4)
+    outs = _drain(eng, [ra, rb], timeout=300)
+    assert outs == [oracle_a, oracle_b]
+    prefill_preempts = [e for e in telemetry.events("serve_preempt")
+                        if e.get("prefill")]
+    assert prefill_preempts, "the mid-prefill victim path never ran"
+    assert prefill_preempts[0].get("request") == rb.id
+    assert eng.leaked_blocks() == 0
+    parked = 0 if eng._prefix is None else eng._prefix.parked_count
+    assert eng._alloc.free_blocks + parked == eng._alloc.capacity
+
+
+# ---------------------------------------------------------------------------
+# 5. chaos composition (the ISSUE-12 acceptance clause)
+# ---------------------------------------------------------------------------
+
+def test_chaos_composition_durability(model_and_params, monkeypatch):
+    """engine_crash + block_exhaust + draft_junk simultaneously, on a
+    2-replica router with speculation ON: zero hung handles, every
+    request resolves (tokens or typed error) in bounded time, zero
+    leaked blocks on survivors, compiles frozen at warmup."""
+    from mxnet_tpu.parallel import make_mesh
+
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "5")
+    _chaos(monkeypatch,
+           "engine_crash:3:replica0,block_exhaust:0.2,draft_junk:0.5")
+    deadline_ms = 60000.0
+    mesh = make_mesh(shape=(2,), axis_names=("data",))
+    router = ReplicaRouter.from_mesh(
+        model, params, mesh=mesh, max_batch=2, prefill_buckets=[8, 16],
+        max_new_tokens=4, deadline_ms=deadline_ms, respawn=True,
+        sampling=False, spec=True, spec_k=2, spec_drafter="ngram")
+    router.warmup()
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+
+    rng = np.random.RandomState(3)
+    router.start()
+    try:
+        reqs = []
+        for _ in range(12):
+            prompt = list(rng.randint(0, V, size=int(rng.randint(1, 8))))
+            reqs.append(router.submit(prompt))
+            time.sleep(float(rng.exponential(0.02)))
+        ok, typed = 0, 0
+        for r in reqs:
+            try:
+                r.result(timeout=120)
+                ok += 1
+            except ServeTimeout:
+                pytest.fail("request %d hung (no resolution)" % r.id)
+            except ServeError:
+                typed += 1
+        assert ok + typed == len(reqs)
+        assert all(r.done for r in reqs)
+        assert ok > 0
+        grace_ms = 5000.0
+        for r in reqs:
+            assert r.latency_ms is not None
+            assert r.latency_ms <= deadline_ms + grace_ms
+        assert reg.counter("serve.failovers").value >= 1
+    finally:
+        router.stop()
+    for e in router.engines:
+        if e._dead is None:
+            assert e.leaked_blocks() == 0
+    assert reg.counter("serve.aot.compiles").value == compiles
+    serving_events = [e for e in telemetry.events("retrace")
+                      if str(e.get("site", "")).startswith("serving.")]
+    assert serving_events == [], serving_events

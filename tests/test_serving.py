@@ -548,7 +548,9 @@ def test_unsorted_bucket_kwargs_normalized(model_and_params):
 
 def test_router_skips_dead_replica(model_and_params, monkeypatch):
     """One replica's scheduler dying must not black-hole the router:
-    least-depth dispatch skips dead engines while any replica lives.
+    least-depth dispatch skips dead engines while any replica lives,
+    and (ISSUE-12) the dead replica's admitted in-flight request
+    MIGRATES to the survivor and completes instead of failing typed.
     (respawn=False keeps the dead replica dead for determinism — the
     respawn path has its own test.)"""
     model, params = model_and_params
@@ -563,30 +565,32 @@ def test_router_skips_dead_replica(model_and_params, monkeypatch):
     monkeypatch.setattr(engines[0], "_compiled_decode", boom)
     router.start()
     try:
-        dead_req = engines[0].submit([1, 2])
-        with pytest.raises(MXNetError, match="exploded"):
-            dead_req.result(timeout=60)
+        moved = engines[0].submit([1, 2])
+        assert len(moved.result(timeout=60)) == 2  # journal migration
         reqs = [router.submit([3 + i]) for i in range(4)]
         outs = [r.result(timeout=60) for r in reqs]
     finally:
         router.stop()
     assert all(len(o) == 2 for o in outs)
     assert engines[0]._dead is not None
-    assert engines[1].stats["completed"] == 4
+    assert engines[1].stats["completed"] == 5  # 4 routed + 1 migrated
 
 
 def test_router_redispatches_queued_requests_on_death(model_and_params,
                                                       monkeypatch):
-    """Failover: a dying replica's queued-but-not-admitted requests move
-    to survivors (same ServeRequest objects — deadlines ride along) and
-    complete there; the admitted one fails typed (its K/V died with the
-    cache)."""
+    """Failover with the journal DISABLED (the MXNET_SERVE_JOURNAL=0
+    kill-switch contract, PR-8/11 semantics): a dying replica's
+    queued-but-not-admitted requests move to survivors (same
+    ServeRequest objects — deadlines ride along) and complete there;
+    the admitted one fails typed (its K/V died with the cache and
+    nothing replays it).  Journal-on migration coverage lives in
+    tests/test_serve_durability.py."""
     model, params = model_and_params
     engines = [_engine(model, params, max_batch=1, max_new_tokens=2),
                _engine(model, params, max_batch=2, max_new_tokens=2)]
     engines[1].name = "replica1"
     engines[1]._gauge = "serve.replica1."
-    router = ReplicaRouter(engines, respawn=False)
+    router = ReplicaRouter(engines, respawn=False, journal=False)
     router.warmup()
 
     def boom(b_bucket):
@@ -636,8 +640,9 @@ def test_router_respawns_dead_replica_compiling_nothing(model_and_params,
     router.start()
     try:
         doomed = engines[0].submit([1, 2])
-        with pytest.raises(ServeEngineDead):
-            doomed.result(timeout=60)
+        # the in-flight request migrates to replica1 and completes (the
+        # ISSUE-12 journal path) while the respawn replaces replica0
+        assert len(doomed.result(timeout=60)) == 2
         deadline = time.perf_counter() + 30
         while router.engines[0] is engines[0]:
             assert time.perf_counter() < deadline, "respawn never happened"

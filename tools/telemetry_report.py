@@ -71,6 +71,16 @@ SERVE_SPEC_COUNTERS = (
     "serve.verify_steps", "serve.chaos_draft_junk", "serve.draft_degraded")
 SERVE_SPEC_GAUGE_SUFFIX = ".spec_accept_rate"
 
+# serving durability accounting (docs/serving.md "Durability"): journal
+# migration / exact replay, rolling-restart drain, and the anti-thrash
+# preemption policy (stalls + storm trips)
+SERVE_DURABILITY_COUNTERS = (
+    "serve.migrated", "serve.replays", "serve.drained", "serve.stalled",
+    "serve.thrash_trips")
+SERVE_DURABILITY_EVENT_KINDS = (
+    "serve_migrate", "serve_drain", "serve_drain_begin",
+    "serve_thrash_trip")
+
 
 def load(path):
     records = []
@@ -245,6 +255,15 @@ def summarize(records):
         resilience["serve.queue_age_ms"] = age
     if resilience:
         out["resilience"] = resilience
+    durability = {k: int(final.get(k, 0))
+                  for k in SERVE_DURABILITY_COUNTERS if final.get(k)}
+    for kind in SERVE_DURABILITY_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            durability["%s_events" % kind] = n
+    if durability:
+        out["durability"] = durability
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -301,6 +320,11 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %d" % (key, v))
+    durability = summary.get("durability")
+    if durability:
+        lines.append("  durability:")
+        for key in sorted(durability):
+            lines.append("    %-24s %d" % (key, durability[key]))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "
