@@ -677,7 +677,16 @@ def serve_bench(record=True, with_chaos=False):
                            1, cap).astype(int)
 
         tails = _lens(max(1.0, tail_cap / 2.0), tail_cap, n_requests)
-        which = rng.randint(0, n_sys, size=n_requests)
+        if os.environ.get("SERVE_PREFIX_CYCLE", "0").lower() \
+                not in ("0", "false", "no"):
+            # round-robin through the system prompts — the canonical
+            # working-set SWEEP: with the set larger than the device
+            # pool, every prefix is LRU-evicted before its next use, so
+            # an HBM-only cache gets ~zero hits while a host tier
+            # restores every one (the tier A/B's access pattern)
+            which = np.arange(n_requests) % n_sys
+        else:
+            which = rng.randint(0, n_sys, size=n_requests)
         prompts = [sys_prompts[w] + list(rng.randint(0, vocab, size=int(t)))
                    for w, t in zip(which, tails)]
         plens = np.array([len(p) for p in prompts])
@@ -828,6 +837,23 @@ def serve_bench(record=True, with_chaos=False):
                                   float(max(looked, 1)), 4),
                 "cow_copies": _sum("cow_copies"),
                 "evictions": _sum("prefix_evictions"),
+            },
+            # host-DRAM tier (docs/serving.md "Memory tiering &
+            # sessions"); None when MXNET_SERVE_TIER=0
+            "tier": None if all(e._tier is None for e in paged_engines)
+            else {
+                "host_blocks": sum(e._tier.capacity for e in paged_engines
+                                   if e._tier is not None),
+                "host_used": sum(e._tier.used for e in paged_engines
+                                 if e._tier is not None),
+                "host_leaked": sum(e.leaked_host_blocks()
+                                   for e in paged_engines),
+                "spilled": _sum("spilled"),
+                "restored": _sum("restored"),
+                "restored_tokens": _sum("restored_tokens"),
+                "spill_fails": _sum("spill_fails"),
+                "restore_fails": _sum("restore_fails"),
+                "session_hits": _sum("session_hits"),
             },
         }
     spec_engines = [e for e in router.engines if e._spec]
@@ -1084,6 +1110,156 @@ def serve_prefix_bench(record=True):
         "prefix_hit_rate": (prefix["blocks"] or {}).get(
             "prefix", {}).get("hit_rate"),
         "tok_s_gain": round(prefix["value"] / max(single["value"], 1e-9), 3),
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+def serve_tier_bench(record=True):
+    """Tiered-KV A/B at EQUAL HBM under a hot-prefix working set ~4x
+    the device block capacity (``python bench.py --serve --tier``).
+
+    Both legs run the paged+prefix engine with the SAME (deliberately
+    tight) block pool under the shared-system-prompt trace, sized so
+    the distinct hot prefixes total >= 4x the pool's token capacity —
+    the regime where PR-10's HBM-only LRU must evict hot prefixes and
+    every re-hit pays a full prefill recompute.  The `single` leg pins
+    ``MXNET_SERVE_TIER=0`` (PR-12 evict-and-destroy); the `tier` leg
+    spills evictions to ``MXNET_SERVE_HOST_BLOCKS`` host blocks and
+    restores hits through the async-device_put path.  The acceptance
+    contract (ISSUE 13, gated nightly): prefix hit-rate strictly
+    HIGHER and ttft p50 strictly LOWER with the tier, token-for-token
+    output parity (`output_sig` equal — a restore is the same bytes),
+    zero leaked blocks in EITHER tier, zero steady-state recompiles on
+    both legs (the restore program is part of the frozen warmup set).
+    """
+    from mxnet_tpu import telemetry
+
+    # LONG hot prefixes vs SMALL prefill buckets: a 256-token prefix at
+    # 64-token buckets recomputes through ~4 chunk launches (each with
+    # a full-context gather-attention pass) while a restore is ONE
+    # batched scatter — a launch-count asymmetry that holds on any
+    # backend and in any machine-speed state, unlike raw FLOPs on a
+    # CPU mesh where a single small prefill launch can cost less than
+    # the restore's fixed path.
+    bs = int(os.environ.get("MXNET_SERVE_BLOCK_SIZE", "16"))
+    seq = int(os.environ.get("SERVE_SEQ", "512"))
+    sys_len = int(os.environ.get("SERVE_PREFIX_LEN", "256"))
+    # 12 distinct hot system prompts x 256 tokens = 3072 tokens against
+    # a 544-token device pool: the >= 4x-over-HBM regime the gate
+    # demands.  Generations long enough (8 tokens) that restores have
+    # decode iterations to overlap with — the stage-ahead pattern hides
+    # the transfer under OTHER rows' decode work.
+    n_sys = int(os.environ.get("SERVE_PREFIX_COUNT", "12"))
+    prompt_max = int(os.environ.get("SERVE_PROMPT_MAX", str(sys_len + 8)))
+    max_new = int(os.environ.get("SERVE_NEW", "8"))
+    n_blocks = int(os.environ.get("MXNET_SERVE_N_BLOCKS", "0")) or \
+        (1 + 2 * (-(-(prompt_max + max_new) // bs)))
+    working_set = n_sys * sys_len
+    capacity = (n_blocks - 1) * bs
+    host_blocks = os.environ.get("MXNET_SERVE_HOST_BLOCKS",
+                                 str(2 * n_sys * (-(-prompt_max // bs))))
+    runs = {}
+    # moderate Poisson arrivals (identical in both legs — same seed),
+    # NOT the saturating rate-0 flood: under a flood, ttft p50 is
+    # mostly queue wait, which amplifies whole-run wall-clock noise;
+    # near capacity-matched arrivals it measures the ADMISSION path
+    # itself — restore vs prefill recompute, the thing the tier
+    # changes — averaged over every request
+    shared = {"SERVE_TRACE": "prefix",
+              # round-robin prefix sweep: with the working set 4x+ the
+              # pool, cycling guarantees the evict-and-recompute leg
+              # re-prefills every hot prefix while the tier restores it
+              # — the deterministic access pattern the tier exists for
+              # (random draws let the baseline luck into device hits)
+              "SERVE_PREFIX_CYCLE": "1",
+              "SERVE_RATE": os.environ.get("SERVE_RATE", "12"),
+              "SERVE_SEQ": str(seq),
+              # prefill buckets capped at 64: the chunk machinery is
+              # what gives a recomputed 256-token prefix its multi-
+              # launch cost (Sarathi-style chunking is also how a
+              # production engine actually serves long prompts)
+              "MXNET_SERVE_PREFILL_BUCKETS":
+                  os.environ.get("MXNET_SERVE_PREFILL_BUCKETS",
+                                 "16,32,64"),
+              "SERVE_PREFIX_LEN": str(sys_len),
+              "SERVE_PREFIX_COUNT": str(n_sys),
+              "SERVE_PROMPT_MAX": str(prompt_max),
+              "SERVE_NEW": str(max_new),
+              "MXNET_SERVE_MAX_BATCH":
+                  os.environ.get("MXNET_SERVE_MAX_BATCH", "4"),
+              "MXNET_SERVE_BLOCK_SIZE": str(bs),
+              "MXNET_SERVE_N_BLOCKS": str(n_blocks)}
+    legs = (("single", {"MXNET_SERVE_TIER": "0"}),
+            ("tier", {"MXNET_SERVE_TIER": "1",
+                      "MXNET_SERVE_HOST_BLOCKS": str(host_blocks)}))
+    # each leg runs TWICE, alternating, and the per-leg representative
+    # is the run with the LOWER ttft p50: this host's wall clock drifts
+    # run to run (ambient container contention, CPU warmup), so a
+    # single sample per leg turns the A/B into a coin flip — the
+    # min-of-2 under alternation is the least-contended estimate of
+    # each leg, with identical treatment on both sides.  Token streams,
+    # hit rates, and leak/recompile counts are deterministic and
+    # identical across repeats (asserted via output_sig below).
+    for mode, env in legs + legs:
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            rec = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        runs.setdefault(mode, []).append(rec)
+    for mode, recs in runs.items():
+        sigs = {r["output_sig"] for r in recs}
+        assert len(sigs) == 1, \
+            "serve_tier_bench: %s leg not deterministic across repeats" \
+            % mode
+    single = min(runs["single"], key=lambda r: r["ttft_ms"]["p50"] or 0.0)
+    tier = min(runs["tier"], key=lambda r: r["ttft_ms"]["p50"] or 0.0)
+
+    def _hit(r):
+        return ((r["blocks"] or {}).get("prefix") or {}).get("hit_rate", 0.0)
+
+    def _ttft(r):
+        return r["ttft_ms"]["p50"] or 0.0
+
+    result = {
+        "metric": "serve_tier_vs_evict",
+        # the acceptance ratio: ttft p50 at equal HBM (single / tier —
+        # > 1.0 means the host tier answers faster than recompute)
+        "value": round(_ttft(single) / max(_ttft(tier), 1e-9), 3),
+        "unit": "single/tier ttft p50 ratio (equal HBM: %d blocks x %d; "
+                "hot working set %d tokens = %.1fx device capacity)"
+                % (n_blocks, bs, working_set,
+                   working_set / float(max(capacity, 1))),
+        "single": single,
+        "tier": tier,
+        "working_set_tokens": working_set,
+        "device_capacity_tokens": capacity,
+        "ttft_p50_ms": {"single": _ttft(single), "tier": _ttft(tier)},
+        "ttft_p50_samples_ms": {
+            m: [r["ttft_ms"]["p50"] for r in runs[m]]
+            for m in ("single", "tier")},
+        "hit_rate": {"single": _hit(single), "tier": _hit(tier)},
+        "token_parity": single["output_sig"] == tier["output_sig"],
+        "tok_s_gain": round(tier["value"] / max(single["value"], 1e-9), 3),
+        "spilled": ((tier["blocks"] or {}).get("tier") or {}).get("spilled"),
+        "restored": ((tier["blocks"] or {}).get("tier")
+                     or {}).get("restored"),
+        "host_leaked": ((tier["blocks"] or {}).get("tier")
+                        or {}).get("host_leaked"),
     }
     if record:
         here = os.path.dirname(os.path.abspath(__file__))
@@ -1361,6 +1537,8 @@ if __name__ == "__main__":
             serve_prefix_bench()
         elif "--spec" in sys.argv:
             serve_spec_bench()
+        elif "--tier" in sys.argv:
+            serve_tier_bench()
         elif "--durability" in sys.argv:
             serve_durability_bench()
         else:

@@ -68,6 +68,17 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           engine must still emit parity output (verify
                           re-derives truth from the target model), only
                           the accept rate drops
+    spill_fail:P          with probability P a host-tier spill attempt
+                          (an evicted prefix block's device→host copy)
+                          fails — the engine must degrade to the PR-12
+                          evict-and-destroy path: the block's K/V is
+                          lost, the next hit re-prefills, nothing leaks
+                          in either tier
+    restore_slow:P:MS     with probability P a host→device block
+                          restore sleeps MS ms before its pool write
+                          lands (PCIe congestion pressure: deadlines
+                          may expire mid-restore, which must resolve
+                          typed through the ordinary sweep)
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
@@ -96,7 +107,7 @@ __all__ = [
     "reset", "rpc_action", "maybe_crash_server", "grad_poison",
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
     "serve_queue_flood", "serve_block_exhaust", "serve_prefix_evict",
-    "serve_draft_junk",
+    "serve_draft_junk", "serve_spill_fail", "serve_restore_slow",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -133,6 +144,8 @@ class _Spec:
         self.block_exhaust = 0.0          # probability per allocation
         self.prefix_evict = 0.0           # probability per scheduler step
         self.draft_junk = 0.0             # probability per spec round
+        self.spill_fail = 0.0             # probability per spill attempt
+        self.restore_slow = (0.0, 0.0)    # (probability, milliseconds)
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -167,6 +180,12 @@ class _Spec:
                 self.prefix_evict = float(parts[1])
             elif kind == "draft_junk":
                 self.draft_junk = float(parts[1])
+            elif kind == "spill_fail":
+                self.spill_fail = float(parts[1])
+            elif kind == "restore_slow":
+                self.restore_slow = (float(parts[1]),
+                                     float(parts[2]) if len(parts) > 2
+                                     else 20.0)
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -386,6 +405,36 @@ def serve_draft_junk():
     with s.lock:
         return bool(s.rng_for("draft_junk").random_sample()
                     < s.draft_junk)
+
+
+def serve_spill_fail():
+    """True when the CURRENT host-tier spill attempt should fail
+    (`spill_fail:P`): the evicted block's K/V is destroyed instead of
+    spilled — exactly the PR-12 evict-and-recompute behavior the tier
+    must degrade to, so a flaky PCIe path (or host allocator) can only
+    cost prefill recomputes, never correctness or a leak."""
+    s = spec()
+    if s is None or s.spill_fail <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("spill_fail").random_sample()
+                    < s.spill_fail)
+
+
+def serve_restore_slow():
+    """Milliseconds to stall the CURRENT host→device block restore, or
+    None (`restore_slow:P:MS`).  The engine sleeps host-side before the
+    restore's pool write, so the injected latency hits exactly where
+    PCIe congestion would: a mid-restore admission whose deadline
+    expires must still resolve typed through the ordinary sweep."""
+    s = spec()
+    if s is None or s.restore_slow[0] <= 0:
+        return None
+    p, ms = s.restore_slow
+    with s.lock:
+        if s.rng_for("restore_slow").random_sample() < p:
+            return ms
+    return None
 
 
 def serve_queue_flood():

@@ -39,6 +39,17 @@ package is the production path on top of it (ROADMAP item 1):
   replicas (the mesh scale-out path) with heartbeat monitoring, failover
   of a dead replica's queued requests to survivors, and background
   respawn off the shared AOT cache (recovery compiles nothing).
+* `tiers.HostBlockTier` — the host-DRAM block tier under the paged
+  pool (`MXNET_SERVE_TIER`): prefix blocks the LRU evicts SPILL
+  device→host instead of being destroyed, the radix index becomes
+  tier-aware (a lookup landing on host-resident blocks returns a
+  restore-then-acquire plan), and restores ride async `jax.device_put`
+  transfers overlapped with the current decode iteration — a host hit
+  costs a PCIe copy instead of a prefill recompute.  Preempted
+  requests resume by restore when their spilled blocks survive, and
+  `submit(session=…)` turns the tier into chat continuity: a finished
+  turn's blocks reattach to the follow-up, which prefills only the
+  new suffix.
 * `journal.RequestJournal` — router-owned durability ledger
   (`MXNET_SERVE_JOURNAL`): a dead or draining replica's ADMITTED
   in-flight requests migrate to survivors via the exact-replay
@@ -57,6 +68,7 @@ from .engine import ServeRequest, ServingEngine, ReplicaRouter
 from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
+from .tiers import HostBlockTier
 from .spec import Drafter, NgramDrafter, ModelDrafter, make_drafter
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
@@ -65,7 +77,7 @@ from .errors import (ServeError, ServeTimeout, ServeOverload,
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
            "ReplicaRouter", "RequestJournal", "journal_enabled",
-           "BlockAllocator", "PrefixCache", "TRASH_BLOCK",
+           "BlockAllocator", "PrefixCache", "TRASH_BLOCK", "HostBlockTier",
            "sample_tokens", "Drafter", "NgramDrafter", "ModelDrafter",
            "make_drafter", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",

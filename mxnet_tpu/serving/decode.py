@@ -256,6 +256,21 @@ class TransformerKVModel:
         dst = dst.astype(jnp.int32)
         return pool.at[:, :, dst].set(pool[:, :, src])
 
+    def write_block(self, pool, dst, data):
+        """Scatter a staged run of K/V blocks — every layer, K and V —
+        into the pool at blocks ``dst`` ((k,) int32): the host-tier
+        RESTORE body.  ``data`` is the `(num_layers, 2, k, block_size,
+        embed)` device array ONE async `jax.device_put` staged from the
+        host pool while the previous decode iteration ran — a whole
+        restored prefix costs one transfer and one launch, not one per
+        block.  Padding entries past the real run point ``dst`` at the
+        trash block (the engine pads k up to a fixed bucket), so the
+        program's shape set is small and compiled at warmup like
+        `copy_block`.  The pool is donated by the engine's compiled
+        wrapper, so the write is in-place on the device."""
+        return pool.at[:, :, dst.astype(jnp.int32)].set(
+            data.astype(pool.dtype))
+
     def prefill_paged(self, params, pool, tokens, start, length, tables):
         """One chunked-prefill step over the paged pool.
 

@@ -87,6 +87,34 @@ burned), the oldest in-flight request is never preempted (livelock
 breaker: someone always finishes), and a preemption storm
 (``MXNET_SERVE_THRASH_TRIP`` preemptions with no completion) trips the
 PR-8 degrade path until the pool drains.
+
+MEMORY TIERING (docs/serving.md "Memory tiering & sessions",
+``MXNET_SERVE_TIER``): the prefix cache gains a HOST-DRAM tier below
+HBM (serving/tiers.py).  A parked block the LRU evicts is no longer
+destroyed — its K/V spills device→host into a bounded
+(``MXNET_SERVE_HOST_BLOCKS``) LRU pool and the radix node converts to
+host residency, so the hot-prefix working set survives past device
+memory.  Admission's prefix lookup returns a tier-aware plan: a match
+landing on host-resident blocks becomes a *restore-then-acquire*
+admission (`_Restore`) — fresh device blocks are allocated, the whole
+host run packs into ONE async `jax.device_put` at admission, the
+transfer OVERLAPS the current decode iteration (the
+`io.DevicePrefetchIter` two-stage stage-ahead pattern), and next
+iteration one bucketed pool-scatter program (compiled at warmup: the
+AotCache stays frozen) lands the bytes and the sequence proceeds
+exactly as a device hit —
+so a host hit costs a PCIe copy instead of a prefill recompute, and
+the miss path never waits behind a restore
+(``MXNET_SERVE_RESTORE_AHEAD`` bounds concurrent restores; past it a
+lookup simply takes its device-resident prefix).  Preempted requests
+park their K/V the same way — their registered blocks spill under
+pressure and the resume admission restores instead of replaying —
+and ``submit(session=…)`` turns the tier into chat continuity: a
+finished turn's full history is remembered under the session key,
+a follow-up submit reattaches the cached blocks (device- or
+host-resident) and prefills only the new turn's suffix.
+``MXNET_SERVE_TIER=0`` (the default) restores PR-12
+evict-and-recompute bit for bit.
 """
 from __future__ import annotations
 
@@ -94,7 +122,7 @@ import os
 import re
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -110,6 +138,7 @@ from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
 from .spec import make_drafter
+from .tiers import HostBlockTier
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
                      ServeQuarantined, ServeBlocksExhausted,
@@ -153,10 +182,16 @@ class ServeRequest:
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, deadline_ms=None,
-                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                 session=None):
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("ServeRequest: empty prompt")
+        # session continuity key (docs/serving.md "Memory tiering &
+        # sessions"): the engine prepended the session's stored history
+        # to `prompt` at submit, and will register prompt+generated
+        # under this key at retire so the NEXT turn reattaches it
+        self.session = session
         with self._ids_lock:
             self._ids[0] += 1
             self.id = self._ids[0]
@@ -291,6 +326,66 @@ class _Prefill:
         self.resume = resume      # (last, pos, n_new) after preemption
 
 
+class _Restore:
+    """A tier-aware admission waiting on its host→device transfer: the
+    prefix lookup matched ``done`` device-resident tokens plus
+    ``nodes`` host-resident blocks, fresh device blocks were allocated
+    for the host run (``dst``, the leading fresh blocks) and the whole
+    run was packed into ONE padded array and dispatched with ONE async
+    `jax.device_put` at admission (``staged``).  The transfer rides
+    UNDER the current iteration's decode launch — the
+    `DevicePrefetchIter` two-stage pattern — and `_advance_restores`
+    completes it next iteration with one warmup-compiled bucketed pool
+    write, after which the sequence proceeds exactly as if the whole
+    run had been device-resident.  ``blocks`` is the full table (shared
+    device prefix + every fresh block), held at ordinary refcounts so
+    every failure path funnels through `_release_blocks` like any other
+    holder.
+
+    Two admissions racing over the SAME spilled prefix within one
+    iteration each stage their own restore; the later `restore_landed`
+    sees the node already device-resident and keeps its copy private —
+    correct, at the cost of a duplicated transfer bounded by
+    ``MXNET_SERVE_RESTORE_AHEAD`` (folding the second admission into
+    the first's in-flight restore would save it, but degrading it to a
+    recompute — the simple alternative — costs strictly more than the
+    duplicate copy)."""
+
+    __slots__ = ("req", "row", "tokens", "done", "blocks", "nodes",
+                 "handles", "staged", "dst_d", "dst", "kb", "t_stage")
+
+    def __init__(self, req, row, tokens, blocks, done, nodes, handles,
+                 staged, dst_d, dst, kb):
+        self.req = req
+        self.row = row
+        self.tokens = tokens
+        self.done = done          # device-matched tokens (valid rows)
+        self.blocks = blocks
+        self.nodes = nodes        # host-resident _PrefixNodes, in order
+        self.handles = handles    # their host-tier handles
+        self.staged = staged      # ONE staged (L, 2, kb, bs, E) array
+        self.dst_d = dst_d        # (kb,) destination ids, trash-padded
+        self.dst = dst            # real destination blocks, in order
+        self.kb = kb              # the k-bucket the run padded up to
+        self.t_stage = time.perf_counter()
+
+
+class _SessionClaim:
+    """Placeholder live entry between a session submit passing the
+    liveness guard and its admission landing: never ``done``, so a
+    racing second submit of the same session raises typed instead of
+    both passing the guard and silently forking the history.  Resolves
+    to the admitted request (`_session_record`) or back to ``prev``
+    (`_session_unclaim` — the shed/raise path)."""
+
+    __slots__ = ("prev", "id", "done")
+
+    def __init__(self, prev):
+        self.prev = prev
+        self.id = 0 if prev is None else prev.id
+        self.done = False
+
+
 _OVERLOAD_POLICIES = ("shed", "block", "degrade")
 
 
@@ -316,7 +411,8 @@ class ServingEngine:
                  paged=None, block_size=None, n_blocks=None,
                  chunk_prefill=None, sampling=None, prefix=None,
                  prefix_pool=None, spec=None, spec_k=None,
-                 spec_drafter=None, min_progress=None, thrash_trip=None):
+                 spec_drafter=None, min_progress=None, thrash_trip=None,
+                 tier=None, host_blocks=None, restore_ahead=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -431,8 +527,27 @@ class ServingEngine:
                 if prefix_pool is None else prefix_pool)
             prefix_on = _env_flag("MXNET_SERVE_PREFIX") if prefix is None \
                 else bool(prefix)
-            self._prefix = PrefixCache(bs, self._prefix_pool) \
-                if prefix_on else None
+            # host-DRAM block tier (MXNET_SERVE_TIER, default OFF: =0 is
+            # the PR-12 evict-and-recompute behavior bit-for-bit).  The
+            # tier rides the prefix index — without it there is nothing
+            # to spill — so prefix off forces tier off.
+            tier_on = (_env_flag("MXNET_SERVE_TIER", "0") if tier is None
+                       else bool(tier)) and prefix_on
+            self._host_blocks = int(
+                os.environ.get("MXNET_SERVE_HOST_BLOCKS", "256")
+                if host_blocks is None else host_blocks)
+            self._restore_ahead = int(
+                os.environ.get("MXNET_SERVE_RESTORE_AHEAD", "2")
+                if restore_ahead is None else restore_ahead)
+            self._tier = HostBlockTier(self._host_blocks) \
+                if tier_on and self._host_blocks > 0 else None
+            self._prefix = PrefixCache(
+                bs, self._prefix_pool,
+                spill_hook=self._spill_block if self._tier is not None
+                else None,
+                host_drop_hook=self._host_dropped if self._tier is not None
+                else None) if prefix_on else None
+            self._restoring = {}   # row -> _Restore (insertion-ordered)
         else:
             self._chunk_prefill = False
             self.block_size = None
@@ -440,6 +555,10 @@ class ServingEngine:
             self._alloc = None
             self._prefix = None
             self._prefix_pool = -1
+            self._tier = None
+            self._host_blocks = 0
+            self._restore_ahead = 0
+            self._restoring = {}
             # slot max_batch is the trash slot padding rows write into
             self._cache = model.init_cache(self.max_batch + 1,
                                            device=self._device)
@@ -499,6 +618,19 @@ class ServingEngine:
         self._stalled = set()     # rows sitting out THIS decode step
         self._preempts_since_retire = 0
         self._storm = False       # preemption storm: degrade admissions
+        # session continuity (docs/serving.md "Memory tiering &
+        # sessions"): key -> (full token history of the last COMPLETED
+        # turn, last request).  LRU-capped; histories are host lists —
+        # the K/V itself lives in the prefix index / host tier and is
+        # reattached by the ordinary lookup at the follow-up submit.
+        self._sessions = OrderedDict()
+        self._session_cap = max(1, int(os.environ.get(
+            "MXNET_SERVE_SESSION_CAP", "512")))
+        # sessions are the one engine structure TWO threads touch: the
+        # caller's submit (prompt expansion + live-turn record) and the
+        # scheduler's retire (history store) — serialized here the way
+        # _qlock serializes the queue
+        self._slock = threading.Lock()
         self.last_beat = time.monotonic()  # scheduler heartbeat
         # bench accounting (host-side, touched only by the scheduler)
         self.stats = {"decode_steps": 0, "decode_rows": 0,
@@ -516,7 +648,12 @@ class ServingEngine:
                       "spec_accepted": 0, "spec_rollbacks": 0,
                       "spec_junk_rounds": 0,
                       # durability (journal replay / drain / anti-thrash)
-                      "replays": 0, "stalls": 0, "thrash_trips": 0}
+                      "replays": 0, "stalls": 0, "thrash_trips": 0,
+                      # memory tiering + sessions (0s when disabled)
+                      "spilled": 0, "restored": 0, "restored_tokens": 0,
+                      "spill_fails": 0, "restore_fails": 0,
+                      "prefill_tokens": 0, "session_hits": 0,
+                      "session_turns": 0}
 
     # -- program building --------------------------------------------------
     _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
@@ -687,6 +824,56 @@ class ServingEngine:
         z = np.zeros((1,), np.int32)
         return (z, z), ("src", "dst")
 
+    def _compiled_restore(self, kb):
+        """The host-tier restore body: a whole staged run of K/V blocks
+        scattered into the pool (every layer, K and V) with the pool
+        donated — ONE launch per restored prefix, not one per block
+        (per-block writes would pay k dispatches to replace the single
+        prefill launch a recompute costs; the batched scatter keeps the
+        restore cheaper than the recompute on dispatch-bound backends
+        too).  Runs pad up to a few power-of-two k-buckets (padding
+        entries scatter into the trash block), all compiled at warmup
+        like `cow`, so the restore path adds nothing to steady state —
+        its real cost is the PCIe transfer, which rode under the
+        previous iteration's decode launch."""
+        def build():
+            def prog(pool, dst, data):
+                return self.model.write_block(pool, dst, data)
+
+            fn = jax.jit(prog, donate_argnums=(0,))
+            z = self._put(np.zeros((kb,), np.int32))
+            d = self._put(np.zeros(self._restore_shape(kb),
+                                   self.model.dtype))
+            return fn.lower(self._cache, z, d).compile()
+
+        return self._aot.get(("tier_restore", kb, 1), build)
+
+    def _restore_shape(self, kb):
+        return (self.model.num_layers, 2, int(kb), self.block_size,
+                self.model.num_embed)
+
+    def _restore_buckets(self):
+        """Power-of-two restore run lengths up to the table width."""
+        out, k = [], 1
+        while k < self._n_table:
+            out.append(k)
+            k *= 2
+        out.append(k)
+        return out
+
+    def _restore_bucket(self, n):
+        for k in self._restore_buckets():
+            if k >= n:
+                return k
+        raise MXNetError(
+            "ServingEngine %s: restore run %d exceeds the table width %d"
+            % (self.name, n, self._n_table))
+
+    def _restore_watch_arrays(self, kb):
+        return ((np.zeros((kb,), np.int32),
+                 np.zeros(self._restore_shape(kb), self.model.dtype)),
+                ("dst", "data"))
+
     def _put(self, a):
         return jax.device_put(a, self._device)
 
@@ -754,12 +941,22 @@ class ServingEngine:
             self._compiled_cow()
             arrays, names = self._cow_watch_arrays()
             self._watch("cow", arrays, names, 1, seed=True)
+        if self._tier is not None:
+            # the restore writes join the frozen set too: a host hit in
+            # steady state compiles nothing, it only transfers
+            for kb in self._restore_buckets():
+                self._compiled_restore(kb)
+                arrays, names = self._restore_watch_arrays(kb)
+                self._watch("restore", arrays, names, kb, seed=True)
         self._aot.freeze()
         return {"prefill": list(self.prefill_buckets),
                 "decode": list(self.decode_buckets),
                 "cache": "paged" if self._paged else "slot",
                 "block_size": self.block_size, "n_blocks": self.n_blocks,
                 "prefix": self._prefix is not None,
+                "tier": None if self._tier is None else
+                {"host_blocks": self._tier.capacity,
+                 "restore_ahead": self._restore_ahead},
                 "spec": None if not self._spec else
                 {"k": self._spec_k, "drafter": self._drafter.name}}
 
@@ -786,12 +983,119 @@ class ServingEngine:
             spec_drafter=self._drafter_arg if self._drafter_arg is not None
             else (self._drafter.name if self._drafter is not None
                   else None),
-            min_progress=self._min_progress, thrash_trip=self._thrash_trip)
+            min_progress=self._min_progress, thrash_trip=self._thrash_trip,
+            tier=self._tier is not None, host_blocks=self._host_blocks,
+            restore_ahead=self._restore_ahead)
 
     # -- request intake ----------------------------------------------------
+    def has_session(self, key):
+        """Whether this engine holds session ``key``'s history (the
+        router's affinity signal: a follow-up lands where the K/V
+        likely still is — device-resident, or a host-tier restore)."""
+        with self._slock:
+            return key in self._sessions
+
+    def _session_prompt(self, key, prompt):
+        """Prepend session ``key``'s stored history to this turn's
+        ``prompt`` (docs/serving.md "Memory tiering & sessions").  The
+        expanded prompt flows through ordinary admission, so the prefix
+        lookup reattaches the previous turns' cached blocks — device-
+        or host-resident — and only the new suffix prefills.  A first
+        turn (unknown key) passes through unchanged.  Submitting the
+        next turn while the previous one is unresolved raises: the
+        history it would build on does not exist yet, and silently
+        using the older one would diverge the conversation.  (`_retire`
+        stores the history BEFORE `_finish` sets done, so a prev.done
+        observed here always sees its completed history.)
+
+        Passing the guard CLAIMS the turn atomically (a `_SessionClaim`
+        becomes the live entry under the lock), so two racing submits
+        of the same session cannot both pass — the loser raises typed.
+        The claim resolves in `submit`: `_session_record` on success,
+        `_session_unclaim` when admission sheds/raises."""
+        with self._slock:
+            ent = self._sessions.get(key)
+            if ent is None:
+                return prompt
+            hist, prev = ent
+            if prev is not None and not prev.done:
+                raise MXNetError(
+                    "ServingEngine %s: session %r has an unresolved turn "
+                    "(request %d) — wait for its result before submitting "
+                    "the next turn" % (self.name, key, prev.id))
+            self._sessions[key] = (hist, _SessionClaim(prev))
+            self._sessions.move_to_end(key)
+            hist = list(hist)
+        return hist + [int(t) for t in np.asarray(prompt).reshape(-1)]
+
+    def _session_record(self, key, req):
+        """The claimed turn was ADMITTED: the request replaces the
+        claim as the session's live entry (the liveness guard), under
+        the LRU cap; history only advances at `_session_store`.
+        Follow-up hits count HERE — at the landing, like prefix hits —
+        so a shed submit can never inflate `session_hits`."""
+        with self._slock:
+            ent = self._sessions.get(key)
+            hist = ent[0] if ent is not None else []
+            self._sessions[key] = (hist, req)
+            self._sessions.move_to_end(key)
+            self.stats["session_turns"] += 1
+            if hist:
+                self.stats["session_hits"] += 1
+            self._trim_sessions_locked()
+        if hist:
+            self._count("session_hits")
+
+    def _session_unclaim(self, key):
+        """Admission shed/raised after the claim: restore the previous
+        resolved turn as the live entry — the conversation is exactly
+        as it was, retryable."""
+        with self._slock:
+            ent = self._sessions.get(key)
+            if ent is not None and isinstance(ent[1], _SessionClaim):
+                self._sessions[key] = (ent[0], ent[1].prev)
+
+    def _session_store(self, req):
+        """A session turn completed: its FULL history (expanded prompt
+        + every generated token) becomes the context the next turn
+        builds on.  The K/V needs no copy — the full blocks are
+        registered in the prefix index already, park at release, and
+        spill to the host tier under pressure.  Runs on the scheduler
+        thread, BEFORE `_finish` flips done (so the liveness guard can
+        never admit a follow-up against a missing history)."""
+        with self._slock:
+            self._sessions[req.session] = (
+                list(req.prompt) + [int(t) for t in req.tokens], req)
+            self._sessions.move_to_end(req.session)
+            self._trim_sessions_locked()
+
+    def _trim_sessions_locked(self):
+        """Enforce `MXNET_SERVE_SESSION_CAP` (caller holds `_slock`) —
+        every insert path trims, so migrated turns retiring here count
+        against the cap exactly like local submits."""
+        while len(self._sessions) > self._session_cap:
+            self._sessions.popitem(last=False)
+
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
                deadline_ms=None, temperature=0.0, top_k=0, top_p=1.0,
-               seed=None, _count_shed=True):
+               seed=None, session=None, _count_shed=True):
+        if session is None:
+            return self._submit(prompt, max_new_tokens, eos_id,
+                                deadline_ms, temperature, top_k, top_p,
+                                seed, None, _count_shed)
+        prompt = self._session_prompt(session, prompt)  # claims the turn
+        try:
+            return self._submit(prompt, max_new_tokens, eos_id,
+                                deadline_ms, temperature, top_k, top_p,
+                                seed, session, _count_shed)
+        except BaseException:
+            # shed/rejected after the claim: the conversation reverts to
+            # exactly its pre-submit state — retryable, never bricked
+            self._session_unclaim(session)
+            raise
+
+    def _submit(self, prompt, max_new_tokens, eos_id, deadline_ms,
+                temperature, top_k, top_p, seed, session, _count_shed):
         if max_new_tokens is None:
             max_new_tokens = self.max_new_default
         elif int(max_new_tokens) < 1:
@@ -809,7 +1113,7 @@ class ServingEngine:
                            self.eos_id if eos_id is None else eos_id,
                            deadline_ms=deadline_ms,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p, seed=seed)
+                           top_p=top_p, seed=seed, session=session)
         if not (self._paged and self._chunk_prefill) and \
                 len(req.prompt) > self.prefill_buckets[-1]:
             # chunked prefill streams any prompt through bucket-sized
@@ -845,6 +1149,10 @@ class ServingEngine:
             self._enqueue_blocking(req)
         else:
             self._enqueue(req, count_shed_global=_count_shed)
+        if session is not None:
+            # only an ADMITTED request becomes the session's live turn:
+            # a shed/raise above leaves the session exactly as it was
+            self._session_record(session, req)
         # counted at the submit door only: failover re-dispatch and chaos
         # floods reuse _enqueue but are not new offered requests (they
         # have serve.redispatched / serve.chaos_flooded of their own)
@@ -959,10 +1267,12 @@ class ServingEngine:
         request and its prefill landing in `_active` (or finishing) —
         without it a thread-driven `run_until_idle` could read depth 0
         and declare idle while a prefill is in flight.  `_prefilling`
-        (paged chunked prefills mid-stream) counts the same way."""
+        (paged chunked prefills mid-stream) and `_restoring` (host-tier
+        restores staged but not landed) count the same way."""
         with self._qlock:
             return len(self._queue) + self._admitting + \
-                len(self._active) + len(self._prefilling)
+                len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
 
     # -- scheduling --------------------------------------------------------
     def _bucket_for(self, n, buckets):
@@ -1079,6 +1389,88 @@ class ServingEngine:
         return self._alloc.capacity - self._alloc.free_blocks - \
             self._alloc.used_blocks - parked
 
+    def leaked_host_blocks(self):
+        """Host-tier blocks no prefix node references — must be 0
+        whenever the scheduler is quiesced (every tier entry is owned
+        by exactly one radix node; staged restores hold device copies,
+        not handles)."""
+        if self._tier is None:
+            return 0
+        return self._tier.used - self._prefix.host_count
+
+    # -- host-DRAM tier (docs/serving.md "Memory tiering & sessions") ------
+    def _spill_block(self, block, tokens, node):
+        """`PrefixCache` eviction hook: copy the evicted block's K/V
+        device→host into the tier so the prefix survives below HBM.
+        Returns the host handle — or None (tier missing, `spill_fail`
+        chaos, or a device read failure), upon which the cache detaches
+        the node exactly as PR-12 did: spilling can only ever ADD a
+        cheaper recovery path, never a correctness edge.  ``tokens`` is
+        the node's full token path (the structured eviction metadata
+        any observer gets); unused here beyond events because the node
+        itself keys the index."""
+        if self._tier is None:
+            return None
+        if chaos.enabled() and chaos.serve_spill_fail():
+            self.stats["spill_fails"] += 1
+            self._count("spill_fails")
+            return None
+        try:
+            # the block is parked (refcount 0, full, registered): its
+            # rows are stable between launches, and the scheduler owns
+            # the pool here.  Dispatch the slice + an ASYNC device→host
+            # copy and hand the in-flight array to the tier: a spill on
+            # the admission road must never block on the launch queue
+            # (a synchronous fetch here stalls every pressured admission
+            # behind whatever decode work is in flight — measured as the
+            # dominant tier cost before this went async).  `tier.get`
+            # finalizes to numpy on first use, at least one admission
+            # later, when the copy has long landed.
+            data = self._cache[:, :, block]
+            copy_async = getattr(data, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        except Exception as e:  # noqa: BLE001 — degrade, never escalate
+            self.stats["spill_fails"] += 1
+            self._count("spill_fails")
+            telemetry.record_event("serve_spill_failed", replica=self.name,
+                                   block=int(block), error=str(e)[:200])
+            return None
+        handle, evicted = self._tier.put(data)
+        for h in evicted:
+            # the tier's own LRU pushed the oldest host blocks out: the
+            # bottom tier really forgets — detach their index entries
+            for orphan in self._prefix.drop_host(h):
+                self._tier.free(orphan)
+        self.stats["spilled"] += 1
+        self._count("spilled")
+        telemetry.set_gauge(self._gauge + "host_blocks_used",
+                            self._tier.used)
+        return handle
+
+    def _host_dropped(self, handle):
+        """`PrefixCache` host-drop hook: the index dropped its reference
+        (node detach/orphan) — free the tier storage with it."""
+        if self._tier is not None:
+            self._tier.free(handle)
+            telemetry.set_gauge(self._gauge + "host_blocks_used",
+                                self._tier.used)
+
+    def _drop_host_node(self, node):
+        """Drop one host-resident node (and its host subtree) from both
+        the index and the tier — the restore-failure degrade path: the
+        retry must take the chunk-prefill replay road, not re-stage the
+        same failing restore."""
+        if node.tier != "host":
+            return
+        handle = node.block
+        orphans = self._prefix.drop_host(handle)
+        self._tier.free(handle)
+        for h in orphans:
+            self._tier.free(h)
+        telemetry.set_gauge(self._gauge + "host_blocks_used",
+                            self._tier.used)
+
     def _register_prefix(self, tokens, blocks, n_tokens):
         """Register a sequence's newly-FULL blocks in the prefix index
         (eager: a concurrent request can share them while the writer is
@@ -1114,7 +1506,9 @@ class ServingEngine:
         for holder, n in [(s.blocks, s.pos)
                           for s in self._active.values()] + \
                          [(p.blocks, p.done)
-                          for p in self._prefilling.values()]:
+                          for p in self._prefilling.values()] + \
+                         [(r.blocks, r.done)
+                          for r in self._restoring.values()]:
             if holder is None:
                 continue
             for i, b in enumerate(holder):
@@ -1160,8 +1554,27 @@ class ServingEngine:
                 else:
                     self._quarantine(pf.req, "prefill lost to a cache "
                                      "rebuild twice: %s" % reason[:200])
+            for row, rs in list(self._restoring.items()):
+                # a staged restore's target blocks died with the pool;
+                # same one-retry contract as a mid-stream prefill
+                del self._restoring[row]
+                self._free.append(row)
+                rs.blocks = None
+                if rs.req._requeues < 1:
+                    rs.req._requeues += 1
+                    with self._qlock:
+                        self._queue.appendleft(rs.req)
+                else:
+                    self._quarantine(rs.req, "restore lost to a cache "
+                                     "rebuild twice: %s" % reason[:200])
             if self._prefix is not None:
                 self._prefix.clear()  # the pool its nodes point at is gone
+            if self._tier is not None:
+                # the index died with the pool and the host copies are
+                # unreachable without it: clear the bottom tier too (one
+                # sweep, not a hook per handle)
+                self._tier.clear()
+                telemetry.set_gauge(self._gauge + "host_blocks_used", 0)
             self._alloc.reset()
             self._cache = self.model.init_block_pool(
                 self.n_blocks, self.block_size, device=self._device)
@@ -1250,6 +1663,7 @@ class ServingEngine:
         req.t_first = time.perf_counter()
         req.tokens.append(first)
         self.stats["prefills"] += 1
+        self.stats["prefill_tokens"] += plen
         self.stats["tokens"] += 1
         telemetry.inc("serve.prefills")
         telemetry.inc("serve.tokens")
@@ -1277,7 +1691,23 @@ class ServingEngine:
         retires)."""
         row = self._free.pop()
         tokens = req.prompt if req._resume is None else req._resume[0]
-        shared = [] if self._prefix is None else self._prefix.lookup(tokens)
+        if self._prefix is None:
+            shared, host_nodes = [], []
+        else:
+            shared, host_nodes = self._prefix.lookup_plan(tokens)
+            if host_nodes and (self._tier is None or
+                               len(self._restoring) >=
+                               self._restore_ahead):
+                # no restore slot (or no tier): the miss path must never
+                # wait behind a restore — admit on the device match
+                # alone.  The matched host blocks stay put for a later
+                # hit, MRU-touched so a hot prefix that keeps matching
+                # while restore slots are busy cannot age out of the
+                # host LRU unused.
+                if self._tier is not None:
+                    for node in host_nodes:
+                        self._tier.touch(node.block)
+                host_nodes = []
         matched = len(shared) * self.block_size
         # acquire BEFORE allocating: live refs pin the matched blocks so
         # the fresh allocation's eviction-under-pressure cannot reclaim
@@ -1295,16 +1725,32 @@ class ServingEngine:
             with self._qlock:
                 self._queue.appendleft(req)
             return False
+        # stage the host run's transfer (restore-then-acquire): the
+        # whole run packs into ONE padded array and ONE async
+        # device_put dispatched NOW, so the PCIe copy rides under this
+        # iteration's decode launch; the write into the pool happens
+        # next iteration (_advance_restores).  A handle the tier
+        # evicted in the window truncates the run — contiguity is what
+        # makes the table coverage valid.
+        nodes, handles, arrs, dst = [], [], [], []
+        for node in host_nodes:
+            arr = self._tier.get(node.block)
+            if arr is None:
+                break
+            nodes.append(node)
+            handles.append(node.block)
+            arrs.append(arr)
+            dst.append(fresh[len(nodes) - 1])
         # hit accounting only for admissions that LAND: a denied-alloc
-        # requeue retries the lookup every iteration and would otherwise
-        # inflate hit_rate exactly when the pool is under pressure
-        if self._prefix is not None:
+        # requeue retries the lookup every iteration, and a restore that
+        # fails mid-flight requeues too — counting either at staging
+        # would inflate hit_rate exactly when the pool (or the restore
+        # path) is under pressure, so restore admissions count at
+        # `_complete_restore` instead
+        if self._prefix is not None and not nodes:
             self.stats["prefix_lookup_tokens"] += len(tokens)
-            if shared:
-                self.stats["prefix_hits"] += 1
-                self.stats["prefix_tokens"] += matched
-                self._count("prefix_hits")
-                telemetry.inc("serve.prefix_tokens", matched)
+            if matched:
+                self._count_prefix_hit(matched)
         blocks = shared + fresh
         self._block_gauges()
         if req._migrated:
@@ -1313,7 +1759,38 @@ class ServingEngine:
             req._migrated = False
             self.stats["replays"] += 1
             self._count("replays")
-        if matched >= len(tokens):
+        if nodes:
+            kb = self._restore_bucket(len(nodes))
+            data = np.zeros(self._restore_shape(kb), self.model.dtype)
+            for j, a in enumerate(arrs):
+                data[:, :, j] = a
+            dsts = np.full((kb,), TRASH_BLOCK, np.int32)
+            dsts[:len(dst)] = dst
+            self._restoring[row] = _Restore(req, row, list(tokens), blocks,
+                                            matched, nodes, handles,
+                                            self._put(data),
+                                            self._put(dsts), dst, kb)
+            return True
+        self._enter_decode_or_prefill(req, row, list(tokens), blocks,
+                                      matched)
+        return True
+
+    def _count_prefix_hit(self, matched_tokens):
+        self.stats["prefix_hits"] += 1
+        self.stats["prefix_tokens"] += matched_tokens
+        self._count("prefix_hits")
+        telemetry.inc("serve.prefix_tokens", matched_tokens)
+
+    def _enter_decode_or_prefill(self, req, row, tokens, blocks, covered):
+        """Route an admission whose cache rows ``[0, covered)`` are
+        already valid (device prefix hit, or a completed host-tier
+        restore): a full cover BOOTSTRAPS straight into the decode set,
+        anything else streams its uncached suffix through chunked
+        prefill.  The single entry point both the ordinary admission
+        and `_advance_restores` funnel through, so resume bookkeeping,
+        drafter seeding, and latency stamps cannot diverge between a
+        device hit and a restored one."""
+        if covered >= len(tokens):
             # full cover (len(tokens) is block-aligned): nothing to
             # prefill — admit straight to decode, feeding the last
             # cached token at its own position.  Fresh admissions have
@@ -1338,14 +1815,13 @@ class ServingEngine:
                        ctx=list(tokens[:pos]))
             seq.n_new = n_new
             self._active[row] = seq
-            return True
-        pf = _Prefill(req, row, list(tokens), blocks,
+            return
+        pf = _Prefill(req, row, tokens, blocks,
                       resume=None if req._resume is None
                       else req._resume[1:])
-        pf.done = matched  # the cached prefix needs no prefill
+        pf.done = covered  # the cached prefix needs no prefill
         self._prefilling[row] = pf
         self._advance_chunk(pf)
-        return True
 
     def _drop_prefill(self, pf):
         """Remove a mid-stream prefill: row and blocks return to their
@@ -1362,6 +1838,119 @@ class ServingEngine:
         for pf in list(self._prefilling.values()):
             if pf.row in self._prefilling:
                 self._advance_chunk(pf)
+
+    # -- host-tier restore completion --------------------------------------
+    def _drop_restore(self, rs):
+        """Remove a staged restore: row and blocks return to their
+        pools (the staged device arrays just drop — they were never
+        part of the pool); the caller resolves the request."""
+        self._restoring.pop(rs.row, None)
+        self._free.append(rs.row)
+        self._release_blocks(rs)
+
+    def _advance_restores(self):
+        """Land every restore staged in a PREVIOUS iteration: the async
+        `device_put`s dispatched at admission rode under that
+        iteration's decode launch (the DevicePrefetchIter overlap), so
+        by now the bytes are on-device and each block costs one tiny
+        warmup-compiled pool write.  Runs BEFORE `_advance_prefills`,
+        so a restore that still has an uncached suffix advances its
+        first prefill chunk in this same iteration."""
+        for rs in list(self._restoring.values()):
+            if rs.row in self._restoring:
+                self._complete_restore(rs)
+
+    def _complete_restore(self, rs):
+        """Write one staged restore's blocks into the pool and route
+        the admission onward.  Failure scoping mirrors `_advance_chunk`:
+        device death is scheduler-fatal; a consumed pool rebuilds (which
+        requeues every staged restore); a scoped fault DEGRADES to the
+        chunk-prefill replay path — the involved host entries drop, the
+        request requeues at the front, and its retry prefills the
+        context the restore would have transferred.  Never a hang,
+        never a leak in either tier."""
+        req = rs.req
+        ms = chaos.serve_restore_slow()
+        if ms:
+            time.sleep(ms / 1e3)
+        try:
+            compiled = self._compiled_restore(rs.kb)
+            self._watch("restore", (rs.dst_d, rs.staged),
+                        ("dst", "data"), rs.kb)
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError(
+                    "chaos: injected restore launch error")
+            self._cache = compiled(self._cache, rs.dst_d, rs.staged)
+        except Exception as e:
+            kind = self._classify_failure(e)
+            if kind == "device":
+                self._drop_restore(rs)
+                req._finish(error=ServeEngineDead(
+                    "restore launch failed: %s" % str(e)[:400]))
+                raise _EngineFatal("restore launch failed: %s" % e) from e
+            if kind == "cache":
+                self._rebuild_cache("restore launch failed: %s" % e)
+                return
+            self.stats["restore_fails"] += 1
+            self._count("restore_fails")
+            telemetry.record_event("serve_restore_failed",
+                                   replica=self.name, request=req.id,
+                                   error=str(e)[:200])
+            self._drop_restore(rs)
+            for node in rs.nodes:
+                self._drop_host_node(node)
+            with self._qlock:
+                self._queue.appendleft(req)
+            return
+        # landed: flip the nodes back to device residency (keeping the
+        # host copies — re-evicting them is free), count, and proceed.
+        # A node upgraded or dropped in the window leaves its restored
+        # block as the sequence's private property: the bytes came from
+        # the tier, the tree only decides future sharing.
+        for node, handle, dstb in zip(rs.nodes, rs.handles, rs.dst):
+            self._prefix.restore_landed(node, handle, dstb)
+        n_host = len(rs.nodes)
+        covered = rs.done + n_host * self.block_size
+        # the deferred hit accounting: this restore admission LANDED
+        self.stats["prefix_lookup_tokens"] += len(rs.tokens)
+        self._count_prefix_hit(covered)
+        self.stats["restored"] += n_host
+        self._count("restored", n_host)
+        self.stats["restored_tokens"] += n_host * self.block_size
+        telemetry.observe("serve.restore_wait_ms",
+                          1e3 * (time.perf_counter() - rs.t_stage))
+        telemetry.set_gauge(self._gauge + "host_blocks_used",
+                            self._tier.used)
+        del self._restoring[rs.row]
+        if self._drafter is not None and self._drafter.mirrors_pool:
+            # the mirrored draft pool follows the restore: re-derive its
+            # rows for the restored span by draft-prefilling the tokens
+            # the target just got back as bytes (accept-rate hygiene,
+            # never correctness)
+            self._drafter_restore_span(rs.tokens, rs.blocks, rs.done,
+                                       covered)
+        self._enter_decode_or_prefill(req, rs.row, rs.tokens, rs.blocks,
+                                      covered)
+        self._block_gauges()
+
+    def _drafter_restore_span(self, tokens, blocks, start, end):
+        """Feed the restored (block-aligned) span to the drafter as
+        ordinary prefill chunks over the warmup bucket shapes."""
+        pos = start
+        largest = self.prefill_buckets[-1]
+        while pos < end:
+            remaining = end - pos
+            bucket = largest if remaining > largest else \
+                self._bucket_for(remaining, self.prefill_buckets)
+            chunk = min(remaining, bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :chunk] = tokens[pos:pos + chunk]
+            table = np.full((1, self._n_table), TRASH_BLOCK, np.int32)
+            table[0, :len(blocks)] = blocks
+            self._drafter.on_restore_span(
+                self._put(toks), self._put(np.array([pos], np.int32)),
+                self._put(np.array([chunk], np.int32)), self._put(table))
+            pos += chunk
 
     def _advance_chunk(self, pf):
         """Launch one prefill chunk; the final chunk moves the sequence
@@ -1422,6 +2011,7 @@ class ServingEngine:
                                            table_d)
         pf.done += chunk
         self.stats["prefill_chunks"] += 1
+        self.stats["prefill_tokens"] += chunk  # the suffix-only witness
         telemetry.inc("serve.prefill_chunks")
         # publish the chunk's newly-FULL blocks (a block whose bucket
         # tail is padding garbage stays private: `done` counts only real
@@ -1724,6 +2314,12 @@ class ServingEngine:
             # stream an exact oracle for the next identical request
             self._drafter.on_retire(seq.ctx + [seq.last])
         self._release_blocks(seq)
+        if seq.req.session is not None:
+            # the turn's full history becomes the session context the
+            # next submit(session=...) reattaches; its registered blocks
+            # just parked (and will spill under pressure), so the
+            # follow-up is a prefix hit — device or host — not a replay
+            self._session_store(seq.req)
         seq.req._finish()
         self.stats["completed"] += 1
         # a completion proves the pool drains: reset the storm detector
@@ -1783,6 +2379,14 @@ class ServingEngine:
             if r._cancelled or r.expired(now):
                 dropped.append(r)
                 self._drop_prefill(pf)
+        for rs in list(self._restoring.values()):
+            # a deadline expiring mid-restore (restore_slow pressure)
+            # resolves typed like any other holder — the staged arrays
+            # simply drop
+            r = rs.req
+            if r._cancelled or r.expired(now):
+                dropped.append(r)
+                self._drop_restore(rs)
         for r in dropped:
             self._finish_dropped(r, now)
 
@@ -1817,6 +2421,10 @@ class ServingEngine:
                     self._count_evictions(len(evicted))
         self._sweep()
         if self._paged:
+            # restores staged last iteration land BEFORE new prefill
+            # chunks and admissions: their transfers already overlapped
+            # the previous decode launch
+            self._advance_restores()
             self._advance_prefills()
         while self._free:
             with self._qlock:
@@ -1847,9 +2455,10 @@ class ServingEngine:
             self.stats["max_concurrent"] = n
         telemetry.set_gauge(self._gauge + "active", n)
         if n == 0:
-            # mid-stream chunked prefills still count as work: the
-            # scheduler keeps stepping until they land
-            return len(self._prefilling)
+            # mid-stream chunked prefills and staged restores still
+            # count as work: the scheduler keeps stepping until they
+            # land
+            return len(self._prefilling) + len(self._restoring)
         if chaos.enabled():
             if chaos.serve_engine_crash(self.name):
                 raise chaos.ChaosEngineCrash(
@@ -1874,7 +2483,8 @@ class ServingEngine:
             # to launch — back off briefly so the retry loop doesn't spin
             # the host while it waits for room (or a deadline) to resolve
             time.sleep(0.001)
-            return len(self._active) + len(self._prefilling)
+            return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
         b = self._bucket_for(n, self.decode_buckets)
         seqs = [self._active[s] for s in slots]
         token = np.zeros((b,), np.int32)
@@ -1906,7 +2516,8 @@ class ServingEngine:
             # scoped/transient: the donated cache survived — retry the
             # same decode next iteration, escalate after N consecutive
             self._handle_launch_failure(e, "decode")
-            return len(self._active) + len(self._prefilling)
+            return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
         self._launch_fails = 0
         nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
         self.stats["decode_steps"] += 1
@@ -1926,7 +2537,8 @@ class ServingEngine:
                 self._drafter.observe(seq.ctx + [seq.last], 1)
             if finished:
                 self._retire(slot, seq)
-        return len(self._active) + len(self._prefilling)
+        return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
 
     def _advance_one(self, seq, t):
         """Advance one sequence by ONE emitted token ``t`` — the single
@@ -2014,7 +2626,8 @@ class ServingEngine:
         n = len(rows)
         if n == 0:
             time.sleep(0.001)  # all rows stalled: retry next iteration
-            return len(self._active) + len(self._prefilling)
+            return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
         b = self._bucket_for(n, self.decode_buckets)
         k = self._spec_k
         c = k + 1
@@ -2066,7 +2679,8 @@ class ServingEngine:
             out, self._cache = compiled(self._params, self._cache, *args)
         except Exception as e:
             self._handle_launch_failure(e, "verify")
-            return len(self._active) + len(self._prefilling)
+            return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
         self._launch_fails = 0
         out = np.asarray(out)  # (b, k+2): picks then n_accepted
         self.stats["verify_steps"] += 1
@@ -2109,7 +2723,8 @@ class ServingEngine:
                 self._gauge + "spec_accept_rate",
                 round(self.stats["spec_accepted"]
                       / float(self.stats["spec_proposed"]), 4))
-        return len(self._active) + len(self._prefilling)
+        return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
 
     # -- worker loop -------------------------------------------------------
     def start(self):
@@ -2193,6 +2808,11 @@ class ServingEngine:
             self._free.append(pf.row)
             self._release_blocks(pf)
             inflight.append(pf.req)
+        for rs in list(self._restoring.values()):
+            del self._restoring[rs.row]
+            self._free.append(rs.row)
+            self._release_blocks(rs)
+            inflight.append(rs.req)
         return inflight
 
     def _join_thread(self):
@@ -2230,6 +2850,9 @@ class ServingEngine:
         for pf in list(self._prefilling.values()):
             self._drop_prefill(pf)
             pf.req._finish(error=err)
+        for rs in list(self._restoring.values()):
+            self._drop_restore(rs)
+            rs.req._finish(error=err)
         for req in stranded:
             req._finish(error=err)
 
@@ -2559,6 +3182,7 @@ class ReplicaRouter:
             raise ServeEngineDead("ReplicaRouter: router stopped")
         telemetry.set_gauge("serve.replicas", len(self.engines))
         last_err = None
+        session = kw.get("session")
         # two rounds: a replica dying (or respawning) between the snapshot
         # and the submit re-routes instead of failing the request
         for _ in range(2):
@@ -2566,7 +3190,22 @@ class ReplicaRouter:
             if not live:
                 break
             shed = 0
-            for eng in sorted(live, key=lambda e: e.depth()):
+            # session affinity: a follow-up turn must land on a replica
+            # holding the session's history — its K/V is device- or
+            # host-resident there, and any other replica would SILENTLY
+            # restart the conversation.  With holders alive the
+            # candidate set is the holders ONLY (ties break
+            # least-depth): a holder that sheds fails the submit typed
+            # rather than forking the history onto a stranger.  With no
+            # live holder (first turn, or the holder died — session
+            # state is engine-local and dies with its replica) the turn
+            # routes least-depth as a fresh conversation.
+            order = sorted(live, key=lambda e: e.depth())
+            if session is not None:
+                holders = [e for e in live if e.has_session(session)]
+                if holders:
+                    order = sorted(holders, key=lambda e: e.depth())
+            for eng in order:
                 try:
                     req = eng.submit(prompt, _count_shed=False, **kw)
                     if self.journal is not None:
@@ -2584,13 +3223,15 @@ class ReplicaRouter:
                     if eng._dead is None:
                         raise  # a bad request, not a dead replica
                     last_err = e
-            if shed == len(live):
+            if shed == len(order):
                 # the request is definitively rejected only here — the
                 # per-replica attempts above counted serve.<name>.shed
+                # (for a session turn, "all" means all HOLDERS: shedding
+                # onto a history-less replica is not an option)
                 telemetry.inc("serve.shed")
                 raise ServeOverload(
-                    "ReplicaRouter: all %d live replicas shed (%s)"
-                    % (shed, last_err))
+                    "ReplicaRouter: all %d live candidate replicas shed "
+                    "(%s)" % (shed, last_err))
         raise ServeEngineDead(
             "ReplicaRouter: no live replica among %d (%s)"
             % (len(self.engines), last_err))

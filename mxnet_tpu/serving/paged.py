@@ -39,6 +39,22 @@ parks instead of returning to the free list.  Only full blocks of
 ACCEPTED tokens ever register in the `PrefixCache`; speculative garbage
 is structurally unshareable.
 
+Memory TIERING (serving/tiers.py, ``MXNET_SERVE_TIER``) extends the
+radix index below HBM: a parked block the LRU evicts is no longer
+necessarily destroyed — the engine's eviction hook may SPILL its K/V
+to a host-DRAM pool, and the node then converts to HOST residency
+(``tier == "host"``, ``block`` holds the host handle) instead of
+detaching.  Host-resident nodes only ever appear below device-resident
+ones on any path (eviction is leaf-first and live holders pin whole
+prefixes, so spills happen bottom-up), which is exactly what makes
+`lookup_plan` well-formed: a lookup returns a contiguous DEVICE run
+followed by a contiguous HOST run, and the engine restores the host
+run into freshly allocated device blocks before acquiring.  A restored
+(or freshly re-prefilled) run flips its node back to device residency;
+the host copy may be retained as a free re-spill (full blocks are
+immutable — CoW keeps writers off registered blocks — so the two
+copies cannot diverge).
+
 Block 0 is reserved as the TRASH block: padding decode rows and the
 unallocated tail entries of every block table point at it, so gathers
 stay in-bounds with fixed shapes and scatters from padding rows land
@@ -237,16 +253,22 @@ class BlockAllocator:
 
 class _PrefixNode:
     """One cached full-block token run: `key` is the exact block_size-
-    token tuple, `block` the physical block holding its K/V, the parent
-    chain spells the whole prefix."""
+    token tuple, `block` the physical location of its K/V — a device
+    block id while ``tier == "dev"``, a host-tier handle while
+    ``tier == "host"`` — and the parent chain spells the whole prefix.
+    ``host`` (dev-resident nodes only) remembers a still-valid host
+    copy from an earlier spill/restore cycle, so re-evicting this node
+    costs no second device→host transfer."""
 
-    __slots__ = ("key", "block", "parent", "children")
+    __slots__ = ("key", "block", "parent", "children", "tier", "host")
 
     def __init__(self, key, block, parent):
         self.key = key
         self.block = block
         self.parent = parent
         self.children = {}
+        self.tier = "dev"
+        self.host = None
 
 
 class PrefixCache:
@@ -266,58 +288,120 @@ class PrefixCache:
     refcount hits zero, and `evict`s parked blocks — oldest-first with
     leaf preference, so a prefix's tail dies before its root — only
     under allocation pressure (or past ``pool_cap``).
+
+    TIERING hooks (both optional — absent, behavior is exactly the
+    single-tier PR-12 cache):
+
+    * ``spill_hook(block, tokens, node)`` fires when the LRU evicts a
+      parked device block, with the block id, the node's full token
+      path, and the node itself — the structured eviction metadata any
+      observer needs.  Returning a host-tier handle converts the node
+      to host residency (the prefix stays findable); returning None
+      detaches it exactly as before.  The evicted DEVICE block is
+      returned to the caller for reclaim either way.
+    * ``host_drop_hook(handle)`` fires whenever the cache drops its own
+      reference to a host handle (node detach/orphan paths), so the
+      owner can free the host storage.
     """
 
-    def __init__(self, block_size, pool_cap=-1):
+    def __init__(self, block_size, pool_cap=-1, spill_hook=None,
+                 host_drop_hook=None):
         self.block_size = int(block_size)
         self.pool_cap = int(pool_cap)     # parked blocks retained; < 0 = all
+        self.spill_hook = spill_hook
+        self.host_drop_hook = host_drop_hook
         self._root = _PrefixNode(None, None, None)
-        self._by_block = {}               # block -> node
+        self._by_block = {}               # device block -> node
+        self._by_host = {}                # host handle -> node
         self._parked = OrderedDict()      # block -> node, oldest first
 
     @property
     def cached_blocks(self):
-        """Registered blocks (live + parked)."""
+        """Registered DEVICE blocks (live + parked)."""
         return len(self._by_block)
+
+    @property
+    def host_count(self):
+        """Host-tier handles this index references (host-resident nodes
+        plus retained host copies of device-resident ones) — must equal
+        the tier's own `used` count, or someone leaked."""
+        return len(self._by_host)
 
     @property
     def parked_count(self):
         """Refcount-0 blocks retained for reuse (the LRU pool)."""
         return len(self._parked)
 
+    def _path_tokens(self, node):
+        """The full token path root→``node`` (the exact tokens whose
+        K/V the node's block holds) — the eviction hook's metadata."""
+        keys = []
+        while node is not self._root:
+            keys.append(node.key)
+            node = node.parent
+        out = []
+        for k in reversed(keys):
+            out.extend(k)
+        return out
+
     def _key(self, tokens, i):
         bs = self.block_size
         return tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
 
-    def lookup(self, tokens):
-        """Block ids of the longest cached FULL-block prefix of
-        ``tokens`` (possibly covering all of them), touching the matched
-        path so hot prefixes move to the MRU end of the parked eviction
-        order (recency IS the `_parked` OrderedDict order).  The caller
-        must `acquire` the result before any operation that could evict
-        (a parked match is still parked until acquired)."""
-        out = []
+    def lookup_plan(self, tokens):
+        """The tier-aware match: ``(dev_blocks, host_nodes)`` — the
+        longest cached FULL-block prefix of ``tokens`` split into its
+        leading device-resident run (block ids, acquire-ready) and the
+        host-resident run that follows (nodes, each carrying its host
+        handle in ``.block`` — the engine's restore-then-acquire plan).
+        Host under device is the only legal stacking (spills are
+        bottom-up), so the walk flips exactly once; a device node BELOW
+        a host one would mean the invariant broke — the walk stops
+        there rather than hand out an unreachable plan.  Touches the
+        matched parked path so hot prefixes move to the MRU end of the
+        eviction order (recency IS the `_parked` OrderedDict order).
+        The caller must `acquire` the device run before any operation
+        that could evict (a parked match is still parked until
+        acquired)."""
+        dev, host = [], []
         node = self._root
+        last_dev = self._root
         for i in range(len(tokens) // self.block_size):
             child = node.children.get(self._key(tokens, i))
             if child is None:
                 break
-            out.append(child.block)
+            if child.tier == "host":
+                host.append(child)
+            elif host:
+                break
+            else:
+                dev.append(child.block)
+                last_dev = child
             node = child
-        n = node
+        n = last_dev
         while n is not self._root:
             if n.block in self._parked:
                 self._parked.move_to_end(n.block)
             n = n.parent
-        return out
+        return dev, host
+
+    def lookup(self, tokens):
+        """Device block ids of the longest cached FULL-block prefix of
+        ``tokens`` (the tier-blind view — exactly the PR-12 result;
+        tier-aware callers use `lookup_plan`)."""
+        return self.lookup_plan(tokens)[0]
 
     def insert(self, tokens, blocks, n_full):
         """Register the first ``n_full`` blocks of a sequence (its FULL
         blocks) along the tree path of ``tokens``.  A run already cached
-        under a DIFFERENT physical block keeps the existing copy (the
-        walk continues through it, so deeper runs still register); a
-        run already cached under the SAME block is a no-op.  Returns the
-        number of newly registered blocks."""
+        under a DIFFERENT physical device block keeps the existing copy
+        (the walk continues through it, so deeper runs still register);
+        a run already cached under the SAME block is a no-op.  A run
+        cached only on the HOST tier is UPGRADED: the node repoints at
+        the freshly prefilled device block and retains the host copy as
+        a free re-spill (prefill of the same tokens under the same
+        weights is deterministic, so the two copies are bit-identical).
+        Returns the number of newly registered device blocks."""
         node = self._root
         added = 0
         for i in range(min(int(n_full), len(blocks))):
@@ -331,6 +415,15 @@ class PrefixCache:
                     break
                 child = _PrefixNode(key, b, node)
                 node.children[key] = child
+                self._by_block[b] = child
+                added += 1
+            elif child.tier == "host":
+                b = blocks[i]
+                if b in self._by_block:
+                    break
+                child.host = child.block
+                child.tier = "dev"
+                child.block = b
                 self._by_block[b] = child
                 added += 1
             node = child
@@ -362,15 +455,20 @@ class PrefixCache:
             self._parked.pop(b, None)
 
     def _evict_one(self):
-        """Evict the oldest parked LEAF (a parked node's children are
-        always parked too — a live child would imply a live holder of
-        the whole prefix — so leaves exist whenever the pool is
-        non-empty; preferring them keeps prefix ROOTS, the shareable
-        part, alive longest)."""
+        """Evict the oldest parked DEVICE leaf (a parked node's device
+        children are always parked too — a live child would imply a
+        live holder of the whole prefix — so device leaves exist
+        whenever the pool is non-empty; preferring them keeps prefix
+        ROOTS, the shareable part, alive longest; already-spilled host
+        children hang below without pinning their parent).  With a
+        ``spill_hook``, the node converts to host residency instead of
+        detaching — eviction ORDER over device blocks is identical
+        either way (regression-tested), only the node's afterlife
+        differs."""
         for b, node in self._parked.items():
-            if not node.children:
+            if not any(c.tier == "dev" for c in node.children.values()):
                 del self._parked[b]
-                self._detach(node)
+                self._spill_or_detach(node)
                 return [b]
         # unreachable while the parked-subtree invariant holds; take the
         # oldest anyway (detaching orphans its subtree: unregistered,
@@ -379,17 +477,111 @@ class PrefixCache:
         del self._parked[b]
         evicted = [b]
         self._detach(node)
+        self._drop_host_handle(node.host)
+        node.host = None
         stack = list(node.children.values())
+        node.children = {}
         while stack:
             d = stack.pop()
-            self._by_block.pop(d.block, None)
-            if self._parked.pop(d.block, None) is not None:
-                evicted.append(d.block)
+            if d.tier == "host":
+                self._by_host.pop(d.block, None)
+                self._drop_host_handle(d.block)
+            else:
+                self._by_block.pop(d.block, None)
+                self._drop_host_handle(d.host)
+                if self._parked.pop(d.block, None) is not None:
+                    evicted.append(d.block)
             stack.extend(d.children.values())
+            d.children = {}
         return evicted
 
-    def _detach(self, node):
+    def _spill_or_detach(self, node):
+        """A parked device node lost its block to eviction: convert it
+        to host residency when a host copy exists (retained from an
+        earlier cycle, or minted right now by the spill hook), detach
+        it — dropping any orphaned host descendants — otherwise."""
+        handle = node.host
+        if handle is None and self.spill_hook is not None:
+            handle = self.spill_hook(node.block, self._path_tokens(node),
+                                     node)
+        if handle is None:
+            self._detach(node)
+            stack = list(node.children.values())
+            node.children = {}
+            while stack:  # children of an evictable node are all host
+                d = stack.pop()
+                if d.tier == "host":
+                    self._by_host.pop(d.block, None)
+                    self._drop_host_handle(d.block)
+                else:
+                    self._drop_host_handle(d.host)
+                    self._by_block.pop(d.block, None)
+                stack.extend(d.children.values())
+                d.children = {}
+            return
         self._by_block.pop(node.block, None)
+        node.block = handle
+        node.tier = "host"
+        node.host = None
+        self._by_host[handle] = node
+
+    def _drop_host_handle(self, handle):
+        if handle is not None:
+            self._by_host.pop(handle, None)
+            if self.host_drop_hook is not None:
+                self.host_drop_hook(handle)
+
+    def drop_host(self, handle):
+        """The host TIER evicted ``handle`` (its storage is already
+        gone): detach the index's view of it.  A retained host copy of
+        a device-resident node just loses the shortcut; a host-resident
+        node detaches with its (host) subtree.  Returns the ORPHANED
+        descendant handles for the caller to free from the tier —
+        no ``host_drop_hook`` reentry from this path, the tier
+        initiated it."""
+        node = self._by_host.pop(handle, None)
+        if node is None:
+            return []
+        if node.tier == "dev":
+            node.host = None
+            return []
+        orphans = []
+        self._detach(node)
+        stack = list(node.children.values())
+        node.children = {}
+        while stack:
+            d = stack.pop()
+            if d.tier == "host":
+                self._by_host.pop(d.block, None)
+                orphans.append(d.block)
+            else:  # dev under host: invariant breach — scrub defensively
+                self._by_block.pop(d.block, None)
+                self._parked.pop(d.block, None)
+            stack.extend(d.children.values())
+            d.children = {}
+        return orphans
+
+    def restore_landed(self, node, handle, dev_block):
+        """A restore staged against host ``handle`` finished writing
+        ``dev_block``: flip the node back to device residency, keep the
+        host copy as a free re-spill.  Returns False when the node was
+        upgraded or dropped in the transfer window (the restored block
+        stays the sequence's private property — correct either way, the
+        bytes came from the tier, not the tree)."""
+        if self._by_host.get(handle) is not node or node.tier != "host" \
+                or dev_block in self._by_block:
+            return False
+        node.tier = "dev"
+        node.block = dev_block
+        node.host = handle
+        self._by_block[dev_block] = node
+        return True
+
+    def _detach(self, node):
+        if node.tier == "dev":
+            self._by_block.pop(node.block, None)
+        else:
+            self._by_host.pop(node.block, None)
         if node.parent is not None:
             node.parent.children.pop(node.key, None)
         node.parent = None
@@ -404,7 +596,10 @@ class PrefixCache:
 
     def clear(self):
         """Drop every cached prefix (the pool-rebuild recovery path:
-        the device blocks the tree points at no longer exist)."""
+        the device blocks the tree points at no longer exist).  Host
+        references drop too — the owner clears the tier itself (one
+        `HostBlockTier.clear`, not a hook storm)."""
         self._root = _PrefixNode(None, None, None)
         self._by_block.clear()
+        self._by_host.clear()
         self._parked.clear()

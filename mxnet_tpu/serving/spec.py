@@ -81,6 +81,10 @@ class Drafter:
     # triple — False lets the engine skip staging it (a host drafter
     # costs zero device traffic per round)
     needs_device = False
+    # whether the drafter mirrors the target's paged pool and wants
+    # `on_restore_span` after a host-tier restore — False lets the
+    # engine skip building the span's chunk arrays entirely
+    mirrors_pool = False
 
     def __init__(self):
         self._engine = None
@@ -122,6 +126,17 @@ class Drafter:
 
     def on_cow(self, src_d, dst_d):
         """The target copied block src -> dst (copy-on-write)."""
+
+    def on_restore_span(self, toks_d, start_d, length_d, table_d):
+        """A host-tier restore landed this (block-aligned) span of the
+        target pool without running prefill — the mirrored draft pool
+        has no K/V for it.  Only the TARGET's K/V could be spilled (a
+        draft cache is derived state, never worth a host copy), so a
+        pool-mirroring drafter re-derives its rows by prefilling the
+        restored tokens through its OWN model — accept-rate hygiene
+        exactly like `on_prefill_chunk`, and like all draft state never
+        correctness-critical: the default no-op just costs accept rate
+        on the restored span until decode overwrites past it."""
 
     def on_cache_rebuild(self):
         """The target pool was rebuilt: every cached draft row is void."""
@@ -280,6 +295,7 @@ class ModelDrafter(Drafter):
 
     name = "model"
     needs_device = True
+    mirrors_pool = True
 
     def __init__(self, model=None, params=None):
         super().__init__()
@@ -428,6 +444,13 @@ class ModelDrafter(Drafter):
             self._pool = self._compiled_cow()(self._pool, src_d, dst_d)
         except Exception as exc:  # noqa: BLE001
             self._degrade("cow", exc)
+
+    def on_restore_span(self, toks_d, start_d, length_d, table_d):
+        # the draft pool follows a host-tier restore by PREFILLING the
+        # restored tokens through the draft model (the target restored
+        # bytes; the draft re-derives its own) — same chunk arrays,
+        # same compiled prefill buckets as `on_prefill_chunk`
+        self.on_prefill_chunk(toks_d, start_d, length_d, table_d)
 
     def on_cache_rebuild(self):
         self._init_pool()

@@ -49,6 +49,17 @@ elif [ "$1" = "--serve-spec-smoke" ]; then
     T1=""
     set -- tests/test_serve_spec.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-tier-smoke" ]; then
+    # fast memory-tiering smoke: host-tier spill/restore bit-exactness,
+    # the structured eviction hook, tier-aware lookup plans, session
+    # reattach parity + suffix-only prefill, the MXNET_SERVE_TIER=0
+    # kill-switch, cross-tier leak accounting, and the spill_fail/
+    # restore_slow chaos legs (docs/serving.md "Memory tiering &
+    # sessions")
+    shift
+    T1=""
+    set -- tests/test_serve_tiers.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-durability-smoke" ]; then
     # fast serving-durability smoke: journal exact-replay migration on
     # replica death, rolling-restart drain, anti-thrash preemption

@@ -192,6 +192,57 @@ print("prefix gate passed: ttft p50 %s->%s ms (%sx), concurrency %s->%s, "
                        prefix["max_concurrent"], rec["prefix_hit_rate"]))
 PY
 
+# -- memory-tiering serve gate (docs/serving.md "Memory tiering &
+# sessions") --------------------------------------------------------------
+# evict-and-recompute vs host-tier A/B at EQUAL HBM with a hot-prefix
+# working set >= 4x the device block capacity: the tier leg must hit
+# strictly more prefix tokens and answer strictly faster (ttft p50)
+# with token-for-token parity (a restore is the same bytes), zero
+# leaked blocks in EITHER tier, and zero steady-state recompiles on
+# both legs (the restore program is part of the frozen warmup set);
+# artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python bench.py --serve --tier | tee /tmp/nightly_serve_tier.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_tier.log").read().strip().splitlines()[-1])
+single, tier = rec["single"], rec["tier"]
+for leg, r in (("single", single), ("tier", tier)):
+    assert r["completed"] == r["requests"], \
+        "tier gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["steady_state_recompiles"] == 0, \
+        "tier gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "tier gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+    assert r["blocks"]["leaked"] == 0, \
+        "tier gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+assert rec["working_set_tokens"] >= 4 * rec["device_capacity_tokens"], \
+    "tier gate: working set %s < 4x device capacity %s" % (
+        rec["working_set_tokens"], rec["device_capacity_tokens"])
+assert rec["token_parity"], \
+    "tier gate: outputs diverged between evict and tier legs"
+assert rec["hit_rate"]["tier"] > rec["hit_rate"]["single"], \
+    "tier gate: hit rate %s not above evict-and-recompute %s" % (
+        rec["hit_rate"]["tier"], rec["hit_rate"]["single"])
+assert rec["ttft_p50_ms"]["tier"] < rec["ttft_p50_ms"]["single"], \
+    "tier gate: ttft p50 %s not below evict-and-recompute %s" % (
+        rec["ttft_p50_ms"]["tier"], rec["ttft_p50_ms"]["single"])
+assert rec["host_leaked"] == 0, \
+    "tier gate: %d host-tier blocks leaked" % rec["host_leaked"]
+print("tier gate passed: ttft p50 %s->%s ms (%sx), hit_rate %s->%s, "
+      "spilled %s restored %s" % (
+          rec["ttft_p50_ms"]["single"], rec["ttft_p50_ms"]["tier"],
+          rec["value"], rec["hit_rate"]["single"], rec["hit_rate"]["tier"],
+          rec["spilled"], rec["restored"]))
+PY
+
+# -- memory-tiering smoke: spill/restore/session/chaos unit coverage ------
+./run_tests.sh --serve-tier-smoke
+
 # -- speculative-decoding serve gate (docs/serving.md "Speculative
 # decoding") --------------------------------------------------------------
 # draft-verify vs one-token-per-step A/B at EQUAL HBM on the templated

@@ -1,0 +1,563 @@
+"""Tiered KV memory: host-DRAM block tier, async restore, sessions
+(ISSUE-13).
+
+Contracts under test:
+
+1. `HostBlockTier`: bounded LRU over spilled blocks — put/get/touch/
+   free, capacity eviction returns the forgotten handles, free is
+   idempotent.
+2. Structured eviction hook: `PrefixCache._evict_one` hands the hook
+   (block id, full token path, node); a pure-observer hook leaves the
+   eviction ORDER bit-identical to the hookless cache.
+3. Spill/restore K/V bit-exactness: an evicted-then-restored block's
+   pool bytes equal the never-evicted original, and a request served
+   through a restore emits the oracle's tokens.
+4. Tier-aware admission: a host hit restores (PCIe path) instead of
+   re-prefilling — `serve.restored` advances, `prefill_tokens` does
+   not; `MXNET_SERVE_RESTORE_AHEAD` caps concurrent restores without
+   blocking the miss path.
+5. Cross-tier leak accounting: `leaked_blocks()` == 0 AND
+   `leaked_host_blocks()` == 0 after preempt/eviction storms, chaos
+   included.
+6. Sessions: `submit(session=…)` reattaches a finished turn's blocks —
+   the follow-up prefills only the new suffix (counter-asserted) and
+   matches a full-history resubmission token for token, including when
+   the history had to come back from the host tier; a follow-up racing
+   an unresolved turn raises.
+7. Kill-switch: `MXNET_SERVE_TIER=0` spills nothing and emits the
+   PR-12 tokens bit for bit.
+8. Zero-steady-state compiles with tiering on: the restore program is
+   part of the frozen warmup set.
+9. Chaos: `spill_fail:P` degrades to evict-and-destroy (typed, no
+   leak), `restore_slow:P:MS` only delays, a mid-restore launch
+   failure degrades to the chunk-prefill replay path, and the clauses
+   compose with `engine_crash` + `block_exhaust` with zero hangs.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (HostBlockTier, PrefixCache, ServingEngine,
+                               ReplicaRouter, TransformerKVModel)
+
+V, S, L, H, E = 61, 32, 2, 2, 32
+BS = 4          # block size used by every engine below
+POOL = 9        # 8 usable blocks = 32 cache tokens: eviction is easy
+
+
+@pytest.fixture
+def model_and_params():
+    model = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E)
+    return model, model.init_params(np.random.RandomState(7))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    telemetry.reset()
+    chaos.reset()
+    monkeypatch.delenv("MXNET_CHAOS", raising=False)
+    yield
+    telemetry.reset()
+    chaos.reset()
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("max_new_tokens", 4)
+    kw.setdefault("sampling", False)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("n_blocks", POOL)
+    kw.setdefault("tier", True)
+    kw.setdefault("host_blocks", 32)
+    eng = ServingEngine(model, params, **kw)
+    eng.warmup()
+    return eng
+
+
+def _run(eng, prompt, max_new=4, **kw):
+    req = eng.submit(prompt, max_new_tokens=max_new, **kw)
+    eng.run_until_idle(timeout=300)
+    return req.result(1)
+
+
+def _force_spill(eng):
+    """Evict every parked block (the allocation-pressure path): with
+    the tier on they spill instead of dying."""
+    evicted = eng._prefix.evict(eng._alloc.capacity)
+    eng._alloc.reclaim(evicted)
+    return evicted
+
+
+# ---------------------------------------------------------------------------
+# 1. HostBlockTier unit behavior
+# ---------------------------------------------------------------------------
+
+def test_host_tier_lru():
+    t = HostBlockTier(2)
+    a = np.ones((1, 2, 4, 8), np.float32)
+    h1, ev = t.put(a * 1)
+    assert ev == [] and t.used == 1
+    h2, ev = t.put(a * 2)
+    assert ev == []
+    t.touch(h1)                      # h1 becomes MRU
+    h3, ev = t.put(a * 3)
+    assert ev == [h2]                # the LRU (h2) was forgotten
+    assert t.get(h2) is None
+    assert np.array_equal(t.get(h1), a * 1)
+    t.free(h3)
+    t.free(h3)                       # idempotent
+    assert t.used == 1
+    t.clear()
+    assert t.used == 0 and t.bytes == 0
+    with pytest.raises(MXNetError):
+        HostBlockTier(0)
+
+
+# ---------------------------------------------------------------------------
+# 2. structured eviction hook + ordering regression
+# ---------------------------------------------------------------------------
+
+def test_evict_hook_metadata_and_ordering_regression():
+    seen = []
+
+    def hook(block, tokens, node):
+        seen.append((block, tuple(tokens), node))
+        return None                  # pure observer: no spill
+
+    plain = PrefixCache(2)
+    hooked = PrefixCache(2, spill_hook=hook)
+    for pc in (plain, hooked):
+        pc.insert([1, 2, 3, 4, 5, 6], [10, 11, 12], 3)
+        pc.insert([1, 2, 9, 9], [10, 20], 2)
+        for b in (12, 11, 20, 10):
+            pc.park(b)
+        pc.lookup([1, 2, 3, 4])      # touch: 10, 11 move to MRU
+    order_plain = [plain.evict(1)[0] for _ in range(4)]
+    order_hooked = [hooked.evict(1)[0] for _ in range(4)]
+    assert order_plain == order_hooked
+    # the hook saw every evicted block with its exact token path
+    assert [b for b, _, _ in seen] == order_hooked
+    paths = {b: t for b, t, _ in seen}
+    assert paths[12] == (1, 2, 3, 4, 5, 6)
+    assert paths[20] == (1, 2, 9, 9)
+    assert paths[10] == (1, 2)
+    for b, tokens, node in seen:
+        assert node.key == tuple(tokens[-2:])
+
+
+def test_spilled_node_stays_findable():
+    """A spilling hook converts the node to host residency: the prefix
+    remains in the tree and `lookup_plan` returns it as the host run."""
+    pc = PrefixCache(2, spill_hook=lambda b, t, n: 100 + b)
+    pc.insert([1, 2, 3, 4], [10, 11], 2)
+    pc.park(11)
+    pc.park(10)
+    assert pc.evict(1) == [11]       # leaf first
+    dev, host = pc.lookup_plan([1, 2, 3, 4])
+    assert dev == [10] and [n.block for n in host] == [111]
+    assert pc.host_count == 1
+    assert pc.evict(1) == [10]
+    dev, host = pc.lookup_plan([1, 2, 3, 4])
+    assert dev == [] and [n.block for n in host] == [110, 111]
+
+
+# ---------------------------------------------------------------------------
+# 3/4. spill/restore bit-exactness + restore-not-prefill accounting
+# ---------------------------------------------------------------------------
+
+def test_spill_restore_bit_exact_vs_never_evicted(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    rng = np.random.RandomState(0)
+    prompt = list(rng.randint(0, V, size=12))      # 3 full blocks
+    out1 = _run(eng, prompt)
+    # snapshot the registered blocks' K/V before eviction
+    dev, host = eng._prefix.lookup_plan(prompt)
+    before = {b: np.asarray(eng._cache[:, :, b]) for b in dev}
+    assert len(before) == 3
+    _force_spill(eng)
+    assert eng.stats["spilled"] == 3 and eng._tier.used == 3
+    prefilled = eng.stats["prefill_tokens"]
+    out2 = _run(eng, prompt)
+    assert out2 == out1                            # token parity
+    assert eng.stats["restored"] == 3
+    assert eng.stats["prefill_tokens"] == prefilled  # restored, not redone
+    # the restored pool bytes are the ORIGINAL bytes, bit for bit
+    dev2, host2 = eng._prefix.lookup_plan(prompt)
+    assert len(dev2) == 3 and not host2
+    originals = list(before.values())  # path order, like dev2
+    for i, b in enumerate(dev2):
+        assert np.array_equal(np.asarray(eng._cache[:, :, b]), originals[i])
+    # never-evicted oracle emits the same stream
+    big = _engine(model, params, n_blocks=33, tier=False)
+    assert _run(big, prompt) == out1
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_restore_ahead_caps_without_blocking_misses(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params, restore_ahead=0)  # restores never staged
+    rng = np.random.RandomState(1)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    spilled = eng.stats["spilled"]
+    assert spilled >= 3
+    out2 = _run(eng, prompt)                       # miss path: re-prefill
+    assert out2 == out1
+    assert eng.stats["restored"] == 0
+    assert eng.stats["prefill_tokens"] > 12        # paid the recompute
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_restore_after_host_lru_forgot(model_and_params):
+    """The bottom tier really forgets: with a tiny host pool, spilled
+    blocks past capacity are gone and the next hit recomputes — typed,
+    leak-free, parity intact."""
+    model, params = model_and_params
+    eng = _engine(model, params, host_blocks=1)
+    rng = np.random.RandomState(2)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    assert eng._tier.used == 1                     # capacity bound held
+    assert eng._prefix.host_count == 1
+    out2 = _run(eng, prompt)
+    assert out2 == out1
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. cross-tier leak accounting under storms
+# ---------------------------------------------------------------------------
+
+def test_eviction_preemption_storm_zero_leaks(model_and_params,
+                                              monkeypatch):
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_CHAOS",
+                       "block_exhaust:0.2,prefix_evict:0.3")
+    chaos.reset()
+    eng = _engine(model, params, max_batch=3, host_blocks=16)
+    rng = np.random.RandomState(3)
+    shared = list(rng.randint(0, V, size=8))
+    reqs = [eng.submit(shared + list(rng.randint(0, V, size=4)),
+                       max_new_tokens=3) for _ in range(8)]
+    eng.run_until_idle(timeout=300)
+    for r in reqs:
+        assert r.result(1) is not None             # all resolve typed
+    assert eng.leaked_blocks() == 0
+    assert eng.leaked_host_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 6. sessions
+# ---------------------------------------------------------------------------
+
+def test_session_reattach_parity_and_suffix_only_prefill(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params, n_blocks=17, max_new_tokens=8)
+    rng = np.random.RandomState(4)
+    turn1 = list(rng.randint(0, V, size=8))
+    turn2 = list(rng.randint(0, V, size=4))
+    out1 = _run(eng, turn1, max_new=4, session="chat")
+    hist = turn1 + out1
+    prefilled = eng.stats["prefill_tokens"]
+    matched0 = eng.stats["prefix_tokens"]
+    out2 = _run(eng, turn2, max_new=4, session="chat")
+    assert eng.stats["session_hits"] == 1
+    # counter-asserted suffix-only prefill: the follow-up prefills only
+    # what the prefix cache could not cover — at most the new turn plus
+    # the history's partial tail block
+    suffix = eng.stats["prefill_tokens"] - prefilled
+    matched = eng.stats["prefix_tokens"] - matched0
+    assert matched >= (len(hist) // BS) * BS - BS
+    assert suffix <= len(turn2) + 2 * BS - 1
+    assert suffix + matched >= len(hist) + len(turn2) - 1
+    # parity vs resubmitting the full history on a fresh engine
+    eng2 = _engine(model, params, n_blocks=17, max_new_tokens=8)
+    assert _run(eng2, turn1, max_new=4) == out1
+    assert _run(eng2, hist + turn2, max_new=4) == out2
+
+
+def test_session_reattach_through_host_tier(model_and_params):
+    """The session's blocks were evicted to host between turns: the
+    follow-up restores them instead of replaying the history."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    rng = np.random.RandomState(5)
+    turn1 = list(rng.randint(0, V, size=8))
+    out1 = _run(eng, turn1, max_new=4, session="s")
+    _force_spill(eng)
+    assert eng.stats["spilled"] >= 2
+    turn2 = list(rng.randint(0, V, size=4))
+    out2 = _run(eng, turn2, max_new=4, session="s")
+    assert eng.stats["restored"] >= 2
+    eng2 = _engine(model, params, n_blocks=33)
+    assert _run(eng2, turn1 + out1 + turn2, max_new=4) == out2
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_session_shed_does_not_brick_session(model_and_params):
+    """A submit(session=...) that sheds at admission must leave the
+    session untouched: the rejected request never becomes the live
+    turn, so the conversation is retryable instead of permanently
+    hitting the unresolved-turn guard."""
+    from mxnet_tpu.serving import ServeOverload
+    model, params = model_and_params
+    eng = _engine(model, params, n_blocks=33, queue_max=1,
+                  overload="shed")
+    filler = eng.submit([1, 2, 3], max_new_tokens=2)  # queue now full
+    with pytest.raises(ServeOverload):
+        eng.submit([4, 5], max_new_tokens=2, session="k")
+    eng.run_until_idle(timeout=300)
+    filler.result(1)
+    # the shed attempt left no unresolvable live turn behind
+    assert _run(eng, [4, 5], max_new=2, session="k") is not None
+    assert _run(eng, [6], max_new=2, session="k") is not None
+    assert eng.stats["session_hits"] == 1
+
+
+def test_session_claim_blocks_racing_submit(model_and_params):
+    """Passing the liveness guard CLAIMS the turn atomically: a second
+    submit racing the first (guard passed, admission not yet landed)
+    raises typed instead of both running against the same history;
+    unclaim (the shed path) makes the turn retryable."""
+    model, params = model_and_params
+    eng = _engine(model, params, n_blocks=33)
+    assert _run(eng, [1, 2, 3], max_new=2, session="r") is not None
+    eng._session_prompt("r", [4])                 # turn 2 claimed
+    with pytest.raises(MXNetError, match="unresolved turn"):
+        eng._session_prompt("r", [5])             # the racer loses
+    eng._session_unclaim("r")
+    assert _run(eng, [4], max_new=2, session="r") is not None
+    assert eng.stats["session_hits"] == 1         # counted at landing
+
+
+def test_session_live_turn_guard(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    req = eng.submit([1, 2, 3], max_new_tokens=4, session="live")
+    try:
+        with pytest.raises(MXNetError, match="unresolved turn"):
+            eng.submit([4, 5], max_new_tokens=2, session="live")
+    finally:
+        eng.run_until_idle(timeout=300)
+        req.result(1)
+    # resolved: the next turn is welcome
+    assert _run(eng, [4, 5], max_new=2, session="live") is not None
+
+
+def test_router_session_affinity(model_and_params):
+    model, params = model_and_params
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 CPU devices")
+    engines = [ServingEngine(model, params, ctx=d, name="replica%d" % i,
+                             max_batch=2, prefill_buckets=[8, 16],
+                             sampling=False, block_size=BS, n_blocks=17,
+                             tier=True, host_blocks=16)
+               for i, d in enumerate(jax.devices()[:2])]
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()
+    r1 = router.submit([1, 2, 3, 4, 5], max_new_tokens=3, session="aff")
+    router.run_until_idle(timeout=300)
+    out1 = r1.result(1)
+    owner = [e for e in router.engines if e.has_session("aff")]
+    assert len(owner) == 1
+    # pile depth onto the owner: affinity must still win over least-depth
+    r2 = router.submit([6, 7], max_new_tokens=3, session="aff")
+    router.run_until_idle(timeout=300)
+    r2.result(1)
+    assert owner[0].stats["session_hits"] == 1
+    router.stop()
+    assert out1 is not None
+
+
+# ---------------------------------------------------------------------------
+# 7. kill-switch parity
+# ---------------------------------------------------------------------------
+
+def test_tier_kill_switch_parity(model_and_params):
+    model, params = model_and_params
+    rng = np.random.RandomState(6)
+    prompts = [list(rng.randint(0, V, size=n)) for n in (12, 8, 12)]
+    outs = {}
+    for mode in (False, True):
+        eng = _engine(model, params, tier=mode)
+        got = []
+        for p in prompts:
+            got.append(_run(eng, p))
+            _force_spill(eng)                      # eviction between each
+        outs[mode] = got
+        if not mode:
+            assert eng._tier is None
+            assert eng.stats["spilled"] == 0 == eng.stats["restored"]
+        else:
+            assert eng.stats["spilled"] > 0
+        assert eng.leaked_blocks() == 0
+    assert outs[False] == outs[True]               # bit-for-bit tokens
+
+
+def test_tier_requires_prefix(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params, prefix=False, tier=True)
+    assert eng._tier is None                       # nothing to spill
+
+
+# ---------------------------------------------------------------------------
+# 8. zero steady-state compiles with tiering on
+# ---------------------------------------------------------------------------
+
+def test_zero_steady_state_compiles_with_tier(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    reg = telemetry.registry()
+    compiled = reg.counter("serve.aot.compiles").value
+    rng = np.random.RandomState(8)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    out2 = _run(eng, prompt)                       # restore path exercised
+    assert out2 == out1 and eng.stats["restored"] >= 3
+    assert reg.counter("serve.aot.compiles").value == compiled
+    assert reg.counter("serve.aot.frozen_compiles").value == 0
+    retraces = [e for e in telemetry.events("retrace")
+                if str(e.get("site", "")).startswith("serving.")]
+    assert retraces == []
+
+
+# ---------------------------------------------------------------------------
+# 9. chaos
+# ---------------------------------------------------------------------------
+
+def test_chaos_spill_fail_degrades_to_destroy(model_and_params,
+                                              monkeypatch):
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_CHAOS", "spill_fail:1.0")
+    chaos.reset()
+    eng = _engine(model, params)
+    rng = np.random.RandomState(9)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    assert eng.stats["spilled"] == 0               # every spill denied
+    assert eng.stats["spill_fails"] >= 3
+    assert eng._tier.used == 0
+    out2 = _run(eng, prompt)                       # PR-12 recompute path
+    assert out2 == out1 and eng.stats["restored"] == 0
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_chaos_restore_slow_only_delays(model_and_params, monkeypatch):
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_CHAOS", "restore_slow:1.0:5")
+    chaos.reset()
+    eng = _engine(model, params)
+    rng = np.random.RandomState(10)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    out2 = _run(eng, prompt)
+    assert out2 == out1 and eng.stats["restored"] >= 3
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_mid_restore_failure_degrades_to_replay(model_and_params,
+                                                monkeypatch):
+    """A restore whose pool write fails scoped must fall back to the
+    chunk-prefill replay path: request completes with parity, the
+    failing host entries drop, nothing leaks in either tier."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    rng = np.random.RandomState(11)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    real = eng._compiled_restore
+
+    calls = {"n": 0}
+
+    def boom(kb):
+        calls["n"] += 1
+        raise RuntimeError("injected scoped restore failure")
+
+    monkeypatch.setattr(eng, "_compiled_restore", boom)
+    req = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle(timeout=300)
+    monkeypatch.setattr(eng, "_compiled_restore", real)
+    assert req.result(1) == out1                   # replay path, parity
+    assert calls["n"] == 1
+    assert eng.stats["restore_fails"] == 1
+    assert eng.stats["restored"] == 0
+    # the failed restore never counted a prefix hit (hit accounting is
+    # deferred to the landing) — hit_rate cannot inflate under restore
+    # pressure
+    assert eng.stats["prefix_tokens"] == 0
+    assert eng._prefix.host_count == eng._tier.used
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_model_drafter_follows_restores(model_and_params):
+    """Speculation + tiering: a restore bypasses prefill, so the
+    ModelDrafter's mirrored pool re-derives the restored span via
+    `on_restore_span` — output parity holds either way (draft state is
+    never correctness-critical), and the accept counters prove the
+    draft path still ran after a restore."""
+    model, params = model_and_params
+    eng = _engine(model, params, spec=True, spec_k=2,
+                  spec_drafter="model")
+    rng = np.random.RandomState(13)
+    prompt = list(rng.randint(0, V, size=12))
+    out1 = _run(eng, prompt)
+    _force_spill(eng)
+    assert eng.stats["spilled"] >= 3
+    out2 = _run(eng, prompt)
+    assert out2 == out1 and eng.stats["restored"] >= 3
+    assert eng.stats["spec_proposed"] > 0
+    assert eng.leaked_blocks() == 0 and eng.leaked_host_blocks() == 0
+
+
+def test_chaos_composition_with_crash_and_exhaust(model_and_params,
+                                                  monkeypatch):
+    """spill_fail + restore_slow composed with engine_crash +
+    block_exhaust (the ISSUE-13 composition leg): every request
+    resolves typed, zero hangs, zero leaks on live engines."""
+    import jax
+    model, params = model_and_params
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 CPU devices")
+    monkeypatch.setenv("MXNET_CHAOS",
+                       "engine_crash:4:replica0,block_exhaust:0.1,"
+                       "spill_fail:0.3,restore_slow:0.3:5,"
+                       "prefix_evict:0.3")
+    chaos.reset()
+    engines = [ServingEngine(model, params, ctx=d, name="replica%d" % i,
+                             max_batch=2, prefill_buckets=[8, 16],
+                             sampling=False, block_size=BS, n_blocks=POOL,
+                             tier=True, host_blocks=8)
+               for i, d in enumerate(jax.devices()[:2])]
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()
+    router.start()
+    rng = np.random.RandomState(12)
+    shared = list(rng.randint(0, V, size=8))
+    reqs = [router.submit(shared + list(rng.randint(0, V, size=4)),
+                          max_new_tokens=3, deadline_ms=30000)
+            for _ in range(10)]
+    hung = 0
+    for r in reqs:
+        try:
+            r.result(timeout=120)
+        except MXNetError:
+            if not r.done:
+                hung += 1
+    router.stop()
+    assert hung == 0
+    for e in router.engines:
+        if e._dead is None:
+            assert e.leaked_blocks() == 0
+            assert e.leaked_host_blocks() == 0

@@ -81,6 +81,16 @@ SERVE_DURABILITY_EVENT_KINDS = (
     "serve_migrate", "serve_drain", "serve_drain_begin",
     "serve_thrash_trip")
 
+# memory tiering accounting (docs/serving.md "Memory tiering &
+# sessions"): host-tier spill/restore traffic, the per-replica
+# host-pool occupancy gauge, the restore-wait histogram, and session
+# continuity hits
+SERVE_TIER_COUNTERS = (
+    "serve.spilled", "serve.restored", "serve.spill_fails",
+    "serve.restore_fails", "serve.session_hits")
+SERVE_TIER_GAUGE_SUFFIX = ".host_blocks_used"
+SERVE_TIER_EVENT_KINDS = ("serve_spill_failed", "serve_restore_failed")
+
 
 def load(path):
     records = []
@@ -264,6 +274,23 @@ def summarize(records):
             durability["%s_events" % kind] = n
     if durability:
         out["durability"] = durability
+    tiering = {k: int(final.get(k, 0)) for k in SERVE_TIER_COUNTERS
+               if final.get(k)}
+    for r in records:
+        for k, v in r.get("gauges", {}).items():
+            if k.startswith("serve.") and \
+                    k.endswith(SERVE_TIER_GAUGE_SUFFIX):
+                tiering[k] = v  # last-seen per replica
+    for kind in SERVE_TIER_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            tiering["%s_events" % kind] = n
+    wait = _merge_hists(records, "serve.restore_wait_ms")
+    if wait:
+        tiering["serve.restore_wait_ms"] = wait
+    if tiering:
+        out["tiering"] = tiering
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -325,6 +352,17 @@ def format_summary(summary):
         lines.append("  durability:")
         for key in sorted(durability):
             lines.append("    %-24s %d" % (key, durability[key]))
+    tiering = summary.get("tiering")
+    if tiering:
+        lines.append("  tiering:")
+        for key in sorted(tiering):
+            v = tiering[key]
+            if isinstance(v, dict):
+                lines.append("    %-24s n=%d mean=%.1f p99<=%.1f max=%.1f"
+                             % (key, v["count"], v["mean"], v["p99_max"],
+                                v["max"]))
+            else:
+                lines.append("    %-24s %s" % (key, v))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "
