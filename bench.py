@@ -620,7 +620,7 @@ def serve_bench(record=True, with_chaos=False):
             "MXNET_CHAOS",
             "engine_crash:%d:replica0,decode_slow:0.05:20,"
             "launch_error:0.02,block_exhaust:0.05,prefix_evict:0.05,"
-            "draft_junk:0.1" % max(4, n_requests // 6))
+            "draft_junk:0.1,scale_corrupt:0.05" % max(4, n_requests // 6))
         os.environ.setdefault("SERVE_REPLICAS", "2")
         os.environ.setdefault("SERVE_DEADLINE_MS", "10000")
         chaos_mod.reset()
@@ -1345,6 +1345,132 @@ def serve_spec_bench(record=True):
     return result
 
 
+def serve_quant_bench(record=True):
+    """Quantized-serving A/B at EQUAL HBM under the mixed-length trace
+    (``python bench.py --serve --quant``).
+
+    Both legs run the paged+prefix engine over the same request set with
+    the K/V pool pinned to ONE memory budget: the `bf16` leg (full
+    precision — ``MXNET_SERVE_QUANT=0``, bit-for-bit PR 13) gets a
+    deliberately tight block pool so admitted concurrency is
+    block-capped; the `quant` leg re-cuts exactly that budget into
+    int8 blocks with per-row scales (``E*1 + 4`` bytes per cached token
+    row vs ``E*4``), which is ~3.9x the blocks at E=128 — plus int8/fp8
+    weights via the same ``MXNET_SERVE_QUANT`` switch.  The acceptance
+    contract (ISSUE 14, gated nightly): >= 1.8x admitted concurrency OR
+    >= 1.3x tok/s/chip at equal HBM, the logit-error/token-match parity
+    gate passing (`mxnet_tpu.quant.parity_report` against the bf16
+    oracle on this bench's own request distribution,
+    ``MXNET_SERVE_QUANT_TOL_REL`` / ``MXNET_SERVE_QUANT_MATCH``), zero
+    leaked blocks, and zero steady-state recompiles on both legs
+    (quantized programs join the frozen warmup bucket set).
+    """
+    from mxnet_tpu import quant as quant_mod
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import TransformerKVModel
+
+    fmt = os.environ.get("SERVE_QUANT_FMT", "int8")
+    # the row ceiling is shared by both legs and sized ABOVE what either
+    # pool can hold, so admitted concurrency is block-capped on both
+    # sides — the A/B then measures exactly the memory multiplier
+    batch = int(os.environ.get("SERVE_QUANT_BATCH", "24"))
+    bs = int(os.environ.get("MXNET_SERVE_BLOCK_SIZE", "16"))
+    seq = int(os.environ.get("SERVE_SEQ", "128"))
+    vocab = int(os.environ.get("SERVE_VOCAB", "512"))
+    layers = int(os.environ.get("SERVE_LAYERS", "2"))
+    heads = int(os.environ.get("SERVE_HEADS", "4"))
+    embed = int(os.environ.get("SERVE_EMBED", "128"))
+    prompt_max = int(os.environ.get("SERVE_PROMPT_MAX", "24"))
+    max_new = int(os.environ.get("SERVE_NEW", "16"))
+    # bf16 leg: ~2 concurrent worst-case rows — the alloc_denied regime
+    # paging already measured; quant leg: the SAME bytes re-cut into
+    # int8+scale blocks (E*4 bytes/row -> E+4), weights also quantized
+    blocks_per_req = -(-(prompt_max + max_new) // bs)
+    base_usable = (int(os.environ.get("MXNET_SERVE_N_BLOCKS", "0")) - 1) \
+        if os.environ.get("MXNET_SERVE_N_BLOCKS") else 2 * blocks_per_req
+    bytes_ratio = (embed * 4.0) / (embed + 4.0)
+    quant_usable = int(base_usable * bytes_ratio)
+    runs = {}
+    shared = {"SERVE_TRACE": "mixed", "SERVE_RATE": "0",
+              "MXNET_SERVE_MAX_BATCH": str(batch),
+              "MXNET_SERVE_BLOCK_SIZE": str(bs)}
+    # KV_QUANT is pinned per leg (not left to the ride-along default):
+    # an inherited env value would silently break the equal-HBM premise
+    # (weight-only quant leg at 3.9x the bytes) or un-bf16 the oracle
+    for mode, env in (
+            ("bf16", {"MXNET_SERVE_QUANT": "0",
+                      "MXNET_SERVE_KV_QUANT": "0",
+                      "MXNET_SERVE_N_BLOCKS": str(1 + base_usable)}),
+            ("quant", {"MXNET_SERVE_QUANT": fmt,
+                       "MXNET_SERVE_KV_QUANT": "int8",
+                       "MXNET_SERVE_N_BLOCKS": str(1 + quant_usable)})):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    base, quant = runs["bf16"], runs["quant"]
+    # the output-parity gate: same geometry/weights/request distribution
+    # as the legs above, measured through the pure paged-path programs
+    # (logit error of the first decision + greedy leading-match rate)
+    rng = np.random.RandomState(int(os.environ.get("SERVE_SEED", "0")))
+    model = TransformerKVModel(vocab, seq, num_layers=layers,
+                               num_heads=heads, num_embed=embed)
+    params = model.init_params(rng)
+    qmodel = model.with_quant(fmt, "int8")
+    qparams = qmodel.quantize_params(params)
+    n_par = int(os.environ.get("SERVE_QUANT_PARITY_PROMPTS", "8"))
+    prompts = [list(rng.randint(0, vocab,
+                                size=int(rng.randint(1, prompt_max + 1))))
+               for _ in range(n_par)]
+    par = quant_mod.parity_report(model, params, qmodel, qparams, prompts,
+                                  max_new=min(8, max_new), block_size=bs)
+    par.pop("streams", None)
+    tol_rel = float(os.environ.get("MXNET_SERVE_QUANT_TOL_REL", "0.05"))
+    match_floor = float(os.environ.get("MXNET_SERVE_QUANT_MATCH", "0.75"))
+    conc_gain = round(quant["max_concurrent"] /
+                      max(base["max_concurrent"], 1), 3)
+    result = {
+        "metric": "serve_quant_vs_bf16",
+        # the acceptance ratio: admitted concurrency at equal HBM
+        "value": conc_gain,
+        "unit": "quant/bf16 admitted-concurrency ratio (equal HBM: %d "
+                "f32 blocks == %d int8+scale blocks x %d, weights %s)"
+                % (1 + base_usable, 1 + quant_usable, bs, fmt),
+        "format": {"weights": fmt, "kv": "int8"},
+        "bf16": base,
+        "quant": quant,
+        "equal_hbm_bytes": (1 + base_usable) * bs * layers * 2 * embed * 4,
+        "concurrency_gain": conc_gain,
+        "tok_s_gain": round(quant["value"] / max(base["value"], 1e-9), 3),
+        "ttft_p50_ms": {"bf16": base["ttft_ms"]["p50"],
+                        "quant": quant["ttft_ms"]["p50"]},
+        "alloc_denied": {
+            "bf16": (base["blocks"] or {}).get("alloc_denied"),
+            "quant": (quant["blocks"] or {}).get("alloc_denied")},
+        "parity": par,
+        "parity_gate": {
+            "tol_rel": tol_rel, "match_floor": match_floor,
+            "passed": bool(par["logit_err_rel"] <= tol_rel
+                           and par["token_match_rate"] >= match_floor)},
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def serve_durability_bench(record=True):
     """Durability gate (``python bench.py --serve --durability``): the
     ISSUE-12 kill-one-of-two-replicas exact-replay acceptance.
@@ -1539,6 +1665,8 @@ if __name__ == "__main__":
             serve_spec_bench()
         elif "--tier" in sys.argv:
             serve_tier_bench()
+        elif "--quant" in sys.argv:
+            serve_quant_bench()
         elif "--durability" in sys.argv:
             serve_durability_bench()
         else:

@@ -79,6 +79,15 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           lands (PCIe congestion pressure: deadlines
                           may expire mid-restore, which must resolve
                           typed through the ordinary sweep)
+    scale_corrupt:P       with probability P a serving scheduler step
+                          overwrites one held block's per-row KV
+                          quantization scales with NaN (scale-memory
+                          corruption: bit rot, a torn spill).  The
+                          in-graph logit gate must convert every read
+                          of the block into a typed requeue/quarantine
+                          (`ServeQuantError`) — never a silently wrong
+                          token.  No-op unless the engine runs
+                          quantized KV blocks (MXNET_SERVE_KV_QUANT)
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
@@ -108,6 +117,7 @@ __all__ = [
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
     "serve_queue_flood", "serve_block_exhaust", "serve_prefix_evict",
     "serve_draft_junk", "serve_spill_fail", "serve_restore_slow",
+    "serve_scale_corrupt",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -146,6 +156,7 @@ class _Spec:
         self.draft_junk = 0.0             # probability per spec round
         self.spill_fail = 0.0             # probability per spill attempt
         self.restore_slow = (0.0, 0.0)    # (probability, milliseconds)
+        self.scale_corrupt = 0.0          # probability per scheduler step
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -186,6 +197,8 @@ class _Spec:
                 self.restore_slow = (float(parts[1]),
                                      float(parts[2]) if len(parts) > 2
                                      else 20.0)
+            elif kind == "scale_corrupt":
+                self.scale_corrupt = float(parts[1])
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -434,6 +447,24 @@ def serve_restore_slow():
     with s.lock:
         if s.rng_for("restore_slow").random_sample() < p:
             return ms
+    return None
+
+
+def serve_scale_corrupt():
+    """Uniform draw u in [0, 1) when the CURRENT serving scheduler step
+    should corrupt one held block's KV quantization scales
+    (`scale_corrupt:P`), else None.  The engine maps u onto its sorted
+    held-block list (the victim choice stays deterministic without this
+    module knowing pool state) and NaNs that block's per-row scales —
+    the gate-tripping probe behind the "never silent wrong tokens"
+    contract of docs/serving.md "Quantization"."""
+    s = spec()
+    if s is None or s.scale_corrupt <= 0:
+        return None
+    with s.lock:
+        rng = s.rng_for("scale_corrupt")
+        if rng.random_sample() < s.scale_corrupt:
+            return float(rng.random_sample())
     return None
 
 

@@ -153,16 +153,23 @@ def decode_attention(q, k_cache, v_cache, pos, num_heads, *, scale=None):
         scale = 1.0 / float(hd) ** 0.5
     qh = q.reshape(b, num_heads, hd)
     kh = k_cache.reshape(b, s, num_heads, hd)
-    vh = v_cache.reshape(b, s, num_heads, hd)
+    valid = (jnp.arange(s, dtype=jnp.int32)[None, :]
+             <= pos.astype(jnp.int32)[:, None])  # (b, s)
+    # never-attended rows (j > pos) hold stale garbage — zero their V
+    # explicitly so a softmax-0 weight multiplies an exact 0, not
+    # whatever a freed block left behind (0 * NaN = NaN would otherwise
+    # let a stale quantization scale poison a fresh sequence; for
+    # finite garbage this is bit-identical to the unguarded product)
+    vh = jnp.where(valid[:, :, None, None],
+                   v_cache.reshape(b, s, num_heads, hd).astype(jnp.float32),
+                   0.0)
     # scores (b, h, s) in f32: one row of the attention matrix per head
     scores = jnp.einsum(
         "bhd,bshd->bhs", qh.astype(jnp.float32), kh.astype(jnp.float32),
         preferred_element_type=jnp.float32) * scale
-    valid = (jnp.arange(s, dtype=jnp.int32)[None, :]
-             <= pos.astype(jnp.int32)[:, None])  # (b, s)
     scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhs,bshd->bhd", p, vh.astype(jnp.float32),
+    out = jnp.einsum("bhs,bshd->bhd", p, vh,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, e).astype(q.dtype)
 
@@ -191,6 +198,25 @@ def gather_paged_kv(pool, block_tables):
     b, m = block_tables.shape
     _, bs, e = pool.shape
     return pool[block_tables.astype(jnp.int32)].reshape(b, m * bs, e)
+
+
+def gather_paged_scales(scales, block_tables):
+    """Materialize per-row dequantization scales from a paged scale pool
+    (the int8-KV companion of `gather_paged_kv`).
+
+    scales:       (n_blocks, block_size) f32 — ONE layer's K (or V)
+                  per-row quantization scales, indexed exactly like the
+                  int8 block pool (scales travel WITH their block
+                  through sharing, CoW, spill and restore).
+    block_tables: (b, m) int32 — the same tables the K/V gather uses.
+    Returns (b, m*block_size): multiply onto the gathered int8 rows
+    (``kc.astype(f32) * sc[..., None]``) to dequantize in-graph before
+    the attention math — position masking then hides the same tail
+    entries it always did, so trash-block scale garbage is never read.
+    """
+    b, m = block_tables.shape
+    bs = scales.shape[1]
+    return scales[block_tables.astype(jnp.int32)].reshape(b, m * bs)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, pos, num_heads,
@@ -234,18 +260,28 @@ def chunk_attention(q, k_cache, v_cache, start, num_heads, *, scale=None):
         scale = 1.0 / float(hd) ** 0.5
     qh = q.reshape(b, c, num_heads, hd)
     kh = k_cache.reshape(b, s, num_heads, hd)
-    vh = v_cache.reshape(b, s, num_heads, hd)
+    start = start.astype(jnp.int32)
+    # rows past the chunk's own last position (j >= start+c) are stale
+    # garbage no query attends: zero their V explicitly so a softmax-0
+    # weight multiplies an exact 0 (0 * NaN from a freed block's stale
+    # quantization scale would otherwise poison the output; for finite
+    # garbage this is bit-identical to the unguarded product)
+    written = (jnp.arange(s, dtype=jnp.int32)[None, :]
+               < (start + c)[:, None])                   # (b, s)
+    vh = jnp.where(written[:, :, None, None],
+                   v_cache.reshape(b, s, num_heads, hd).astype(jnp.float32),
+                   0.0)
     scores = jnp.einsum(
         "bchd,bshd->bhcs", qh.astype(jnp.float32), kh.astype(jnp.float32),
         preferred_element_type=jnp.float32) * scale
     # query i (absolute position start+i) sees cache rows j <= start+i
-    qpos = start.astype(jnp.int32)[:, None] + \
+    qpos = start[:, None] + \
         jnp.arange(c, dtype=jnp.int32)[None, :]          # (b, c)
     valid = (jnp.arange(s, dtype=jnp.int32)[None, None, :]
              <= qpos[:, :, None])                        # (b, c, s)
     scores = jnp.where(valid[:, None], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhcs,bshd->bchd", p, vh.astype(jnp.float32),
+    out = jnp.einsum("bhcs,bshd->bchd", p, vh,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, c, e).astype(q.dtype)
 
@@ -290,11 +326,19 @@ def verify_attention(q, k_cache, v_cache, start, length, num_heads, *,
         scale = 1.0 / float(hd) ** 0.5
     qh = q.reshape(b, c, num_heads, hd)
     kh = k_cache.reshape(b, s, num_heads, hd)
-    vh = v_cache.reshape(b, s, num_heads, hd)
+    start = start.astype(jnp.int32)
+    # rows past the fed span (j >= start+c) are stale garbage no query
+    # attends (the span itself was scattered fresh by this launch):
+    # zero their V so softmax-0 weights multiply exact 0s — same stale-
+    # scale NaN guard as `chunk_attention`, bit-identical on finite data
+    written = (jnp.arange(s, dtype=jnp.int32)[None, :]
+               < (start + c)[:, None])                   # (b, s)
+    vh = jnp.where(written[:, :, None, None],
+                   v_cache.reshape(b, s, num_heads, hd).astype(jnp.float32),
+                   0.0)
     scores = jnp.einsum(
         "bchd,bshd->bhcs", qh.astype(jnp.float32), kh.astype(jnp.float32),
         preferred_element_type=jnp.float32) * scale
-    start = start.astype(jnp.int32)
     qpos = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]  # (b, c)
     j = jnp.arange(s, dtype=jnp.int32)[None, None, :]
     causal = j <= qpos[:, :, None]                       # (b, c, s)
@@ -305,7 +349,7 @@ def verify_attention(q, k_cache, v_cache, start, length, num_heads, *,
         (j == qpos[:, :, None])
     scores = jnp.where((causal & real)[:, None], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhcs,bshd->bchd", p, vh.astype(jnp.float32),
+    out = jnp.einsum("bhcs,bshd->bchd", p, vh,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, c, e).astype(q.dtype)
 

@@ -52,10 +52,32 @@ from .. import engine as _hengine
 from .. import telemetry
 from ..kvstore import KVStore
 from ..ndarray import NDArray, array
+from ..quant.codec import (encode_wire, decode_wire,
+                           resolve as quant_resolve)
 
 
 def _num_servers():
     return max(1, int(os.environ.get("DMLC_NUM_SERVER", "1")))
+
+
+def _ps_quant():
+    """`MXNET_PS_QUANT=int8` quantizes the dist-PS wire: pushes encode
+    before send and the server dequantizes before its rank-ordered
+    reduce; pulls encode server-side and decode at the worker.  Decode
+    keys off the MESSAGE (presence of ``qvalue``), not this env, so a
+    mixed fleet reduces correctly and ``=0`` is bit-for-bit (nothing
+    encodes, nothing changes).  Measured directly by the PR-2
+    ``dist.bytes_sent/recv`` counters — the payload shrinks ~3.8x at
+    the default 256-value scale groups."""
+    return quant_resolve(os.environ.get("MXNET_PS_QUANT", "0"))
+
+
+def _wire_value(msg):
+    """The (de-quantized, if needed) array payload of a push/pull
+    message/reply — the single decode chokepoint for both directions."""
+    if "qvalue" in msg:
+        return decode_wire(msg["qvalue"])
+    return np.asarray(msg["value"])
 
 
 def _bigarray_bound():
@@ -576,7 +598,7 @@ class ParameterServer:
                 if self._check_dead():
                     _send_msg(conn, self._check_dead())
                     continue
-                key, val = msg["key"], np.asarray(msg["value"])
+                key, val = msg["key"], _wire_value(msg)
                 done = threading.Event()
                 reply = None
                 with self._lock:
@@ -638,11 +660,21 @@ class ParameterServer:
                     reply = self._check_dead() or {"ok": True}
                 _send_msg(conn, reply)
             elif op == "pull":
+                qspec = _ps_quant()
                 with self._lock:
-                    if msg["key"] in self.store:
-                        reply = {"value": np.array(self.store[msg["key"]])}
-                    else:
-                        reply = self._missing_key_reply(msg["key"])
+                    val = self.store.get(msg["key"])
+                    if val is not None:
+                        val = np.array(val)  # snapshot under the lock
+                # the quantization encode runs OUTSIDE the lock: it is
+                # O(shard) arithmetic, and holding the global lock for
+                # it would serialize every other worker's push/pull
+                # behind each pull's encode
+                if val is None:
+                    reply = self._missing_key_reply(msg["key"])
+                elif qspec is None:
+                    reply = {"value": val}
+                else:
+                    reply = {"qvalue": encode_wire(val, qspec)}
                 _send_msg(conn, reply)
             elif op == "barrier":
                 if self._check_dead():
@@ -1071,12 +1103,20 @@ class DistKVStore(KVStore):
 
     def _push_one(self, k, merged, seq):
         merged = np.asarray(merged)  # device->host read, off-caller-thread
+        qspec = _ps_quant()
         reqs = []
         for sid, sl in self._route(k, merged.size):
             shard = merged if sl is None \
                 else merged.reshape(-1)[sl[0]:sl[1]]
-            reqs.append((sid, {"op": "push", "key": k, "seq": seq,
-                               "value": np.ascontiguousarray(shard)}))
+            msg = {"op": "push", "key": k, "seq": seq}
+            if qspec is not None:
+                # quantize-before-send: the server dequantizes before
+                # its rank-ordered reduce, so retried pushes stay
+                # bit-identical (the codec is deterministic)
+                msg["qvalue"] = encode_wire(shard, qspec)
+            else:
+                msg["value"] = np.ascontiguousarray(shard)
+            reqs.append((sid, msg))
         self._rpc_shards(reqs)
 
     def push(self, key, value, priority=0):
@@ -1114,13 +1154,13 @@ class DistKVStore(KVStore):
         size = int(np.prod(olist[0].shape)) if olist[0].shape else 1
         route = self._route(k, size)
         if len(route) == 1:
-            val = self._rpc({"op": "pull", "key": k},
-                            server=route[0][0])["value"]
+            val = _wire_value(self._rpc({"op": "pull", "key": k},
+                                        server=route[0][0]))
         else:
             replies = self._rpc_shards(
                 [(sid, {"op": "pull", "key": k}) for sid, _ in route])
             val = np.concatenate(
-                [r["value"].reshape(-1) for r in replies])
+                [_wire_value(r).reshape(-1) for r in replies])
             val = val.reshape(olist[0].shape)
         src = array(val)
         for o in olist:

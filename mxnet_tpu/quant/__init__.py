@@ -1,0 +1,34 @@
+"""Post-training quantization subsystem (ROADMAP item 2).
+
+One symmetric per-channel codec (`codec.QuantSpec` + `quantize` /
+`quantize_rows` / `dequantize`, int8 and — where the platform supports
+it — fp8 e4m3) reused at three levels of the stack:
+
+* **serving weights** — ``MXNET_SERVE_QUANT=int8|fp8``:
+  `serving.TransformerKVModel` quantizes its matmul weights once at
+  load and runs scaled matmuls inside the same AOT-compiled
+  prefill/decode/verify programs (docs/serving.md "Quantization").
+* **int8 paged KV** — ``MXNET_SERVE_KV_QUANT`` (defaults to int8
+  whenever weight quant is on): the serving block pool stores int8 rows
+  with per-row scales carried beside the block tables — roughly 2-4x
+  ``n_blocks`` at equal HBM, spilled/restored through the host tier in
+  the quantized dtype.
+* **dist-PS wire** — ``MXNET_PS_QUANT=int8``: `encode_wire` /
+  `decode_wire` quantize KVStore/dist-PS push/pull payloads
+  (quantize-before-send, dequantize-before-reduce), measured by the
+  PR-2 ``dist.bytes_*`` counters.
+
+`parity.parity_report` is the acceptance instrument: logit error +
+greedy token-match rate of the quantized model against its
+full-precision oracle over a request set (the ``bench.py --serve
+--quant`` gate), and the ``scale_corrupt:P`` chaos clause proves the
+runtime logit guard fails typed, never silently.
+"""
+from .codec import (QuantSpec, resolve, fp8_supported, quantize,
+                    dequantize, quantize_rows, encode_wire, decode_wire,
+                    wire_nbytes, WIRE_GROUP)
+from .parity import greedy_paged, parity_report
+
+__all__ = ["QuantSpec", "resolve", "fp8_supported", "quantize",
+           "dequantize", "quantize_rows", "encode_wire", "decode_wire",
+           "wire_nbytes", "WIRE_GROUP", "greedy_paged", "parity_report"]

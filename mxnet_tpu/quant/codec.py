@@ -1,0 +1,251 @@
+"""Symmetric per-channel quantization codec (int8 / fp8).
+
+One codec serves every quantization consumer in the stack (the
+LLM.int8 / AWQ weight-only family, expressed in this repo's primitives):
+
+* **serving weights** (``MXNET_SERVE_QUANT``) — `TransformerKVModel`
+  quantizes its matmul weights once at load (`quantize`, channel axis =
+  the output row of each ``(out, in)`` projection) and the compiled
+  programs run *scaled matmuls*: ``y = (x @ W_q.T) * scale`` — exactly
+  dequantize-then-matmul, but the dequantized weight is never
+  materialized, so HBM reads int8/fp8 bytes (the bandwidth-bound decode
+  win) and the MXU accumulates in f32 as before.
+* **int8 paged KV** (``MXNET_SERVE_KV_QUANT``) — the serving block pool
+  stores int8 rows with per-row scales (`quantize_rows`: one scale per
+  cached token row per layer per K/V, indexed block-major so scales
+  travel WITH their block through sharing, copy-on-write, spill and
+  restore).  Per-row granularity is what makes quantize-on-write exact
+  under incremental writes: decode appends one row at a time, and a
+  coarser (whole-block) scale would either clip late rows or silently
+  re-scale ones already written.
+* **dist-PS wire format** (``MXNET_PS_QUANT``) — `encode_wire` /
+  `decode_wire` quantize gradients/parameters per fixed-size group
+  before pickling (quantize-before-send, dequantize-before-reduce), so
+  the PR-2 ``dist.bytes_sent/recv`` counters measure the win directly.
+
+Everything is SYMMETRIC (no zero-points: weights and K/V are centered,
+and a zero-point would put an add on the critical matmul path) and
+deterministic (same input -> same bits, which is what lets retried
+dist-PS pushes stay bit-for-bit and T=0 serving replay exact).
+
+The functions run on BOTH numpy arrays (host: load-time weight quant,
+the wire codec) and jax arrays/tracers (in-graph: KV quantize-on-write
+inside the compiled serving programs) — the array namespace is picked
+per input.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+try:  # jax ships ml_dtypes; guarded so the host-only wire path survives
+    from ml_dtypes import float8_e4m3fn as _FP8_NP
+except ImportError:  # pragma: no cover - ml_dtypes rides in with jax
+    _FP8_NP = None
+
+__all__ = ["QuantSpec", "resolve", "fp8_supported", "quantize",
+           "dequantize", "quantize_rows", "encode_wire", "decode_wire",
+           "wire_nbytes"]
+
+_OFF = ("", "0", "none", "off", "false", "no", "bf16", "fp32")
+
+
+class QuantSpec:
+    """One quantization format: target dtype + the symmetric range.
+
+    ``qmax`` is the largest representable magnitude the scale maps the
+    per-channel (or per-row / per-group) absolute max onto:
+    127 for int8, 448 for fp8 e4m3 (the largest finite e4m3fn value).
+    """
+
+    __slots__ = ("name", "qmax")
+
+    def __init__(self, name):
+        name = str(name).lower()
+        if name == "int8":
+            self.qmax = 127.0
+        elif name == "fp8":
+            if not fp8_supported():
+                raise MXNetError(
+                    "QuantSpec: fp8 (float8_e4m3fn) is not supported on "
+                    "this platform/jax build — use MXNET_SERVE_QUANT=int8")
+            self.qmax = 448.0
+        else:
+            raise MXNetError(
+                "QuantSpec: unknown format %r (expected 'int8' or 'fp8')"
+                % (name,))
+        self.name = name
+
+    def qdtype(self, xp):
+        """The storage dtype in namespace ``xp`` (numpy or jax.numpy)."""
+        if self.name == "int8":
+            return xp.int8
+        return _FP8_NP if xp is np else xp.float8_e4m3fn
+
+    def __repr__(self):
+        return "QuantSpec(%r)" % self.name
+
+    def __eq__(self, other):
+        return isinstance(other, QuantSpec) and other.name == self.name
+
+    def __hash__(self):
+        return hash(("QuantSpec", self.name))
+
+
+def resolve(spec):
+    """Env-string/spec -> `QuantSpec` or None (quantization off).
+
+    Accepts a `QuantSpec`, a format name ('int8'/'fp8'), or any of the
+    kill-switch spellings ('', '0', 'none', 'off', ...).  The single
+    parsing chokepoint for ``MXNET_SERVE_QUANT`` / ``MXNET_SERVE_KV_QUANT``
+    / ``MXNET_PS_QUANT``."""
+    if spec is None or isinstance(spec, QuantSpec):
+        return spec
+    if str(spec).lower() in _OFF:
+        return None
+    return QuantSpec(spec)
+
+
+_FP8_OK = None
+
+
+def fp8_supported():
+    """Whether this platform can store/convert float8_e4m3fn (the weight
+    format gate: fp8 serving weights only need convert — the scaled
+    matmul upcasts to f32 — so CPU meshes qualify via ml_dtypes)."""
+    global _FP8_OK
+    if _FP8_OK is None:
+        if _FP8_NP is None:
+            _FP8_OK = False
+        else:
+            try:
+                import jax.numpy as jnp
+                ok = hasattr(jnp, "float8_e4m3fn")
+                if ok:
+                    np.zeros((2,), np.float32).astype(_FP8_NP)
+                _FP8_OK = bool(ok)
+            except Exception:  # pragma: no cover - exotic builds
+                _FP8_OK = False
+    return _FP8_OK
+
+
+def _xp(x):
+    if isinstance(x, (np.ndarray, np.generic)):
+        return np
+    import jax.numpy as jnp
+    return jnp
+
+
+def _scale_from_amax(xp, amax, qmax):
+    # zero channels get scale 1 (their quantized values are all zero
+    # anyway); guards the div on dead channels / never-written KV rows
+    one = xp.asarray(1.0, xp.float32)
+    return xp.where(amax > 0, amax / qmax, one).astype(xp.float32)
+
+
+def _cast_q(xp, y, spec):
+    if spec.name == "int8":
+        return xp.clip(xp.round(y), -spec.qmax, spec.qmax).astype(xp.int8)
+    return xp.clip(y, -spec.qmax, spec.qmax).astype(spec.qdtype(xp))
+
+
+def quantize(x, spec, axis=0):
+    """Per-channel symmetric quantization of ``x``: one f32 scale per
+    index of ``axis`` (amax over every other axis).  Returns
+    ``(q, scale)`` with ``q`` in the spec's storage dtype and ``scale``
+    shaped ``(x.shape[axis],)``.  For a ``(out, in)`` matmul weight,
+    ``axis=0`` is the standard per-output-channel layout: the scaled
+    matmul applies ``scale`` to the output's last dimension."""
+    spec = resolve(spec)
+    if spec is None:
+        raise MXNetError("quantize: spec resolved to None (quant off)")
+    xp = _xp(x)
+    x = x.astype(xp.float32)
+    axes = tuple(a for a in range(x.ndim) if a != (axis % x.ndim))
+    amax = xp.max(xp.abs(x), axis=axes)
+    scale = _scale_from_amax(xp, amax, spec.qmax)
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = -1
+    q = _cast_q(xp, x / scale.reshape(shape), spec)
+    return q, scale
+
+
+def quantize_rows(x, spec):
+    """Per-row symmetric quantization: one f32 scale per index of every
+    LEADING axis, amax over the last axis only.  Returns ``(q, scale)``
+    with ``scale`` shaped ``x.shape[:-1]`` — the K/V cache layout, where
+    each cached token row ``(..., embed)`` carries its own scale so
+    incremental (row-at-a-time) writes never re-scale earlier rows."""
+    spec = resolve(spec)
+    if spec is None:
+        raise MXNetError("quantize_rows: spec resolved to None (quant off)")
+    xp = _xp(x)
+    x = x.astype(xp.float32)
+    amax = xp.max(xp.abs(x), axis=-1)
+    scale = _scale_from_amax(xp, amax, spec.qmax)
+    q = _cast_q(xp, x / scale[..., None], spec)
+    return q, scale
+
+
+def dequantize(q, scale, axis=None):
+    """Inverse of `quantize`/`quantize_rows`: ``q * scale`` in f32.
+
+    ``axis=None`` is the row layout (``scale.shape == q.shape[:-1]``,
+    broadcast over the last axis); an integer ``axis`` is the
+    per-channel layout (``scale`` spans that axis)."""
+    xp = _xp(q)
+    q = q.astype(xp.float32)
+    scale = scale.astype(xp.float32)
+    if axis is None:
+        return q * scale[..., None]
+    shape = [1] * q.ndim
+    shape[axis % q.ndim] = -1
+    return q * scale.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# dist-PS wire format (MXNET_PS_QUANT) — host-side numpy only
+# ---------------------------------------------------------------------------
+
+WIRE_GROUP = 256  # values per wire scale (fixed: both ends must agree)
+
+
+def encode_wire(arr, spec, group=WIRE_GROUP):
+    """Quantize a host array for the dist-PS wire: flatten, pad to a
+    multiple of ``group``, quantize each group symmetrically, and return
+    the self-describing payload dict (storage + per-group f32 scales +
+    original shape/dtype).  Deterministic — retried pushes re-encode the
+    same bits, so the server's idempotence ledger keeps working.  The
+    gradients/parameters this rides under are 1-D shards (dist.py range-
+    partitions big arrays), so grouping is the per-channel analogue that
+    survives the flattening."""
+    spec = resolve(spec)
+    arr = np.asarray(arr)
+    flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+    # clamp the group to the tensor: a tiny array padded to a full
+    # group would ship MORE bytes quantized than plain (decode reads
+    # the group off the q array's own shape, so both ends stay in step)
+    group = max(1, min(int(group), len(flat)))
+    pad = (-len(flat)) % group
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    q, scale = quantize_rows(flat.reshape(-1, group), spec)
+    return {"q": q, "scale": scale, "shape": tuple(arr.shape),
+            "dtype": arr.dtype.str, "format": spec.name,
+            "group": group}
+
+
+def decode_wire(msg):
+    """Inverse of `encode_wire`: the dequantized array at its original
+    shape and dtype.  Decode keys off the MESSAGE, not the env, so a
+    mixed fleet (quantizing workers, plain workers) reduces correctly
+    through one server."""
+    flat = dequantize(np.asarray(msg["q"]), np.asarray(msg["scale"]))
+    n = int(np.prod(msg["shape"])) if msg["shape"] else 1
+    return flat.reshape(-1)[:n].reshape(msg["shape"]).astype(msg["dtype"])
+
+
+def wire_nbytes(msg):
+    """Payload bytes of an encoded wire dict (telemetry/tests)."""
+    return int(msg["q"].nbytes + msg["scale"].nbytes)

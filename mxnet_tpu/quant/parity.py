@@ -1,0 +1,117 @@
+"""Quantized-vs-oracle output parity (the serving quantization gate).
+
+Post-training quantization is only shippable behind a measured error
+bound: `parity_report` runs the SAME request set through a quantized
+serving model and its full-precision oracle — pure paged-path functions,
+no engine, so the measurement exercises exactly the compiled-program
+math (scaled matmuls, int8 KV quantize-on-write/dequantize-on-gather)
+without scheduler nondeterminism — and reports
+
+* **logit error** of the first sampling decision per prompt (max-abs and
+  relative to the oracle's logit magnitude), the quantity the tolerance
+  gate bounds, exported as the ``serve.quant_logit_err`` gauge, and
+* **greedy token-match rate** at T=0: the mean leading-agreement
+  fraction of the generated streams (once one token diverges the
+  contexts differ, so trailing positions are not comparable — leading
+  agreement is the honest metric).
+
+`bench.py --serve --quant` and tests/test_serve_quant.py gate on both;
+the chaos clause ``scale_corrupt:P`` proves the RUNTIME half of the
+contract (corrupted scales trip the in-graph logit guard typed instead
+of emitting silent wrong tokens).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["greedy_paged", "parity_report"]
+
+
+def greedy_paged(model, params, prompt, max_new, block_size=16):
+    """Pure paged-path greedy decode of ONE prompt: single-chunk prefill
+    over contiguous blocks, then ``max_new`` single-token decode steps.
+    Returns ``(tokens, first_logits)`` — the generated ids and the
+    prefill head logits (the first sampling decision, the logit-error
+    probe).  Uses exactly the program bodies the serving engine
+    compiles, so weight AND KV quantization error both show up."""
+    import jax.numpy as jnp
+
+    prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+    if not prompt:
+        raise MXNetError("greedy_paged: empty prompt")
+    bs = int(block_size)
+    total = len(prompt) + int(max_new)
+    if total > model.seq_len:
+        raise MXNetError("greedy_paged: prompt+max_new %d exceeds seq_len "
+                         "%d" % (total, model.seq_len))
+    n_table = -(-model.seq_len // bs)
+    need = -(-total // bs)
+    pool = model.init_block_pool(need + 1, bs)
+    table = np.zeros((1, n_table), np.int32)
+    table[0, :need] = np.arange(1, need + 1)
+    table_d = jnp.asarray(table)
+    c = -(-len(prompt) // bs) * bs
+    toks = np.zeros((1, c), np.int32)
+    toks[0, :len(prompt)] = prompt
+    logits, pool = model.prefill_paged(
+        params, pool, jnp.asarray(toks), jnp.zeros((1,), jnp.int32),
+        jnp.asarray([len(prompt)], np.int32), table_d)
+    first_logits = np.asarray(logits)[0]
+    tok = int(np.argmax(first_logits))
+    out = [tok]
+    pos = len(prompt)
+    for _ in range(int(max_new) - 1):
+        logits, pool = model.decode_paged(
+            params, pool, jnp.asarray([tok], np.int32),
+            jnp.asarray([pos], np.int32), table_d)
+        tok = int(np.argmax(np.asarray(logits)[0]))
+        out.append(tok)
+        pos += 1
+    return out, first_logits
+
+
+def parity_report(ref_model, ref_params, qmodel, qparams, prompts,
+                  max_new=8, block_size=16):
+    """Quantized-vs-oracle parity over a request set (T=0).
+
+    Returns a dict with ``logit_err_max`` / ``logit_err_rel`` (first-
+    decision logits) and ``token_match_rate`` (mean leading-agreement
+    fraction of the greedy streams), plus the per-request token lists
+    for callers that gate on exact counts.  Also exports the
+    ``serve.quant_logit_err`` gauge so the telemetry report renders the
+    live error level next to the serving counters."""
+    from .. import telemetry
+
+    err_max = 0.0
+    rel_max = 0.0
+    matches = []
+    streams = []
+    for p in prompts:
+        ref_toks, ref_logits = greedy_paged(ref_model, ref_params, p,
+                                            max_new, block_size)
+        q_toks, q_logits = greedy_paged(qmodel, qparams, p, max_new,
+                                        block_size)
+        err = float(np.max(np.abs(q_logits - ref_logits)))
+        err_max = max(err_max, err)
+        denom = float(np.max(np.abs(ref_logits)))
+        rel_max = max(rel_max, err / denom if denom > 0 else err)
+        lead = 0
+        for a, b in zip(ref_toks, q_toks):
+            if a != b:
+                break
+            lead += 1
+        matches.append(lead / float(max(len(ref_toks), 1)))
+        streams.append({"ref": ref_toks, "quant": q_toks})
+    report = {
+        "prompts": len(list(prompts)),
+        "max_new": int(max_new),
+        "logit_err_max": round(err_max, 6),
+        "logit_err_rel": round(rel_max, 6),
+        "token_match_rate": round(float(np.mean(matches)) if matches
+                                  else 1.0, 4),
+        "streams": streams,
+    }
+    telemetry.set_gauge("serve.quant_logit_err", report["logit_err_rel"])
+    return report

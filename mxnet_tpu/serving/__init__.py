@@ -59,6 +59,14 @@ package is the production path on top of it (ROADMAP item 1):
   (`MXNET_SERVE_MIN_PROGRESS`, oldest-request protection, a
   preemption-storm detector tripping the degrade path) guarantees net
   forward progress under sustained block-pool pressure.
+* quantization (mxnet_tpu/quant, ``MXNET_SERVE_QUANT=int8|fp8``) —
+  serving weights quantize once at load (scaled matmuls inside the
+  same compiled programs) and the paged K/V pool stores int8 rows
+  with per-row scales (``MXNET_SERVE_KV_QUANT``, on by default with
+  weight quant) — roughly 2-4x ``n_blocks`` at equal HBM, spilled/
+  restored through the host tier in the quantized dtype, guarded by
+  an in-graph logit gate that fails typed (`ServeQuantError`) on
+  corrupted scales instead of emitting silent wrong tokens.
 * `errors` — the typed failure taxonomy every request resolves to.
 
 See docs/serving.md.
@@ -73,7 +81,8 @@ from .spec import Drafter, NgramDrafter, ModelDrafter, make_drafter
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
                      ServeQuarantined, ServeBlocksExhausted,
-                     ServeCacheInvalidated, ServeEngineDead)
+                     ServeCacheInvalidated, ServeEngineDead,
+                     ServeQuantError)
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
            "ReplicaRouter", "RequestJournal", "journal_enabled",
@@ -82,4 +91,4 @@ __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
            "make_drafter", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
            "ServeBlocksExhausted", "ServeCacheInvalidated",
-           "ServeEngineDead"]
+           "ServeEngineDead", "ServeQuantError"]

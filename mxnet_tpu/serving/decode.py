@@ -28,8 +28,32 @@ reallocation) and makes admit/retire a pure slot-index bookkeeping
 operation — no data moves when a sequence enters or leaves the batch.
 Sequences occupy a slot; per-row positions make the batch ragged-free:
 row b attends to cache[..., b, 0:pos[b]+1, :].
+
+QUANTIZATION (docs/serving.md "Quantization", mxnet_tpu/quant):
+
+* ``quant`` (weights, ``MXNET_SERVE_QUANT=int8|fp8``) — the matmul
+  weights (per-layer projections, the embedding, the pred head) are
+  quantized ONCE at load (`quantize_params`: symmetric per-output-
+  channel, scales stored under ``<name>_qscale``) and every program
+  runs *scaled matmuls*: ``y = (x @ W_q.T) * scale`` — mathematically
+  dequantize-then-matmul, but the f32 weight never materializes, so
+  HBM streams 1-byte rows into the same f32-accumulating dot.
+* ``kv_quant`` (paged KV, ``MXNET_SERVE_KV_QUANT``, int8 by default
+  whenever weight quant is on) — the block pool becomes the PAIR
+  ``(int8 pool (L, 2, n_blocks, bs, E), f32 scales (L, 2, n_blocks,
+  bs))``: quantize-on-write at every scatter (prefill chunks, decode
+  rows, verify spans, `copy_block`, `write_block`), dequantize at
+  every gather, one scale per cached token row so incremental writes
+  never re-scale earlier rows.  Scales are indexed by block, so
+  prefix sharing, copy-on-write, host-tier spill and restore all
+  carry them beside the data for free.
+
+Both default OFF; a model without quant specs builds byte-identical
+programs to PR 13.
 """
 from __future__ import annotations
+
+import copy
 
 import numpy as np
 
@@ -37,11 +61,12 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from ..ops.attention import (gather_paged_kv, paged_decode_attention,
-                             decode_attention, chunk_attention,
-                             verify_attention)
+from ..ops.attention import (gather_paged_kv, gather_paged_scales,
+                             paged_decode_attention, decode_attention,
+                             chunk_attention, verify_attention)
 from ..ops.pallas_kernels.flash_attention import flash_attention
 from ..ops.pallas_kernels.layer_norm import layer_norm
+from ..quant.codec import quantize, quantize_rows, resolve as quant_resolve
 
 
 class TransformerKVModel:
@@ -56,7 +81,7 @@ class TransformerKVModel:
 
     def __init__(self, vocab_size, seq_len, num_layers=2, num_heads=4,
                  num_embed=128, num_ffn_hidden=None, use_bias=True,
-                 eps=1e-5, dtype=np.float32):
+                 eps=1e-5, dtype=np.float32, quant=None, kv_quant=None):
         if num_embed % num_heads != 0:
             raise MXNetError("num_embed must be divisible by num_heads")
         self.vocab_size = int(vocab_size)
@@ -68,6 +93,24 @@ class TransformerKVModel:
         self.use_bias = bool(use_bias)
         self.eps = float(eps)
         self.dtype = np.dtype(dtype)
+        # post-training quantization specs (None = full precision, the
+        # PR-13 programs bit for bit); see the module docstring
+        self.quant = quant_resolve(quant)
+        self.kv_quant = quant_resolve(kv_quant)
+
+    def with_quant(self, quant, kv_quant):
+        """A shallow copy of this geometry with the given quantization
+        specs (the engine's ``MXNET_SERVE_QUANT`` entry point: one model
+        object can serve a quantized engine and a full-precision oracle
+        side by side — each view builds its own programs)."""
+        quant = quant_resolve(quant)
+        kv_quant = quant_resolve(kv_quant)
+        if quant == self.quant and kv_quant == self.kv_quant:
+            return self
+        m = copy.copy(self)
+        m.quant = quant
+        m.kv_quant = kv_quant
+        return m
 
     # -- parameters --------------------------------------------------------
     def param_shapes(self):
@@ -118,6 +161,37 @@ class TransformerKVModel:
             raise MXNetError(
                 "TransformerKVModel: params missing %s" % missing)
 
+    def _quant_weight_names(self):
+        """The matmul weights the weight-quant spec applies to: every
+        2-D projection (per-channel scales need a channel axis).  The
+        tiny 1-D tensors (LN gammas/betas, biases) and the positional
+        table stay full precision — they are O(E) bytes and sit on
+        addition paths where a scale would buy nothing."""
+        names = ["embed_weight", "pred_weight"]
+        for i in range(self.num_layers):
+            p = "layer%d_" % i
+            names += [p + s + "_weight" for s in
+                      ("q", "k", "v", "attn_out", "ffn1", "ffn2")]
+        return names
+
+    def quantize_params(self, params):
+        """Quantize the matmul weights once at load: each weight is
+        replaced by its int8/fp8 storage under the SAME name, with the
+        per-output-channel f32 scales beside it as ``<name>_qscale``
+        (the programs pick the scaled-matmul path whenever the scale
+        key exists).  Idempotent: an already-quantized dict (the
+        respawn path shares device-resident params) passes through."""
+        if self.quant is None:
+            return params
+        if any(k.endswith("_qscale") for k in params):
+            return params
+        out = dict(params)
+        for name in self._quant_weight_names():
+            q, scale = quantize(out[name], self.quant, axis=0)
+            out[name] = q
+            out[name + "_qscale"] = scale
+        return out
+
     def init_cache(self, n_slots, device=None):
         """Zeroed K/V cache: (num_layers, 2, n_slots, S_max, embed).
 
@@ -133,11 +207,36 @@ class TransformerKVModel:
 
     # -- shared pieces -----------------------------------------------------
     def _proj(self, params, x, name):
-        y = jnp.dot(x, params[name + "_weight"].T,
-                    preferred_element_type=jnp.float32).astype(x.dtype)
+        w = params[name + "_weight"]
+        qs = params.get(name + "_weight_qscale")
+        if qs is None:
+            y = jnp.dot(x, w.T,
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+        else:
+            # scaled matmul: the quantized weight upcasts INSIDE the dot
+            # (XLA fuses the convert — HBM reads 1-byte rows) and the
+            # per-output-channel scale folds into the f32 product before
+            # the downcast: exact dequantize-then-matmul, never a
+            # materialized f32 weight
+            y = (jnp.dot(x, w.T.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+                 * qs).astype(x.dtype)
         if self.use_bias:
             y = y + params[name + "_bias"]
         return y
+
+    def _embed(self, params, tokens):
+        """Token embedding lookup — under weight quant the gathered int8
+        rows dequantize by their per-row (per-vocab-entry) scale, so the
+        (V, E) table, the largest weight after the head, also stores
+        1-byte entries."""
+        ids = tokens.astype(jnp.int32)
+        x = jnp.take(params["embed_weight"], ids, axis=0)
+        qs = params.get("embed_weight_qscale")
+        if qs is not None:
+            x = (x.astype(jnp.float32)
+                 * jnp.take(qs, ids, axis=0)[..., None]).astype(self.dtype)
+        return x
 
     def _head(self, params, x):
         return self._proj(params, layer_norm(
@@ -164,8 +263,7 @@ class TransformerKVModel:
         """
         b, s = tokens.shape
         h, e = self.num_heads, self.num_embed
-        x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
-                     axis=0)
+        x = self._embed(params, tokens)
         x = x + params["pos_embed_weight"][0, :s]
         kv = []
         for i in range(self.num_layers):
@@ -208,7 +306,7 @@ class TransformerKVModel:
         e = self.num_embed
         pos = pos.astype(jnp.int32)
         slots = slots.astype(jnp.int32)
-        x = jnp.take(params["embed_weight"], token.astype(jnp.int32), axis=0)
+        x = self._embed(params, token)
         x = x + jnp.take(params["pos_embed_weight"][0], pos, axis=0)
         for i in range(self.num_layers):
             p = "layer%d_" % i
@@ -233,15 +331,86 @@ class TransformerKVModel:
         return self._head(params, x), cache
 
     # -- paged cache -------------------------------------------------------
+    @staticmethod
+    def cache_lost(cache):
+        """True when any leaf of a cache/pool value (an array, or the
+        (pool, scales) pair under KV quant) was consumed by a failed
+        donating launch — the engine's and the drafter's shared
+        pool-loss probe."""
+        for c in jax.tree_util.tree_leaves(cache):
+            if getattr(c, "is_deleted", None) is not None \
+                    and c.is_deleted():
+                return True
+        return False
+
+    def _pool_parts(self, cache):
+        """Split the engine's opaque paged-cache value: ``(pool, None)``
+        full precision, ``(int8 pool, f32 scales)`` under KV quant —
+        every paged method accepts either and returns the same kind."""
+        if self.kv_quant is not None:
+            return cache
+        return cache, None
+
+    def _pack_pool(self, pool, scales):
+        return pool if scales is None else (pool, scales)
+
+    def _gather_ctx(self, pool, scales, layer, which, tables):
+        """Materialize one layer's K (or V) context through the block
+        tables, dequantizing in-graph when the pool stores int8: the
+        gathered rows upcast to f32 and multiply by their gathered
+        per-row scales before the attention math (which runs f32
+        softmax statistics regardless)."""
+        ctx = gather_paged_kv(pool[layer, which], tables)
+        if scales is None:
+            return ctx
+        sc = gather_paged_scales(scales[layer, which], tables)
+        return ctx.astype(jnp.float32) * sc[..., None]
+
     def init_block_pool(self, n_blocks, block_size, device=None):
         """Zeroed paged K/V pool: (num_layers, 2, n_blocks, block_size,
-        embed).  Block 0 is the trash block (serving/paged.py); like
-        `init_cache` this is also the pool-rebuild recovery allocation."""
+        embed) — under KV quantization the (pool, scales) PAIR, with the
+        pool in the quantized dtype and per-row f32 scales
+        (num_layers, 2, n_blocks, block_size).  Block 0 is the trash
+        block (serving/paged.py); like `init_cache` this is also the
+        pool-rebuild recovery allocation."""
         shape = (self.num_layers, 2, int(n_blocks), int(block_size),
                  self.num_embed)
+        if self.kv_quant is None:
+            if device is None:
+                return jnp.zeros(shape, self.dtype)
+            return jax.device_put(np.zeros(shape, self.dtype), device)
+        qdt = np.dtype(self.kv_quant.qdtype(np))
+        pool = np.zeros(shape, qdt)
+        scales = np.zeros(shape[:-1], np.float32)
         if device is None:
-            return jnp.zeros(shape, self.dtype)
-        return jax.device_put(np.zeros(shape, self.dtype), device)
+            return jnp.asarray(pool), jnp.asarray(scales)
+        return (jax.device_put(pool, device),
+                jax.device_put(scales, device))
+
+    def block_run_placeholder(self, k, block_size):
+        """Zeroed HOST staging buffers for a ``k``-block run — the
+        host-tier restore's transfer payload and compile placeholder:
+        one (num_layers, 2, k, block_size, embed) array, or the
+        (int8 data, f32 scales) pair under KV quantization (spilled
+        blocks live on the host in the pool's dtype, so restores move
+        1-byte rows over PCIe)."""
+        shape = (self.num_layers, 2, int(k), int(block_size),
+                 self.num_embed)
+        if self.kv_quant is None:
+            return np.zeros(shape, self.dtype)
+        return (np.zeros(shape, np.dtype(self.kv_quant.qdtype(np))),
+                np.zeros(shape[:-1], np.float32))
+
+    def slice_block(self, cache, block):
+        """One block's device rows — every layer, K and V — as the
+        spill payload: an array, or the (int8 data, scales) pair under
+        KV quantization (the host tier then stores exactly the pool's
+        bytes — spilling never dequantizes)."""
+        pool, scales = self._pool_parts(cache)
+        data = pool[:, :, block]
+        if scales is None:
+            return data
+        return data, scales[:, :, block]
 
     def copy_block(self, pool, src, dst):
         """Copy one block's cached rows — every layer, K and V — from
@@ -251,25 +420,38 @@ class TransformerKVModel:
         readers byte-for-byte.  Gather + scatter on the block axis, the
         same primitives the paged attention path uses; the pool is
         donated by the engine's compiled wrapper, so the copy is
-        in-place on the device."""
+        in-place on the device.  Under KV quantization the per-row
+        scales copy WITH the rows — a CoW'd block dequantizes
+        identically to its original."""
+        pool, scales = self._pool_parts(pool)
         src = src.astype(jnp.int32)
         dst = dst.astype(jnp.int32)
-        return pool.at[:, :, dst].set(pool[:, :, src])
+        pool = pool.at[:, :, dst].set(pool[:, :, src])
+        if scales is not None:
+            scales = scales.at[:, :, dst].set(scales[:, :, src])
+        return self._pack_pool(pool, scales)
 
     def write_block(self, pool, dst, data):
         """Scatter a staged run of K/V blocks — every layer, K and V —
         into the pool at blocks ``dst`` ((k,) int32): the host-tier
         RESTORE body.  ``data`` is the `(num_layers, 2, k, block_size,
-        embed)` device array ONE async `jax.device_put` staged from the
-        host pool while the previous decode iteration ran — a whole
-        restored prefix costs one transfer and one launch, not one per
-        block.  Padding entries past the real run point ``dst`` at the
-        trash block (the engine pads k up to a fixed bucket), so the
+        embed)` device array (or the (int8 data, scales) pair under KV
+        quantization) ONE async `jax.device_put` staged from the host
+        pool while the previous decode iteration ran — a whole restored
+        prefix costs one transfer and one launch, not one per block.
+        Padding entries past the real run point ``dst`` at the trash
+        block (the engine pads k up to a fixed bucket), so the
         program's shape set is small and compiled at warmup like
         `copy_block`.  The pool is donated by the engine's compiled
         wrapper, so the write is in-place on the device."""
-        return pool.at[:, :, dst.astype(jnp.int32)].set(
-            data.astype(pool.dtype))
+        pool, scales = self._pool_parts(pool)
+        dst = dst.astype(jnp.int32)
+        if scales is None:
+            return pool.at[:, :, dst].set(data.astype(pool.dtype))
+        dq, ds = data
+        pool = pool.at[:, :, dst].set(dq.astype(pool.dtype))
+        scales = scales.at[:, :, dst].set(ds.astype(jnp.float32))
+        return self._pack_pool(pool, scales)
 
     def prefill_paged(self, params, pool, tokens, start, length, tables):
         """One chunked-prefill step over the paged pool.
@@ -294,6 +476,7 @@ class TransformerKVModel:
         (cached prefix + the chunk itself), which is exactly the
         training causal mask once start=0.
         """
+        pool, scales = self._pool_parts(pool)
         b, c = tokens.shape
         h, e = self.num_heads, self.num_embed
         bs = pool.shape[3]
@@ -310,8 +493,7 @@ class TransformerKVModel:
         blk = jnp.take_along_axis(tables, jnp.minimum(ent, m - 1), axis=1)
         blk = jnp.where(ent < m, blk, 0)                      # (b, nb)
         positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
-        x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
-                     axis=0)
+        x = self._embed(params, tokens)
         x = x + jnp.take(params["pos_embed_weight"][0], positions, axis=0)
         for i in range(self.num_layers):
             p = "layer%d_" % i
@@ -326,12 +508,21 @@ class TransformerKVModel:
             # Rows past `length` write garbage into the chunk's own
             # blocks — never visible: decode overwrites position
             # start+length first and every mask is `j <= own position`.
-            kw = k.reshape(b, nb, bs, e).astype(pool.dtype)
-            vw = v.reshape(b, nb, bs, e).astype(pool.dtype)
-            pool = pool.at[i, 0, blk].set(kw)
-            pool = pool.at[i, 1, blk].set(vw)
-            kc = gather_paged_kv(pool[i, 0], tables)          # (b, m*bs, e)
-            vc = gather_paged_kv(pool[i, 1], tables)
+            kw = k.reshape(b, nb, bs, e)
+            vw = v.reshape(b, nb, bs, e)
+            if scales is None:
+                pool = pool.at[i, 0, blk].set(kw.astype(pool.dtype))
+                pool = pool.at[i, 1, blk].set(vw.astype(pool.dtype))
+            else:
+                # quantize-on-write: one scale per cached token row
+                kq, ks = quantize_rows(kw, self.kv_quant)
+                vq, vs = quantize_rows(vw, self.kv_quant)
+                pool = pool.at[i, 0, blk].set(kq)
+                pool = pool.at[i, 1, blk].set(vq)
+                scales = scales.at[i, 0, blk].set(ks)
+                scales = scales.at[i, 1, blk].set(vs)
+            kc = self._gather_ctx(pool, scales, i, 0, tables)  # (b,m*bs,e)
+            vc = self._gather_ctx(pool, scales, i, 1, tables)
             attn = chunk_attention(q, kc, vc, start, h)
             x = x + self._proj(params, attn.reshape(-1, e),
                                p + "attn_out").reshape(b, c, e)
@@ -343,7 +534,7 @@ class TransformerKVModel:
         last = jnp.take_along_axis(
             x, (length.astype(jnp.int32) - 1)[:, None, None], axis=1
         )[:, 0, :]
-        return self._head(params, last), pool
+        return self._head(params, last), self._pack_pool(pool, scales)
 
     def decode_paged(self, params, pool, token, pos, tables):
         """One generation step over the paged pool (the block-table
@@ -357,6 +548,7 @@ class TransformerKVModel:
                 with pos 0, so their scatter lands in the trash block.
         Returns (logits (b, vocab), new_pool).
         """
+        pool, scales = self._pool_parts(pool)
         e = self.num_embed
         bs = pool.shape[3]
         m = tables.shape[1]
@@ -371,7 +563,7 @@ class TransformerKVModel:
                                   axis=1)[:, 0]               # (b,)
         blk = jnp.where(ent < m, blk, 0)
         off = pos % bs
-        x = jnp.take(params["embed_weight"], token.astype(jnp.int32), axis=0)
+        x = self._embed(params, token)
         x = x + jnp.take(params["pos_embed_weight"][0],
                          jnp.minimum(pos, self.seq_len - 1), axis=0)
         for i in range(self.num_layers):
@@ -381,16 +573,27 @@ class TransformerKVModel:
             q = self._proj(params, hn, p + "q")
             k = self._proj(params, hn, p + "k")
             v = self._proj(params, hn, p + "v")
-            pool = pool.at[i, 0, blk, off].set(k.astype(pool.dtype))
-            pool = pool.at[i, 1, blk, off].set(v.astype(pool.dtype))
-            attn = paged_decode_attention(q, pool[i, 0], pool[i, 1],
-                                          tables, pos, self.num_heads)
+            if scales is None:
+                pool = pool.at[i, 0, blk, off].set(k.astype(pool.dtype))
+                pool = pool.at[i, 1, blk, off].set(v.astype(pool.dtype))
+                attn = paged_decode_attention(q, pool[i, 0], pool[i, 1],
+                                              tables, pos, self.num_heads)
+            else:
+                kq, ks = quantize_rows(k, self.kv_quant)
+                vq, vs = quantize_rows(v, self.kv_quant)
+                pool = pool.at[i, 0, blk, off].set(kq)
+                pool = pool.at[i, 1, blk, off].set(vq)
+                scales = scales.at[i, 0, blk, off].set(ks)
+                scales = scales.at[i, 1, blk, off].set(vs)
+                kc = self._gather_ctx(pool, scales, i, 0, tables)
+                vc = self._gather_ctx(pool, scales, i, 1, tables)
+                attn = decode_attention(q, kc, vc, pos, self.num_heads)
             x = x + self._proj(params, attn, p + "attn_out")
             hn = layer_norm(x, params[p + "ln2_gamma"],
                             params[p + "ln2_beta"], self.eps)
             f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
             x = x + self._proj(params, f, p + "ffn2")
-        return self._head(params, x), pool
+        return self._head(params, x), self._pack_pool(pool, scales)
 
     def verify_paged(self, params, pool, tokens, pos, length, tables):
         """Speculative-decoding verify: score a whole draft run with ONE
@@ -417,6 +620,7 @@ class TransformerKVModel:
         Positions past the table's coverage (speculation clipped at the
         cache end) redirect to the trash block explicitly.
         """
+        pool, scales = self._pool_parts(pool)
         b, c = tokens.shape
         h, e = self.num_heads, self.num_embed
         bs = pool.shape[3]
@@ -429,8 +633,7 @@ class TransformerKVModel:
         blk = jnp.take_along_axis(tables, jnp.minimum(ent, m - 1), axis=1)
         blk = jnp.where(ent < m, blk, 0)
         off = positions % bs
-        x = jnp.take(params["embed_weight"], tokens.astype(jnp.int32),
-                     axis=0)
+        x = self._embed(params, tokens)
         x = x + jnp.take(params["pos_embed_weight"][0],
                          jnp.minimum(positions, self.seq_len - 1), axis=0)
         for i in range(self.num_layers):
@@ -444,10 +647,18 @@ class TransformerKVModel:
             # scatter the whole fed span, then gather the context: the
             # draft tokens attend to each other causally, exactly as
             # sequential decode would have cached them one by one
-            pool = pool.at[i, 0, blk, off].set(k.astype(pool.dtype))
-            pool = pool.at[i, 1, blk, off].set(v.astype(pool.dtype))
-            kc = gather_paged_kv(pool[i, 0], tables)
-            vc = gather_paged_kv(pool[i, 1], tables)
+            if scales is None:
+                pool = pool.at[i, 0, blk, off].set(k.astype(pool.dtype))
+                pool = pool.at[i, 1, blk, off].set(v.astype(pool.dtype))
+            else:
+                kq, ks = quantize_rows(k, self.kv_quant)
+                vq, vs = quantize_rows(v, self.kv_quant)
+                pool = pool.at[i, 0, blk, off].set(kq)
+                pool = pool.at[i, 1, blk, off].set(vq)
+                scales = scales.at[i, 0, blk, off].set(ks)
+                scales = scales.at[i, 1, blk, off].set(vs)
+            kc = self._gather_ctx(pool, scales, i, 0, tables)
+            vc = self._gather_ctx(pool, scales, i, 1, tables)
             attn = verify_attention(q, kc, vc, pos, length, h)
             x = x + self._proj(params, attn.reshape(-1, e),
                                p + "attn_out").reshape(b, c, e)
@@ -458,7 +669,7 @@ class TransformerKVModel:
             x = x + self._proj(params, f, p + "ffn2").reshape(b, c, e)
         logits = self._head(params, x.reshape(-1, e)).reshape(
             b, c, self.vocab_size)
-        return logits, pool
+        return logits, self._pack_pool(pool, scales)
 
     def write_prefill(self, cache, kv, length, slots):
         """Scatter a prefill's (num_layers, 2, b, s, embed) K/V block into

@@ -134,6 +134,7 @@ from .. import telemetry
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
+from ..quant.codec import resolve as quant_resolve
 from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
@@ -142,7 +143,8 @@ from .tiers import HostBlockTier
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
                      ServeQuarantined, ServeBlocksExhausted,
-                     ServeCacheInvalidated, ServeEngineDead)
+                     ServeCacheInvalidated, ServeEngineDead,
+                     ServeQuantError)
 
 
 def _env_flag(name, default="1"):
@@ -412,7 +414,8 @@ class ServingEngine:
                  chunk_prefill=None, sampling=None, prefix=None,
                  prefix_pool=None, spec=None, spec_k=None,
                  spec_drafter=None, min_progress=None, thrash_trip=None,
-                 tier=None, host_blocks=None, restore_ahead=None):
+                 tier=None, host_blocks=None, restore_ahead=None,
+                 quant=None, kv_quant=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -476,6 +479,39 @@ class ServingEngine:
             else bool(paged)
         self._sampling = _env_flag("MXNET_SERVE_SAMPLING") \
             if sampling is None else bool(sampling)
+        # post-training quantization (docs/serving.md "Quantization"):
+        # MXNET_SERVE_QUANT=int8|fp8 quantizes the serving weights once
+        # at load (scaled matmuls inside the same compiled programs);
+        # MXNET_SERVE_KV_QUANT (default: int8 whenever weight quant is
+        # on) stores the paged K/V pool int8 with per-row scales —
+        # roughly 2-4x n_blocks at equal HBM.  =0 is bit-for-bit PR 13.
+        self._quant = quant_resolve(
+            os.environ.get("MXNET_SERVE_QUANT", "0") if quant is None
+            else quant)
+        kvq = os.environ.get("MXNET_SERVE_KV_QUANT", "") \
+            if kv_quant is None else kv_quant
+        if kvq in ("", None):
+            # implicit default: int8 KV rides along with weight quant —
+            # but only where it can (paged); a slot-cache engine keeps
+            # weight-only quantization instead of failing over a
+            # variable the user never set
+            kvq = "int8" if (self._quant is not None
+                             and self._paged) else "0"
+        self._kv_quant = quant_resolve(kvq)
+        if self._kv_quant is not None and not self._paged:
+            raise MXNetError(
+                "ServingEngine: quantized KV blocks need the paged cache "
+                "(MXNET_SERVE_KV_QUANT set with MXNET_SERVE_PAGED=0)")
+        self._quant_gate = (self._quant is not None
+                            or self._kv_quant is not None)
+        self._quant_logit_max = float(os.environ.get(
+            "MXNET_SERVE_QUANT_LOGIT_MAX", "1e4"))
+        self.model = model = model.with_quant(self._quant, self._kv_quant)
+        if self._quant is not None:
+            # quantize ONCE at load, host-side; a respawn passes the dead
+            # incarnation's already-quantized device params straight
+            # through (quantize_params is idempotent)
+            params = model.quantize_params(params)
         jarr = getattr(jax, "Array", ())
         self._params = {k: jax.device_put(
             v if isinstance(v, jarr) else np.asarray(v), self._device)
@@ -653,7 +689,9 @@ class ServingEngine:
                       "spilled": 0, "restored": 0, "restored_tokens": 0,
                       "spill_fails": 0, "restore_fails": 0,
                       "prefill_tokens": 0, "session_hits": 0,
-                      "session_turns": 0}
+                      "session_turns": 0,
+                      # quantization (0s when disabled)
+                      "quant_trips": 0, "scale_corrupts": 0}
 
     # -- program building --------------------------------------------------
     _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
@@ -666,6 +704,22 @@ class ServingEngine:
         return (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
                 np.ones((b,), np.float32), np.zeros((b,), np.uint32))
 
+    def _quant_guard(self, logits, picked):
+        """The in-graph quantization logit gate (docs/serving.md
+        "Quantization"): with quant on, a row whose logits are
+        nonfinite or implausibly large (`MXNET_SERVE_QUANT_LOGIT_MAX`)
+        — corrupted per-block scales, the `scale_corrupt:P` chaos
+        clause, or a genuine quantization blow-up — emits the sentinel
+        token -1 instead of an unverifiable argmax.  The scheduler
+        converts the sentinel into a typed requeue/quarantine
+        (`_quant_trip_req`): NEVER a silent wrong token.  Quant off
+        compiles no guard — the PR-13 tail bit for bit."""
+        if not self._quant_gate:
+            return picked
+        bad = ~jnp.all(jnp.isfinite(logits), axis=-1) | \
+            (jnp.max(jnp.abs(logits), axis=-1) > self._quant_logit_max)
+        return jnp.where(bad, jnp.int32(-1), picked)
+
     def _pick(self, logits, samp, newpos):
         """The compiled program's token-selection tail.  ``newpos`` is
         the absolute position the chosen token will occupy — the RNG
@@ -673,9 +727,12 @@ class ServingEngine:
         identical sequences.  Greedy-only programs argmax (bit-for-bit
         the PR-7 tail)."""
         if not self._sampling:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return self._quant_guard(
+                logits, jnp.argmax(logits, axis=-1).astype(jnp.int32))
         temp, top_k, top_p, seed = samp
-        return sample_tokens(logits, temp, top_k, top_p, seed, newpos)
+        return self._quant_guard(
+            logits, sample_tokens(logits, temp, top_k, top_p, seed,
+                                  newpos))
 
     def _compiled_prefill(self, s_bucket):
         if self._paged:
@@ -754,13 +811,14 @@ class ServingEngine:
         verified prefix is bit-identical to the non-speculative path."""
         b, c, v = logits.shape
         if not self._sampling:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return self._quant_guard(
+                logits, jnp.argmax(logits, axis=-1).astype(jnp.int32))
         newpos = pos.astype(jnp.int32)[:, None] + 1 + \
             jnp.arange(c, dtype=jnp.int32)[None]
         temp, top_k, top_p, seed = (jnp.repeat(a, c, axis=0) for a in samp)
         flat = sample_tokens(logits.reshape(b * c, v), temp, top_k, top_p,
                              seed, newpos.reshape(-1))
-        return flat.reshape(b, c)
+        return self._quant_guard(logits, flat.reshape(b, c))
 
     def _compiled_verify(self, b_bucket):
         """The draft-verify step: ONE launch scores a whole draft run
@@ -842,15 +900,11 @@ class ServingEngine:
 
             fn = jax.jit(prog, donate_argnums=(0,))
             z = self._put(np.zeros((kb,), np.int32))
-            d = self._put(np.zeros(self._restore_shape(kb),
-                                   self.model.dtype))
+            d = self._put(self.model.block_run_placeholder(
+                kb, self.block_size))
             return fn.lower(self._cache, z, d).compile()
 
         return self._aot.get(("tier_restore", kb, 1), build)
-
-    def _restore_shape(self, kb):
-        return (self.model.num_layers, 2, int(kb), self.block_size,
-                self.model.num_embed)
 
     def _restore_buckets(self):
         """Power-of-two restore run lengths up to the table width."""
@@ -870,9 +924,10 @@ class ServingEngine:
             % (self.name, n, self._n_table))
 
     def _restore_watch_arrays(self, kb):
-        return ((np.zeros((kb,), np.int32),
-                 np.zeros(self._restore_shape(kb), self.model.dtype)),
-                ("dst", "data"))
+        ph = self.model.block_run_placeholder(kb, self.block_size)
+        ph = ph if isinstance(ph, tuple) else (ph,)
+        return ((np.zeros((kb,), np.int32),) + ph,
+                ("dst", "data", "data_scale")[:1 + len(ph)])
 
     def _put(self, a):
         return jax.device_put(a, self._device)
@@ -958,7 +1013,12 @@ class ServingEngine:
                 {"host_blocks": self._tier.capacity,
                  "restore_ahead": self._restore_ahead},
                 "spec": None if not self._spec else
-                {"k": self._spec_k, "drafter": self._drafter.name}}
+                {"k": self._spec_k, "drafter": self._drafter.name},
+                "quant": None if not self._quant_gate else
+                {"weights": None if self._quant is None
+                 else self._quant.name,
+                 "kv": None if self._kv_quant is None
+                 else self._kv_quant.name}}
 
     def respawn(self):
         """A replacement engine for this (dead) replica: same device,
@@ -985,7 +1045,10 @@ class ServingEngine:
                   else None),
             min_progress=self._min_progress, thrash_trip=self._thrash_trip,
             tier=self._tier is not None, host_blocks=self._host_blocks,
-            restore_ahead=self._restore_ahead)
+            restore_ahead=self._restore_ahead,
+            quant=self._quant if self._quant is not None else "0",
+            kv_quant=self._kv_quant if self._kv_quant is not None
+            else "0")
 
     # -- request intake ----------------------------------------------------
     def has_session(self, key):
@@ -1294,8 +1357,7 @@ class ServingEngine:
 
     # -- failure scoping ---------------------------------------------------
     def _cache_lost(self):
-        c = self._cache
-        return getattr(c, "is_deleted", None) is not None and c.is_deleted()
+        return self.model.cache_lost(self._cache)
 
     def _classify_failure(self, exc):
         """Scope of a failed compiled launch:
@@ -1333,6 +1395,76 @@ class ServingEngine:
         telemetry.record_event("serve_quarantine", replica=self.name,
                                request=req.id, error=msg[:200])
         req._finish(error=ServeQuarantined(msg[:500]))
+
+    # -- quantization logit-gate trips (docs/serving.md "Quantization") ----
+    def _scrub_quant(self, blocks):
+        """Corrupted-scale hygiene: a tripped row's cached context may
+        include SHARED prefix blocks whose scales are bad — detach them
+        (and their subtrees) from the prefix index so no later lookup
+        can re-acquire the corruption, and reclaim any that were parked.
+        The retry's replay re-prefill then writes fresh blocks with
+        fresh scales instead of re-reading the poisoned ones."""
+        if self._prefix is None or not blocks:
+            return
+        freed = self._prefix.invalidate(blocks)
+        if freed:
+            self._alloc.reclaim(freed)
+            self._count_evictions(len(freed))
+
+    def _quant_trip_req(self, req, where, requeue=True):
+        """A quantization logit gate tripped for ``req`` (the compiled
+        program emitted the -1 sentinel): count, then requeue ONCE for
+        a clean retry — the second trip (or a path with no exact-replay
+        road, e.g. a mid-generation slot-cache row) quarantines typed
+        `ServeQuantError`.  The one outcome this path can never have is
+        a silently emitted wrong token."""
+        self.stats["quant_trips"] += 1
+        self._count("quant.trips")
+        telemetry.record_event("serve_quant_trip", replica=self.name,
+                               request=req.id, where=where)
+        if requeue and req._requeues < 1:
+            req._requeues += 1
+            with self._qlock:
+                self._queue.appendleft(req)
+        else:
+            req._finish(error=ServeQuantError(
+                "ServeRequest %d: quantization logit gate tripped (%s) — "
+                "nonfinite or out-of-range logits under quantized "
+                "weights/KV (corrupted scales?); the request was retried "
+                "once and is quarantined rather than emitting unverified "
+                "tokens" % (req.id, where)))
+
+    def _vacate_row(self, row, seq, capture_resume=True):
+        """Retire an active row for a later exact replay: leave the
+        decode set, free the row, capture the uniform
+        ``(ctx, last, pos, n_new)`` resume tuple, and release the
+        blocks exactly once.  The ONE shared core of preemption
+        (`_preempt`) and the quant-gate trip (`_quant_trip_seq`), so
+        the replay formula and release ordering cannot drift between
+        them."""
+        del self._active[row]
+        self._free.append(row)
+        req = seq.req
+        if capture_resume:
+            req._resume = (list(seq.ctx), seq.last, seq.pos, seq.n_new)
+            req._preempt_n_new = seq.n_new
+        self._release_blocks(seq)
+        return req
+
+    def _quant_trip_seq(self, row, seq, where="decode"):
+        """Gate trip on an ACTIVE row: leave the decode set, scrub the
+        row's blocks from the prefix index, release them exactly once,
+        and requeue with the exact-replay resume (tokens already
+        emitted passed the gate — the replay continues after them with
+        freshly quantized context).  Slot-cache rows have no replay
+        road, so they quarantine directly."""
+        replayable = self._paged and seq.blocks is not None
+        if replayable:
+            self._scrub_quant(seq.blocks)
+        req = self._vacate_row(row, seq,
+                               capture_resume=replayable
+                               and seq.req._requeues < 1)
+        self._quant_trip_req(req, where, requeue=replayable)
 
     def _release_blocks(self, holder):
         """Drop a seq/prefill's block refs exactly once (every path a
@@ -1426,10 +1558,11 @@ class ServingEngine:
             # dominant tier cost before this went async).  `tier.get`
             # finalizes to numpy on first use, at least one admission
             # later, when the copy has long landed.
-            data = self._cache[:, :, block]
-            copy_async = getattr(data, "copy_to_host_async", None)
-            if copy_async is not None:
-                copy_async()
+            data = self.model.slice_block(self._cache, block)
+            for leaf in (data if isinstance(data, tuple) else (data,)):
+                copy_async = getattr(leaf, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
         except Exception as e:  # noqa: BLE001 — degrade, never escalate
             self.stats["spill_fails"] += 1
             self._count("spill_fails")
@@ -1658,6 +1791,13 @@ class ServingEngine:
                 return True
             self._quarantine(req, "prefill launch failed: %s" % e)
             return True
+        if first < 0:
+            # quantization logit gate (no token emitted yet: the retry
+            # replays the whole prompt — the slot path has no blocks or
+            # prefix index to scrub)
+            self._free.append(slot)
+            self._quant_trip_req(req, "prefill")
+            return True
         telemetry.observe("serve.queue_age_ms",
                           1e3 * (time.perf_counter() - req.t_submit))
         req.t_first = time.perf_counter()
@@ -1761,9 +1901,14 @@ class ServingEngine:
             self._count("replays")
         if nodes:
             kb = self._restore_bucket(len(nodes))
-            data = np.zeros(self._restore_shape(kb), self.model.dtype)
+            data = self.model.block_run_placeholder(kb, self.block_size)
             for j, a in enumerate(arrs):
-                data[:, :, j] = a
+                if isinstance(data, tuple):
+                    # quantized tier entries are (int8 rows, f32 scales)
+                    data[0][:, :, j] = a[0]
+                    data[1][:, :, j] = a[1]
+                else:
+                    data[:, :, j] = a
             dsts = np.full((kb,), TRASH_BLOCK, np.int32)
             dsts[:len(dst)] = dst
             self._restoring[row] = _Restore(req, row, list(tokens), blocks,
@@ -1875,8 +2020,11 @@ class ServingEngine:
             time.sleep(ms / 1e3)
         try:
             compiled = self._compiled_restore(rs.kb)
-            self._watch("restore", (rs.dst_d, rs.staged),
-                        ("dst", "data"), rs.kb)
+            staged = rs.staged if isinstance(rs.staged, tuple) \
+                else (rs.staged,)
+            self._watch("restore", (rs.dst_d,) + staged,
+                        ("dst", "data", "data_scale")[:1 + len(staged)],
+                        rs.kb)
             if chaos.serve_launch_error():
                 raise chaos.ChaosError(
                     "chaos: injected restore launch error")
@@ -2044,6 +2192,17 @@ class ServingEngine:
             self._active[pf.row] = seq
             return
         first = int(np.asarray(tok)[0])
+        if first < 0:
+            # quantization logit gate on the prompt's final chunk (no
+            # token emitted yet): scrub the blocks it read — a shared
+            # prefix with corrupted scales must not be re-acquired by
+            # the retry — release them, and requeue once
+            self._free.append(pf.row)
+            self._scrub_quant(blocks)
+            self._drop_refs(blocks)
+            self._block_gauges()
+            self._quant_trip_req(req, "prefill")
+            return
         req.t_first = time.perf_counter()
         req.tokens.append(first)
         self.stats["tokens"] += 1
@@ -2274,16 +2433,11 @@ class ServingEngine:
                 return
 
     def _preempt(self, row, seq):
-        del self._active[row]
-        self._free.append(row)
-        req = seq.req
         # the cache holds rows 0..pos-1: exactly the fed tokens `ctx`
         # tracks (a bootstrap admission has fed pos of its prompt and
         # generated nothing; after prefill + k decodes it is prompt +
         # generated[:-1] — the incremental list covers both)
-        req._resume = (list(seq.ctx), seq.last, seq.pos, seq.n_new)
-        req._preempt_n_new = seq.n_new
-        self._release_blocks(seq)
+        req = self._vacate_row(row, seq)
         self.stats["preemptions"] += 1
         self._count("preempted")
         self._note_preempt()
@@ -2390,6 +2544,30 @@ class ServingEngine:
         for r in dropped:
             self._finish_dropped(r, now)
 
+    def _corrupt_scales(self, u):
+        """`scale_corrupt:P` chaos: overwrite one HELD block's per-row
+        quantization scales with NaN in the device scale array — the
+        deterministic stand-in for scale-memory corruption (bit rot, a
+        torn spill, a bad restore).  Every launch that subsequently
+        reads the block dequantizes NaN K/V, so its logits go nonfinite
+        and the in-graph guard MUST convert the step into a typed
+        requeue/quarantine — the clause exists to prove "never silent
+        wrong tokens" is structural.  Runs as a tiny eager scatter
+        between launches (not a serving program: the frozen AotCache
+        and the retrace watchdog are about the SERVING shapes, and the
+        clause is chaos-only)."""
+        held = sorted(self._alloc._ref)
+        if not held:
+            return
+        blk = held[int(u * len(held)) % len(held)]
+        pool, scales = self._cache
+        idx = jnp.asarray(blk, jnp.int32)
+        self._cache = (pool, scales.at[:, :, idx].set(jnp.nan))
+        self.stats["scale_corrupts"] += 1
+        self._count("quant.scale_corrupts")
+        telemetry.record_event("serve_scale_corrupt", replica=self.name,
+                               block=int(blk))
+
     def _inject_flood(self):
         """`queue_flood:rate` chaos: synthetic one-token requests pushed
         through the SAME admission control as real traffic (shed floods
@@ -2411,6 +2589,10 @@ class ServingEngine:
         self.last_beat = time.monotonic()
         if chaos.enabled():
             self._inject_flood()
+            if self._kv_quant is not None:
+                u = chaos.serve_scale_corrupt()
+                if u is not None:
+                    self._corrupt_scales(u)
             if self._prefix is not None and chaos.serve_prefix_evict():
                 # `prefix_evict:P` chaos: shove the LRU parked block out
                 # as if allocation pressure claimed it — hot-prefix loss
@@ -2529,7 +2711,12 @@ class ServingEngine:
         telemetry.inc("serve.decode_padded", b - n)
         telemetry.set_gauge(self._gauge + "batch_occupancy", n / float(b))
         for i, (slot, seq) in enumerate(zip(slots, seqs)):
-            finished = self._advance_one(seq, int(nxt[i]))
+            t = int(nxt[i])
+            if t < 0:
+                # quantization logit gate: never emit the flagged token
+                self._quant_trip_seq(slot, seq)
+                continue
+            finished = self._advance_one(seq, t)
             if not finished and self._drafter is not None \
                     and seq.ctx is not None:
                 # adaptive-fallback rounds still feed the drafter's
@@ -2697,17 +2884,35 @@ class ServingEngine:
             # the host loop below cannot walk into them
             n_acc = min(int(out[i, c]), int(length[i]) - 1)
             self.stats["spec_proposed"] += k
-            self.stats["spec_accepted"] += n_acc
             self._count("spec.proposed", k)
-            if n_acc:
-                self._count("spec.accepted", n_acc)
             finished = False
+            tripped = False
+            acc_emitted = 0
             for j in range(n_acc + 1):
+                t = int(out[i, j])
+                if t < 0:
+                    # quantization logit gate: tokens accepted BEFORE
+                    # the flagged position passed it (identical context
+                    # to sequential decode); the trip retires the row
+                    # into the exact-replay requeue from right here
+                    tripped = True
+                    break
                 emitted_total += 1
-                if self._advance_one(seq, int(out[i, j])):
+                if j < n_acc:
+                    acc_emitted += 1
+                if self._advance_one(seq, t):
                     finished = True
                     break
-            if finished:
+            # a trip discards the tail past the flagged position — the
+            # accept counters (and the accept_rate gauge the chaos runs
+            # watch) only count drafts that actually reached the output
+            n_counted = acc_emitted if tripped else n_acc
+            self.stats["spec_accepted"] += n_counted
+            if n_counted:
+                self._count("spec.accepted", n_counted)
+            if tripped:
+                self._quant_trip_seq(row, seq, "verify")
+            elif finished:
                 self._retire(row, seq)
             else:
                 if seq.n_new > seqs_n_new[i]:

@@ -26,6 +26,7 @@ __all__ = [
     "ServeError", "ServeTimeout", "ServeOverload",
     "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
     "ServeBlocksExhausted", "ServeCacheInvalidated", "ServeEngineDead",
+    "ServeQuantError",
 ]
 
 
@@ -69,6 +70,15 @@ class ServeBlocksExhausted(ServeError):
     chaos denial) is NOT this error: those requests stay queued and
     retry, or preempt and requeue, resolving through the deadline/
     overload machinery instead."""
+
+
+class ServeQuantError(ServeError):
+    """The in-graph quantization logit gate tripped twice for this
+    request (nonfinite or out-of-range logits under quantized
+    weights/KV — corrupted per-block scales, or a genuine quantization
+    blow-up).  The request was retried once over freshly quantized
+    context and then quarantined: the engine never emits a token the
+    gate flagged (docs/serving.md "Quantization")."""
 
 
 class ServeCacheInvalidated(ServeError):

@@ -586,6 +586,39 @@ class PrefixCache:
             node.parent.children.pop(node.key, None)
         node.parent = None
 
+    def invalidate(self, blocks):
+        """Detach the nodes backing ``blocks`` (and their entire
+        subtrees — a child run's K/V is only meaningful under its
+        parent's context) from the index: the integrity-scrub path
+        (quantization scale corruption tripping the serving logit
+        gate).  Live holders keep their own table entries — refcounts
+        are the allocator's business — the runs just stop being
+        findable, so no future lookup can re-acquire them.  Host copies
+        under detached nodes drop through ``host_drop_hook``.  Returns
+        the PARKED device blocks that were detached (refcount 0,
+        unreferenced now): the caller reclaims them."""
+        out = []
+        for b in blocks:
+            node = self._by_block.get(b)
+            if node is None:
+                continue
+            self._detach(node)
+            stack = [node]
+            while stack:
+                d = stack.pop()
+                if d.tier == "host":
+                    self._by_host.pop(d.block, None)
+                    self._drop_host_handle(d.block)
+                else:
+                    self._by_block.pop(d.block, None)
+                    self._drop_host_handle(d.host)
+                    d.host = None
+                    if self._parked.pop(d.block, None) is not None:
+                        out.append(d.block)
+                stack.extend(d.children.values())
+                d.children = {}
+        return out
+
     def evict(self, n):
         """Evict at least ``n`` parked blocks (fewer if the pool runs
         dry); returns their ids for the caller to `reclaim`."""

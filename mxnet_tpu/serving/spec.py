@@ -308,12 +308,25 @@ class ModelDrafter(Drafter):
         super().bind(engine)
         if self.model is None:
             self.model = engine.model
+        else:
+            # the mirrored draft pool must quantize IDENTICALLY to the
+            # target's (same specs, same per-row scale discipline): a
+            # draft reading f32 K/V while the target reads int8 would
+            # diverge for quantization reasons alone, polluting the
+            # accept-rate signal — the accounting stays honest only
+            # when both sides see the same arithmetic
+            self.model = self.model.with_quant(engine.model.quant,
+                                               engine.model.kv_quant)
         if self.model.vocab_size != engine.model.vocab_size:
             raise MXNetError(
                 "ModelDrafter: draft vocab %d != target vocab %d"
                 % (self.model.vocab_size, engine.model.vocab_size))
         params = self.params if self.params is not None else engine._params
         self.model.check_params(params)
+        if self.model.quant is not None:
+            # idempotent: the self-draft path shares the engine's
+            # already-quantized device params
+            params = self.model.quantize_params(params)
         jarr = getattr(jax, "Array", ())
         self._dparams = {k: v if isinstance(v, jarr)
                          else engine._put(np.asarray(v))
@@ -326,8 +339,7 @@ class ModelDrafter(Drafter):
                                                 device=e._device)
 
     def _pool_lost(self):
-        p = self._pool
-        return getattr(p, "is_deleted", None) is not None and p.is_deleted()
+        return self.model.cache_lost(self._pool)
 
     # -- compiled programs (keys live in the engine's frozen AotCache) ----
     def _compiled_propose(self, b):

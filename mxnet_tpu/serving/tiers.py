@@ -76,9 +76,20 @@ class HostBlockTier:
                 "HostBlockTier: capacity must be >= 1 host blocks, "
                 "got %d" % capacity)
         self.capacity = int(capacity)
-        self._data = OrderedDict()    # handle -> np.ndarray, LRU order
+        self._data = OrderedDict()    # handle -> block payload, LRU order
         self._next = 1
         self.bytes = 0                # host DRAM held (telemetry)
+
+    @staticmethod
+    def _nbytes(arr):
+        """Bytes of one stored payload: an array, or — under serving
+        KV quantization — the (int8 rows, f32 scales) tuple.  The tier
+        stores whatever dtype the pool uses, so ``bytes`` directly
+        witnesses the quantized-spill footprint (int8 blocks cost ~1/4
+        the host DRAM and PCIe restore bytes of f32 ones)."""
+        if isinstance(arr, tuple):
+            return sum(a.nbytes for a in arr)
+        return arr.nbytes
 
     @property
     def used(self):
@@ -98,12 +109,12 @@ class HostBlockTier:
         evicted = []
         while len(self._data) >= self.capacity:
             h, old = self._data.popitem(last=False)
-            self.bytes -= old.nbytes
+            self.bytes -= self._nbytes(old)
             evicted.append(h)
         handle = self._next
         self._next += 1
         self._data[handle] = arr
-        self.bytes += arr.nbytes
+        self.bytes += self._nbytes(arr)
         return handle, evicted
 
     def get(self, handle):
@@ -116,7 +127,11 @@ class HostBlockTier:
         arr = self._data.get(handle)
         if arr is None:
             return None
-        if not isinstance(arr, np.ndarray):
+        if isinstance(arr, tuple):
+            if not all(isinstance(a, np.ndarray) for a in arr):
+                arr = tuple(np.asarray(a) for a in arr)
+                self._data[handle] = arr
+        elif not isinstance(arr, np.ndarray):
             arr = np.asarray(arr)
             self._data[handle] = arr
         self._data.move_to_end(handle)
@@ -136,7 +151,7 @@ class HostBlockTier:
         has to care who forgot first."""
         arr = self._data.pop(handle, None)
         if arr is not None:
-            self.bytes -= arr.nbytes
+            self.bytes -= self._nbytes(arr)
 
     def clear(self):
         """Forget everything (the pool-rebuild recovery path: the

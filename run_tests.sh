@@ -60,6 +60,17 @@ elif [ "$1" = "--serve-tier-smoke" ]; then
     T1=""
     set -- tests/test_serve_tiers.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-quant-smoke" ]; then
+    # fast quantization smoke: codec round-trip error bounds, quantized-
+    # vs-bf16 serving parity (logit tolerance + greedy token-match at
+    # T=0), the MXNET_SERVE_QUANT=0 kill-switch, prefix/CoW/spec/tier
+    # composition with int8 KV scales, the scale_corrupt chaos clause,
+    # the PS wire codec, and the quant zero-retrace gate
+    # (docs/serving.md "Quantization")
+    shift
+    T1=""
+    set -- tests/test_serve_quant.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-durability-smoke" ]; then
     # fast serving-durability smoke: journal exact-replay migration on
     # replica death, rolling-restart drain, anti-thrash preemption

@@ -322,6 +322,52 @@ print("serve-chaos gate passed: %s/%s resolved, resilience %s, "
                                 rec["deadline"]["hit_rate"]))
 PY
 
+# -- quantized-serving gate (docs/serving.md "Quantization") --------------
+# bf16 vs int8-weights+int8-KV A/B at EQUAL HBM on the mixed trace: the
+# quant leg must admit >= 1.8x the concurrency OR deliver >= 1.3x
+# tok/s/chip, the logit-error/token-match parity gate must pass against
+# the bf16 oracle, MXNET_SERVE_QUANT=0 (the bf16 leg) runs the PR-13
+# programs bit for bit, zero leaked blocks and zero steady-state
+# recompiles on BOTH legs (quantized programs join the frozen warmup
+# set); artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    SERVE_REQUESTS=32 \
+    python bench.py --serve --quant | tee /tmp/nightly_serve_quant.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_quant.log").read().strip().splitlines()[-1])
+for leg in ("bf16", "quant"):
+    r = rec[leg]
+    assert r["completed"] == r["requests"], \
+        "quant gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["steady_state_recompiles"] == 0, \
+        "quant gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "quant gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+    assert r["blocks"]["leaked"] == 0, \
+        "quant gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+assert rec["concurrency_gain"] >= 1.8 or rec["tok_s_gain"] >= 1.3, \
+    "quant gate: concurrency %sx and tok/s %sx both below the " \
+    "1.8x/1.3x acceptance floor at equal HBM" % (
+        rec["concurrency_gain"], rec["tok_s_gain"])
+assert rec["parity_gate"]["passed"], \
+    "quant gate: parity failed (%s vs gate %s)" % (
+        rec["parity"], rec["parity_gate"])
+print("quant gate passed: concurrency %sx (%s->%s), tok/s %sx, "
+      "logit_err_rel %s, token_match %s" % (
+          rec["concurrency_gain"], rec["bf16"]["max_concurrent"],
+          rec["quant"]["max_concurrent"], rec["tok_s_gain"],
+          rec["parity"]["logit_err_rel"],
+          rec["parity"]["token_match_rate"]))
+PY
+
+# -- quantization smoke: codec/parity/kill-switch/chaos unit coverage -----
+./run_tests.sh --serve-quant-smoke
+
 # -- serve-durability gate (docs/serving.md "Durability") -----------------
 # kill-one-of-two-replicas mid-Poisson with the request journal ON: 100%
 # of requests — including the dead replica's ADMITTED in-flight ones,

@@ -91,6 +91,14 @@ SERVE_TIER_COUNTERS = (
 SERVE_TIER_GAUGE_SUFFIX = ".host_blocks_used"
 SERVE_TIER_EVENT_KINDS = ("serve_spill_failed", "serve_restore_failed")
 
+# quantization accounting (docs/serving.md "Quantization"): logit-gate
+# trips + chaos scale corruptions (serve.<name>.quant.* per replica,
+# process-wide serve.quant.*), and the live logit-error gauge the
+# parity instrument exports
+SERVE_QUANT_COUNTERS = ("serve.quant.trips", "serve.quant.scale_corrupts")
+SERVE_QUANT_GAUGE = "serve.quant_logit_err"
+SERVE_QUANT_EVENT_KINDS = ("serve_quant_trip", "serve_scale_corrupt")
+
 
 def load(path):
     records = []
@@ -291,6 +299,21 @@ def summarize(records):
         tiering["serve.restore_wait_ms"] = wait
     if tiering:
         out["tiering"] = tiering
+    quantization = {k: int(final.get(k, 0)) for k in SERVE_QUANT_COUNTERS
+                    if final.get(k)}
+    for r in records:
+        for k, v in r.get("gauges", {}).items():
+            if k == SERVE_QUANT_GAUGE or (
+                    k.startswith("serve.") and ".quant" in k
+                    and k.endswith("_logit_err")):
+                quantization[k] = v  # last-seen
+    for kind in SERVE_QUANT_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            quantization["%s_events" % kind] = n
+    if quantization:
+        out["quantization"] = quantization
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -363,6 +386,11 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %s" % (key, v))
+    quantization = summary.get("quantization")
+    if quantization:
+        lines.append("  quantization:")
+        for key in sorted(quantization):
+            lines.append("    %-24s %s" % (key, quantization[key]))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "
