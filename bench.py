@@ -1653,10 +1653,49 @@ def _io_pipeline_ips(n=384):
     return got / (time.time() - t0)
 
 
+def _serve_lint_preflight():
+    """Refuse a --serve bench when the serving-scoped static rules fail:
+    an AOT-shape or lock-discipline regression would burn a bench hour to
+    rediscover at runtime what mxlint proves in seconds
+    (docs/static_analysis.md).  ``MXNET_BENCH_SKIP_LINT=1`` bypasses the
+    gate for a deliberately dirty tree."""
+    if os.environ.get("MXNET_BENCH_SKIP_LINT", "0") == "1":
+        return
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "mxlint.py"),
+         "--scope", "serving", "--json"],
+        capture_output=True, text=True, timeout=600)
+    if proc.returncode == 0:
+        return
+    try:
+        findings = json.loads(proc.stdout).get("findings", [])
+    except ValueError:
+        # the linter itself crashed (or exited on a usage error): no JSON
+        # report — surface its stderr instead of inventing findings
+        if proc.stderr:
+            print(proc.stderr, file=sys.stderr, end="")
+        raise SystemExit(
+            "bench --serve refused: tools/mxlint.py itself failed "
+            "(exit %d) — fix the linter run (or MXNET_BENCH_SKIP_LINT=1 "
+            "to override)" % proc.returncode)
+    for f in findings:
+        print("mxlint: %s:%s: %s %s"
+              % (f.get("path"), f.get("line"), f.get("rule"),
+                 f.get("message")), file=sys.stderr)
+    raise SystemExit(
+        "bench --serve refused: %d serving-scoped mxlint finding(s) — "
+        "fix them (or MXNET_BENCH_SKIP_LINT=1 to override)"
+        % max(len(findings), 1))
+
+
 if __name__ == "__main__":
     if "--overlap" in sys.argv:
         overlap_bench()
     elif "--serve" in sys.argv:
+        _serve_lint_preflight()
         if "--mixed" in sys.argv:
             serve_mixed_bench()
         elif "--prefix" in sys.argv:

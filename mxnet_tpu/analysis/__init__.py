@@ -1,0 +1,41 @@
+"""mxlint: AST-based static analysis that proves this repo's load-bearing
+invariants at lint time instead of diagnosing their violation at runtime
+(docs/static_analysis.md).
+
+Five rule families, each grounded in a real failure mode of this stack:
+
+* trace safety (``trace-host-sync``/``trace-py-branch``/
+  ``trace-shape-branch``) — host syncs and Python control flow inside
+  jit/pjit/scan-traced functions: the retrace/recompile class the PR-2
+  watchdog only catches after the fact.
+* donation discipline (``donate-reuse``/``donate-dup``) — a donated
+  buffer read after the donating call, or donated twice in one call.
+* lock discipline (``lock-unguarded``) — attributes protected by a
+  ``with self._lock`` somewhere but accessed bare in methods reachable
+  from a different thread entry point (submit-vs-scheduler races).
+* registry drift (``env-undocumented``/``env-stale-doc``/
+  ``telemetry-unemitted``/``telemetry-unrendered``/
+  ``chaos-unknown-clause``) — the env-var table, the telemetry report,
+  and the chaos-spec grammar must agree with the code.
+* AOT-shape hygiene (``aot-dynamic-shape``) — serving launch shapes
+  must come from the bucket/warmup tables, never per-request lengths.
+
+Entry points: ``tools/mxlint.py`` (CLI), ``run_tests.sh --lint`` (CI
+gate), ``bench.py --serve`` preflight (``scope='serving'``), and
+``analysis.run(root)`` programmatically.  Suppress a finding with
+``# mxlint: disable=rule-id -- reason`` (the reason is mandatory).
+
+The package imports no jax/numpy: the gate must run on any checkout.
+"""
+from .core import (Finding, Rule, Result, run, all_rules, register,
+                   rule_ids, DEFAULT_TARGETS, SERVING_PATHS)
+
+# importing the rule modules populates the registry
+from . import rules_trace      # noqa: F401
+from . import rules_donation   # noqa: F401
+from . import rules_locks      # noqa: F401
+from . import rules_registry   # noqa: F401
+from . import rules_aot        # noqa: F401
+
+__all__ = ["Finding", "Rule", "Result", "run", "all_rules", "register",
+           "rule_ids", "DEFAULT_TARGETS", "SERVING_PATHS"]

@@ -1,0 +1,347 @@
+"""mxlint core: rule registry, file walking, suppressions, reporters.
+
+The framework is deliberately dependency-free (stdlib ``ast`` only) so the
+lint gate runs anywhere the repo checks out — no jax import, no device.
+
+A rule is a class with a kebab-case ``id`` registered via `@register`.
+Rules see every file once (`check_file`, for local AST checks and for
+collecting project-wide facts) and then run one `check_project` pass for
+cross-file invariants (registry drift: env vars vs docs, telemetry names
+vs the report renderer, chaos clauses vs specs).
+
+Suppressions are per-line comments that MUST carry a reason::
+
+    x = bad_thing()  # mxlint: disable=rule-id -- why this is safe here
+
+A bare ``# mxlint: disable=rule-id`` (no reason) is itself a finding
+(``bad-suppression``): the whole point of a suppression is the recorded
+justification.  A comment-only line suppresses the line directly below
+it; ``disable-file=`` in the first 30 lines suppresses a rule for the
+whole file (same reason requirement).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+REGISTRY = []
+
+
+def register(cls):
+    REGISTRY.append(cls)
+    return cls
+
+
+def all_rules():
+    return [cls() for cls in REGISTRY]
+
+
+def rule_ids(rule):
+    """All finding ids a rule can emit: its primary id plus companion
+    ids declared as UPPERCASE string class attributes."""
+    ids = {rule.id}
+    for attr in dir(rule):
+        if attr.isupper():
+            v = getattr(rule, attr)
+            if isinstance(v, str):
+                ids.add(v)
+    return ids
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+
+    def key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def __str__(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col,
+                                    self.rule, self.message)
+
+
+class Rule:
+    """Base rule.  ``serving`` marks rules included in ``--scope serving``
+    (the bench.py --serve preflight set)."""
+
+    id = None
+    serving = False
+
+    def check_file(self, ctx, project):
+        return []
+
+    def check_project(self, project):
+        return []
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"(?:\s*(?:--|—|:)\s*(.*?))?\s*$")
+
+
+class Suppressions:
+    """Per-file suppression table parsed from the raw source lines."""
+
+    def __init__(self, relpath, lines):
+        self.by_line = {}       # lineno -> {rule: reason}
+        self.file_wide = {}     # rule -> reason
+        self.findings = []      # bad-suppression findings
+        for i, raw in enumerate(lines, 1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            kind, rules_raw, reason = m.groups()
+            rules = [r.strip() for r in rules_raw.split(",") if r.strip()]
+            if not reason:
+                self.findings.append(Finding(
+                    "bad-suppression", relpath, i, 0,
+                    "suppression without a reason: every "
+                    "'mxlint: disable' must say WHY (e.g. "
+                    "'# mxlint: disable=%s -- <reason>')"
+                    % ",".join(rules)))
+                continue
+            if kind == "disable-file":
+                if i > 30:
+                    self.findings.append(Finding(
+                        "bad-suppression", relpath, i, 0,
+                        "disable-file only honored in the first 30 lines"))
+                    continue
+                for r in rules:
+                    self.file_wide[r] = reason
+                continue
+            # a comment-only line covers the next line; an inline trailing
+            # comment covers its own line
+            target = i + 1 if raw.lstrip().startswith("#") else i
+            table = self.by_line.setdefault(target, {})
+            for r in rules:
+                table[r] = reason
+
+    def match(self, finding):
+        reason = self.file_wide.get(finding.rule)
+        if reason is not None:
+            return reason
+        return self.by_line.get(finding.line, {}).get(finding.rule)
+
+
+class FileContext:
+    def __init__(self, root, relpath):
+        self.root = root
+        self.relpath = relpath
+        with open(os.path.join(root, relpath)) as f:
+            self.src = f.read()
+        self.lines = self.src.splitlines()
+        self.tree = ast.parse(self.src, filename=relpath)
+        self.suppressions = Suppressions(relpath, self.lines)
+
+
+class Project:
+    """Shared state across the run: parsed files + rule scratch space.
+
+    ``partial`` is True when the linted set does not cover the full
+    default surface (an explicit subtree/file run, or ``--scope``): the
+    cross-file REVERSE drift checks (stale doc rows, unemitted report
+    metrics) would see missing facts as drift, so they stand down."""
+
+    def __init__(self, root, contexts, partial=False):
+        self.root = root
+        self.contexts = contexts
+        self.partial = partial
+        self.data = {}   # rule scratch: rule id -> whatever it collects
+
+    def read_text(self, relpath):
+        path = os.path.join(self.root, relpath)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return f.read()
+
+
+# Default lint targets: library + tools + entry scripts + tests.  native/
+# (C++) and bench_results/ have no python to lint; __pycache__ is skipped
+# by the walk.
+DEFAULT_TARGETS = ("mxnet_tpu", "tools", "scripts", "examples", "tests",
+                   "bench.py", "__graft_entry__.py")
+
+SERVING_PATHS = ("mxnet_tpu/serving/",)
+
+
+def iter_py_files(root, targets=DEFAULT_TARGETS):
+    for target in targets:
+        path = os.path.join(root, target)
+        if os.path.isfile(path) and target.endswith(".py"):
+            yield target
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.relpath(os.path.join(dirpath, fn), root)
+
+
+class Result:
+    def __init__(self, findings, suppressed, n_files, rules):
+        self.findings = findings       # [Finding], unsuppressed
+        self.suppressed = suppressed   # [(Finding, reason)]
+        self.n_files = n_files
+        self.rules = rules             # rule ids that ran
+
+    @property
+    def ok(self):
+        return not self.findings
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "files": self.n_files,
+            "rules": sorted(self.rules),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [dict(f.to_dict(), reason=r)
+                           for f, r in self.suppressed],
+        }
+
+    def render_text(self, show_suppressed=False):
+        out = []
+        for f in self.findings:
+            out.append(str(f))
+        if show_suppressed:
+            for f, reason in self.suppressed:
+                out.append("%s  [suppressed: %s]" % (f, reason))
+        out.append("mxlint: %d finding%s (%d suppressed) in %d files"
+                   % (len(self.findings),
+                      "" if len(self.findings) == 1 else "s",
+                      len(self.suppressed), self.n_files))
+        return "\n".join(out)
+
+    def render_json(self):
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+def run(root, targets=None, rules=None, scope=None):
+    """Run the lint pass.  ``rules`` filters by rule id; ``scope='serving'``
+    restricts to the serving-marked rules over the serving paths (the
+    bench.py --serve preflight)."""
+    root = os.path.abspath(root)
+    if targets:
+        missing = [t for t in targets
+                   if not os.path.exists(os.path.join(root, t))]
+        if missing:
+            raise ValueError("lint target does not exist: %s"
+                             % ", ".join(missing))
+    rule_objs = all_rules()
+    if scope == "serving":
+        rule_objs = [r for r in rule_objs if r.serving]
+    wanted = None
+    if rules:
+        wanted = set(rules)
+        known = set()
+        for r in rule_objs:
+            known |= rule_ids(r)
+        unknown = wanted - known
+        if unknown:
+            raise ValueError("unknown rule id(s): %s (known: %s)"
+                             % (", ".join(sorted(unknown)),
+                                ", ".join(sorted(known))))
+        rule_objs = [r for r in rule_objs if rule_ids(r) & wanted]
+
+    contexts = []
+    findings = []
+    attempted = set()
+    for relpath in iter_py_files(root, targets or DEFAULT_TARGETS):
+        if scope == "serving" and not any(
+                relpath.startswith(p) for p in SERVING_PATHS):
+            continue
+        attempted.add(relpath)
+        try:
+            ctx = FileContext(root, relpath)
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", relpath,
+                                    e.lineno or 1, 0, str(e.msg)))
+            continue
+        contexts.append(ctx)
+
+    partial = bool(set(iter_py_files(root, DEFAULT_TARGETS)) - attempted)
+    project = Project(root, contexts, partial=partial)
+    for ctx in contexts:
+        findings.extend(ctx.suppressions.findings)
+        for rule in rule_objs:
+            findings.extend(rule.check_file(ctx, project))
+    for rule in rule_objs:
+        findings.extend(rule.check_project(project))
+
+    if wanted is not None:
+        keep = wanted | {"bad-suppression", "parse-error"}
+        findings = [f for f in findings if f.rule in keep]
+
+    supp_table = {c.relpath: c.suppressions for c in contexts}
+    active, suppressed = [], []
+    for f in sorted(findings, key=Finding.key):
+        supp = supp_table.get(f.path)
+        reason = supp.match(f) if supp else None
+        if reason is None:
+            active.append(f)
+        else:
+            suppressed.append((f, reason))
+    return Result(active, suppressed, len(contexts),
+                  [r.id for r in rule_objs])
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def callee_name(node):
+    """Last path component of a call target: jax.jit -> 'jit'."""
+    func = node.func if isinstance(node, ast.Call) else node
+    while isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def dotted(node):
+    """'self._cache' for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def int_consts(node):
+    """donate_argnums literal -> tuple of ints, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None

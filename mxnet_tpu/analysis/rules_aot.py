@@ -1,0 +1,117 @@
+"""AOT-shape hygiene for serving launch sites.
+
+The serving contract is zero steady-state recompiles: every shape that
+reaches a compiled program comes from the fixed bucket/warmup tables
+(``MXNET_SERVE_BUCKETS``/``_PREFILL_BUCKETS``, the block pool geometry).
+An array whose dimensions derive from a PER-REQUEST Python value —
+``len(req.prompt)``, a generated-token count, a position — compiles a
+fresh program per distinct length, which is exactly the retrace storm
+the buckets exist to prevent.  The watchdog catches it at runtime,
+after the bench burned an hour; this rule catches it at lint time.
+
+``aot-dynamic-shape`` fires in ``mxnet_tpu/serving/`` when an array
+constructor (``jnp/np.zeros/ones/full/empty``) or ``.reshape(...)``
+takes a dimension that contains ``len(...)`` or a request-carried
+attribute (``.prompt``/``.generated``/``.ctx``/``.tokens``/
+``.max_new_tokens``/``.pos``), directly or through a local variable.
+Shapes built from ``.shape`` of an existing (already-bucketed) array,
+``self._*`` configuration, or literals stay silent.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, Finding, register, callee_name
+
+_CREATORS = {"zeros", "ones", "full", "empty"}
+_REQ_ATTRS = {"prompt", "generated", "ctx", "tokens", "max_new_tokens",
+              "pos", "resume"}
+_SERVING_PREFIX = "mxnet_tpu/serving/"
+
+
+def _req_tainted(node, tainted):
+    """Does this expression carry a per-request length?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _REQ_ATTRS and not (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return True
+        if node.attr in ("shape", "ndim", "dtype", "size"):
+            return False   # shape of an existing (bucketed) array: static
+        return _req_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        name = callee_name(node) or ""
+        if "bucket" in name:
+            return False   # the sanctioned laundering point: a bucket
+            #                lookup maps any length onto the fixed table
+        if name == "len" and node.args:
+            return True    # any len() in a launch-site dim is per-request
+        return any(_req_tainted(c, tainted)
+                   for c in ast.iter_child_nodes(node))
+    if isinstance(node, ast.IfExp):
+        # `largest if n > largest else bucket_for(n)`: the VALUE is
+        # whichever branch, the test never reaches the shape
+        return _req_tainted(node.body, tainted) or \
+            _req_tainted(node.orelse, tainted)
+    return any(_req_tainted(c, tainted)
+               for c in ast.iter_child_nodes(node))
+
+
+def _taint_fixpoint(fn):
+    tainted = set()
+    for _ in range(10):
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if _req_tainted(node.value, tainted):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+        if not changed:
+            break
+    return tainted
+
+
+@register
+class AotShapeRule(Rule):
+    id = "aot-dynamic-shape"
+    serving = True
+
+    def check_file(self, ctx, project):
+        if not ctx.relpath.startswith(_SERVING_PREFIX):
+            return []
+        findings = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tainted = _taint_fixpoint(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = callee_name(node)
+                is_creator = (
+                    name in _CREATORS and isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("np", "jnp", "numpy", "jax"))
+                is_reshape = (name == "reshape"
+                              and isinstance(func, ast.Attribute))
+                if not (is_creator or is_reshape):
+                    continue
+                dims = node.args[:1] if is_creator else node.args
+                for dim in dims:
+                    if _req_tainted(dim, tainted):
+                        findings.append(Finding(
+                            self.id, ctx.relpath, node.lineno,
+                            node.col_offset,
+                            "array %s in '%s' takes a per-request "
+                            "dimension — serving shapes must come from "
+                            "the bucket/warmup tables or this compiles "
+                            "a new program per request length"
+                            % ("shape" if is_creator else "reshape",
+                               fn.name)))
+                        break
+        return findings

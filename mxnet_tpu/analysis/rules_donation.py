@@ -1,0 +1,269 @@
+"""Donated-buffer discipline.
+
+``jax.jit(fn, donate_argnums=...)`` tells XLA it may reuse the donated
+argument's buffer for the output: after the call the Python object still
+exists but its device buffer is DELETED.  Reading it again raises (best
+case) or — through a numpy round-trip — silently computes on stale host
+bytes.  The repo's hot paths live on donation (the fused updater carries,
+the serving cache pool pair), so the discipline is mechanical:
+
+* ``donate-reuse`` — a variable passed at a donated position is read
+  again after the donating call without being rebound on the way.
+* ``donate-dup``  — one variable passed at two donated positions of the
+  same call (XLA aliases both outputs onto one buffer).
+
+Tracking covers (a) callables bound in the same function scope
+(``g = jax.jit(f, donate_argnums=...)`` … ``g(x)``), (b) class-attribute
+callables (``self._step = jax.jit(...)`` in one method, ``self._step(x)``
+in another), and (c) inline ``jax.jit(f, donate_argnums=...)(x)``.
+``.lower()``/``.trace()``/``.eval_shape()`` calls do NOT consume — they
+never execute the donation.  Loop bodies are walked twice so a read in
+iteration N+1 of a buffer consumed in iteration N is caught.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, Finding, register, callee_name, dotted, int_consts
+
+_JIT_NAMES = {"jit", "pjit"}
+_NONCONSUMING = {"lower", "trace", "eval_shape"}
+
+
+def _donating_jit(node):
+    """donate_argnums tuple if `node` is jit/pjit(..., donate_argnums=L),
+    possibly wrapped (watch_jit(jax.jit(...))); else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if callee_name(node) in _JIT_NAMES:
+        for kw in node.keywords:
+            if kw.arg == "donate_argnums":
+                return int_consts(kw.value)
+        return None
+    # one-level wrapper: watch_jit(jax.jit(...), ...)
+    for arg in node.args[:1]:
+        inner = _donating_jit(arg)
+        if inner is not None:
+            return inner
+    return None
+
+
+def _class_donators(cls):
+    """{ 'self.X': argnums } for self.X = jit(..., donate_argnums=...)"""
+    out = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        path = dotted(node.targets[0])
+        if not path or not path.startswith("self."):
+            continue
+        argnums = _donating_jit(node.value)
+        if argnums:
+            out[path] = argnums
+    return out
+
+
+class _FnState:
+    def __init__(self, donators):
+        self.donators = dict(donators)   # path -> argnums
+        self.consumed = {}               # path -> (line, donator path)
+
+    def copy(self):
+        s = _FnState(self.donators)
+        s.consumed = dict(self.consumed)
+        return s
+
+    def merge(self, other):
+        self.donators.update(other.donators)
+        self.consumed.update(other.consumed)
+
+
+@register
+class DonationRule(Rule):
+    id = "donate-reuse"
+    serving = True
+
+    DUP = "donate-dup"
+
+    def check_file(self, ctx, project):
+        findings = []
+        # class-attribute donators visible to every method of the class
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                donators = _class_donators(node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._run_fn(ctx, item, donators, findings)
+        # module-level functions (no self.* donators)
+        for item in ctx.tree.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._run_fn(ctx, item, {}, findings)
+        return findings
+
+    def _run_fn(self, ctx, fn, class_donators, findings):
+        state = _FnState(class_donators)
+        self._block(ctx, fn.body, state, findings)
+
+    # -- statement walk -----------------------------------------------------
+    def _block(self, ctx, body, state, findings):
+        for stmt in body:
+            self._stmt(ctx, stmt, state, findings)
+
+    def _stmt(self, ctx, stmt, state, findings):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs run later (builder closures): don't conflate
+            # their loads with this scope's consumption state, but DO
+            # analyze them as their own scope
+            self._run_fn(ctx, stmt, {}, findings)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(ctx, stmt.test, state, findings)
+            s1, s2 = state.copy(), state.copy()
+            self._block(ctx, stmt.body, s1, findings)
+            self._block(ctx, stmt.orelse, s2, findings)
+            state.merge(s1)
+            state.merge(s2)
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self._expr(ctx, stmt.iter, state, findings)
+            else:
+                self._expr(ctx, stmt.test, state, findings)
+            # two passes: catch next-iteration reads of consumed buffers
+            seen = set(f.key() for f in findings)
+            self._block(ctx, stmt.body, state, findings)
+            extra = []
+            self._block(ctx, stmt.body, state, extra)
+            findings.extend(f for f in extra if f.key() not in seen
+                            and not any(f.key() == g.key()
+                                        for g in findings))
+            self._block(ctx, stmt.orelse, state, findings)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(ctx, stmt.body, state, findings)
+            for h in stmt.handlers:
+                hs = state.copy()
+                self._block(ctx, h.body, hs, findings)
+                state.merge(hs)
+            self._block(ctx, stmt.orelse, state, findings)
+            self._block(ctx, stmt.finalbody, state, findings)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._expr(ctx, item.context_expr, state, findings)
+            self._block(ctx, stmt.body, state, findings)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self._expr(ctx, value, state, findings)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            # track new donators: g = jax.jit(f, donate_argnums=...)
+            argnums = _donating_jit(value) if value is not None else None
+            for t in targets:
+                self._store(t, state, argnums)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._expr(ctx, stmt.value, state, findings)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                path = dotted(t)
+                if path:
+                    state.consumed.pop(path, None)
+            return
+        # default: scan any expressions hanging off the statement
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(ctx, child, state, findings)
+
+    def _store(self, target, state, argnums=None):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._store(e, state)
+            return
+        path = dotted(target)
+        if path is None:
+            return
+        # rebinding revives the name: it now holds a live buffer
+        for key in [k for k in state.consumed
+                    if k == path or k.startswith(path + ".")]:
+            del state.consumed[key]
+        if argnums:
+            state.donators[path] = argnums
+        else:
+            state.donators.pop(path, None)
+
+    # -- expression walk ----------------------------------------------------
+    def _expr(self, ctx, node, state, findings):
+        """Check loads against consumed state, then apply consumption from
+        any donating calls in this expression."""
+        pending = []   # (path, line, donator) consumptions to apply after
+
+        def walk(n):
+            if isinstance(n, ast.Call):
+                self._call(ctx, n, state, findings, pending)
+                return
+            if isinstance(n, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(n, "ctx", None), ast.Load):
+                path = dotted(n)
+                if path and path in state.consumed:
+                    line, donator = state.consumed[path]
+                    findings.append(Finding(
+                        self.id, ctx.relpath, n.lineno, n.col_offset,
+                        "'%s' read after being donated to '%s' at line "
+                        "%d (its device buffer is consumed)"
+                        % (path, donator, line)))
+                    return  # one finding per path per read site
+                # still walk attribute bases for nested calls
+                for child in ast.iter_child_nodes(n):
+                    walk(child)
+                return
+            if isinstance(n, ast.Lambda):
+                return
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        walk(node)
+        for path, line, donator in pending:
+            state.consumed[path] = (line, donator)
+
+    def _call(self, ctx, call, state, findings, pending):
+        func = call.func
+        # non-consuming compile-time entry points: fn.lower(...), etc.
+        if isinstance(func, ast.Attribute) and func.attr in _NONCONSUMING:
+            for child in ast.iter_child_nodes(call):
+                self._expr(ctx, child, state, findings)
+            return
+        fpath = dotted(func)
+        argnums = state.donators.get(fpath) if fpath else None
+        if argnums is None:
+            argnums = _donating_jit(func)  # inline jit(...)(args)
+            fpath = fpath or "<inline jit>"
+        # walk func + args as loads first (reads happen before the call)
+        for child in ast.iter_child_nodes(call):
+            self._expr(ctx, child, state, findings)
+        if not argnums:
+            return
+        seen = {}
+        for pos in argnums:
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            path = dotted(arg)
+            if path is None:
+                continue
+            if path in seen:
+                findings.append(Finding(
+                    self.DUP, ctx.relpath, call.lineno, call.col_offset,
+                    "'%s' donated twice in one call to '%s' (argnums %d "
+                    "and %d alias one buffer)"
+                    % (path, fpath, seen[path], pos)))
+            else:
+                seen[path] = pos
+                pending.append((path, call.lineno, fpath))

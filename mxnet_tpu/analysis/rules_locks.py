@@ -1,0 +1,306 @@
+"""Lock discipline: a lightweight cross-thread race detector.
+
+For every class that owns a ``threading.Lock``/``RLock`` attribute (the
+serving engine/router, the journal, the session store, the allocator
+family, telemetry), the rule infers the PROTECTED SET — attributes ever
+accessed inside a ``with self._lock:`` block (Conditions constructed
+from a lock count as aliases of it).  It then builds the intra-class
+call graph, splits entry points into thread groups —
+
+* **background**: methods passed to ``threading.Thread(target=...)``
+  anywhere in the class (scheduler loops, monitors), and
+* **caller**: public methods (the submit/result/drain surface any
+  thread may call),
+
+— and reports ``lock-unguarded`` for each access to a protected
+attribute that happens (a) outside every lock region, (b) in a method
+reachable from an entry point, when (c) the attribute is touched from
+MORE THAN ONE thread group (a single-group attribute has no race
+partner).  This is exactly the submit-vs-scheduler shape the PR-13/14
+review fixes patched by hand.
+
+Knowns that keep the noise honest:
+
+* ``__init__`` is exempt (thread creation is a happens-before edge).
+* ``warmup`` is exempt by serving contract: it runs to completion
+  before ``start()`` spawns the scheduler and before the engine is
+  handed to a router (docs/serving.md).
+* A method whose every intra-class call site sits inside a lock region
+  (directly, or in an always-guarded caller) is treated as lock-held.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, Finding, register, callee_name, dotted
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+_EXEMPT_METHODS = {"__init__", "__del__", "__repr__", "warmup"}
+
+
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "discard", "clear", "update", "setdefault", "add",
+             "popitem", "move_to_end"}
+
+
+class _Access:
+    __slots__ = ("attr", "method", "line", "col", "guarded", "is_store",
+                 "mutates")
+
+    def __init__(self, attr, method, line, col, guarded, is_store,
+                 mutates):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.col = col
+        self.guarded = guarded
+        self.is_store = is_store
+        self.mutates = mutates
+
+
+class _ClassInfo:
+    def __init__(self, node):
+        self.node = node
+        self.locks = set()        # attr names that ARE locks
+        self.aliases = {}         # condition attr -> lock attr (or itself)
+        self.methods = {}         # name -> FunctionDef
+        self.accesses = []        # [_Access]
+        self.calls = {}           # method -> [(callee, guarded)]
+        self.thread_targets = set()
+        self.method_names = set()
+
+
+def _collect_class(cls):
+    info = _ClassInfo(cls)
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    info.method_names = set(info.methods)
+
+    # pass 1: lock/condition attributes + thread targets
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            path = dotted(node.targets[0])
+            if path and path.startswith("self.") and \
+                    isinstance(node.value, ast.Call):
+                name = callee_name(node.value)
+                attr = path[5:]
+                if name in _LOCK_CTORS:
+                    info.locks.add(attr)
+                elif name in _COND_CTORS:
+                    base = None
+                    if node.value.args:
+                        base_path = dotted(node.value.args[0])
+                        if base_path and base_path.startswith("self."):
+                            base = base_path[5:]
+                    info.aliases[attr] = base or attr
+                    info.locks.add(attr)
+        elif isinstance(node, ast.Call) and callee_name(node) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    path = dotted(kw.value)
+                    if path and path.startswith("self."):
+                        info.thread_targets.add(path[5:])
+    if not info.locks:
+        return None
+
+    def canon(attr):
+        return info.aliases.get(attr, attr)
+
+    lock_names = info.locks | set(info.aliases)
+
+    # pass 2: per-method accesses with guarded-region tracking.  A
+    # "mutating" access is a Store/Del, a `self.X[...] = ...` subscript
+    # store, or a `self.X.append(...)`-style container-mutator call —
+    # the protected set is restricted to attributes someone MUTATES, so
+    # reads of immutable config under an incidental lock don't poison it.
+    for mname, fn in info.methods.items():
+        def self_attr(node):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        def walk(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                g = guarded
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        path = dotted(item.context_expr)
+                        if path and path.startswith("self.") and \
+                                path[5:] in lock_names:
+                            g = g | {canon(path[5:])}
+                    for item in child.items:
+                        walk(item.context_expr, guarded)
+                    for stmt in child.body:
+                        walk(stmt, g)
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # nested scope: not this method's accesses
+                attr = self_attr(child)
+                if attr is not None and attr not in lock_names:
+                    is_store = isinstance(child.ctx, (ast.Store, ast.Del))
+                    info.accesses.append(_Access(
+                        attr, mname, child.lineno, child.col_offset,
+                        bool(g), is_store, is_store))
+                if isinstance(child, ast.Subscript) and \
+                        isinstance(child.ctx, (ast.Store, ast.Del)):
+                    attr = self_attr(child.value)
+                    if attr is not None and attr not in lock_names:
+                        info.accesses.append(_Access(
+                            attr, mname, child.lineno,
+                            child.value.col_offset, bool(g), False, True))
+                if isinstance(child, ast.Call):
+                    fpath = dotted(child.func)
+                    if fpath and fpath.startswith("self.") and \
+                            fpath[5:] in info.method_names:
+                        info.calls.setdefault(mname, []).append(
+                            (fpath[5:], bool(g)))
+                        # the method attr itself is not state: drop the
+                        # Attribute access just recorded for the func
+                        info.accesses = [
+                            a for a in info.accesses
+                            if not (a.method == mname
+                                    and a.line == child.func.lineno
+                                    and a.col == child.func.col_offset
+                                    and a.attr == fpath[5:])]
+                    elif isinstance(child.func, ast.Attribute) and \
+                            child.func.attr in _MUTATORS:
+                        attr = self_attr(child.func.value)
+                        if attr is not None and attr not in lock_names:
+                            info.accesses.append(_Access(
+                                attr, mname, child.lineno,
+                                child.func.value.col_offset, bool(g),
+                                False, True))
+                walk(child, g)
+        walk(fn, frozenset())
+    return info
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "lock-unguarded"
+    serving = True
+
+    def check_file(self, ctx, project):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                info = _collect_class(node)
+                if info is not None:
+                    self._check_class(ctx, info, findings)
+        return findings
+
+    def _check_class(self, ctx, info, findings):
+        # method accesses filtered: a self.X that is a known method name
+        # and only ever appears as a call target was stripped in pass 2;
+        # here also drop accesses naming methods (bound-method reads)
+        accesses = [a for a in info.accesses
+                    if a.attr not in info.method_names]
+
+        # protected = guarded somewhere AND mutated somewhere outside
+        # __init__ (an attribute nobody mutates post-construction has no
+        # race to protect against)
+        guarded_attrs = {a.attr for a in accesses if a.guarded}
+        mutated_attrs = {a.attr for a in accesses
+                         if a.mutates and a.method != "__init__"}
+        protected = guarded_attrs & mutated_attrs
+        if not protected:
+            return
+
+        # always-guarded methods (fixpoint over the call graph)
+        callsites = {}   # callee -> [guarded?]
+        for caller, edges in info.calls.items():
+            for callee, guarded in edges:
+                callsites.setdefault(callee, []).append((caller, guarded))
+        always_guarded = set()
+        for _ in range(len(info.methods) + 1):
+            changed = False
+            for m, sites in callsites.items():
+                if m in always_guarded:
+                    continue
+                if sites and all(g or c in always_guarded
+                                 for c, g in sites):
+                    always_guarded.add(m)
+                    changed = True
+            if not changed:
+                break
+
+        # thread groups + reachability.  A public method that is also
+        # reachable from a Thread target (e.g. ServingEngine.step: the
+        # scheduler-loop body, public only for the manual single-thread
+        # drive mode) belongs to the BACKGROUND group — the two drive
+        # modes are mutually exclusive by contract, so its public-ness
+        # is not a second thread.
+        bg_entries = set(info.thread_targets)
+
+        def reach(entries):
+            seen = set(entries)
+            stack = list(entries)
+            while stack:
+                m = stack.pop()
+                for callee, _ in info.calls.get(m, ()):
+                    if callee not in seen:
+                        seen.add(callee)
+                        stack.append(callee)
+            return seen
+
+        bg_reach = reach(bg_entries)
+        caller_entries = {m for m in info.methods
+                          if not m.startswith("_") and
+                          m not in bg_reach and
+                          m not in _EXEMPT_METHODS}
+        caller_reach = reach(caller_entries)
+
+        def groups_of(method):
+            g = set()
+            if method in bg_reach:
+                g.add("background")
+            if method in caller_reach:
+                g.add("caller")
+            return g
+
+        # per-attr access census by group (guarded accesses included:
+        # the guarded half of a race pair is still a pair)
+        writes_by, touch_by = {}, {}
+        for a in accesses:
+            if a.attr not in protected or a.method in _EXEMPT_METHODS:
+                continue
+            for g in groups_of(a.method):
+                touch_by.setdefault(a.attr, {}).setdefault(
+                    g, (a.method, a.line))
+                if a.mutates:
+                    writes_by.setdefault(a.attr, {}).setdefault(
+                        g, (a.method, a.line))
+
+        for a in accesses:
+            if a.guarded or a.attr not in protected:
+                continue
+            if a.method in _EXEMPT_METHODS or a.method in always_guarded:
+                continue
+            gs = groups_of(a.method)
+            if not gs:
+                continue   # unreachable from any entry point
+            # a race needs a partner in ANOTHER group, with a write on
+            # at least one side
+            partner = None
+            for g, site in (touch_by.get(a.attr, {}) if a.mutates
+                            else writes_by.get(a.attr, {})).items():
+                if g not in gs:
+                    partner = (g, site)
+                    break
+            if partner is None:
+                continue
+            findings.append(Finding(
+                self.id, ctx.relpath, a.line, a.col,
+                "'self.%s' %s outside '%s' in %s.%s() — races with the "
+                "%s-thread access in %s() (line %d); the attribute is "
+                "lock-protected elsewhere"
+                % (a.attr, "written" if a.mutates else "read",
+                   "/".join(sorted(info.locks - set(info.aliases))
+                            or info.locks),
+                   info.node.name, a.method,
+                   partner[0], partner[1][0], partner[1][1])))

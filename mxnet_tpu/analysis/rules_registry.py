@@ -1,0 +1,508 @@
+"""Registry drift: the contracts that live half in code, half in docs.
+
+* ``env-undocumented`` / ``env-stale-doc`` — every ``MXNET_*`` variable
+  READ in code (``os.environ.get``/``os.getenv``/``os.environ[...]`` or
+  a local ``_env*`` helper with a literal first argument) must have a
+  table row in ``docs/env_vars.md``, and every documented row must still
+  be read somewhere.  The env-var surface IS the ops interface; a knob
+  that exists only in code is undiscoverable, a row for a deleted knob
+  is a lie.
+* ``telemetry-unemitted`` / ``telemetry-unrendered`` — every metric
+  name or per-replica suffix rendered by ``tools/telemetry_report.py``
+  must be emitted somewhere (``telemetry.inc``/``set_gauge``/
+  ``observe``/``record_event``), and every emitted ``serve.*`` counter /
+  ``serve_*`` event must have a report row.  Emissions through
+  ``"serve.%s" % what``-style helpers are resolved by substituting the
+  literal arguments found at the helper's same-file call sites.
+* ``chaos-unknown-clause`` — every clause named in an ``MXNET_CHAOS``
+  spec (tests, bench, nightly.sh) must be parsed by ``chaos.py``; a
+  typo'd clause would otherwise fail the whole spec at runtime, mid-
+  nightly.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Rule, Finding, register, callee_name, dotted, str_const
+
+_ENV_VAR_RE = re.compile(r"^MXNET_[A-Z0-9_]+$")
+_DOC_ROW_RE = re.compile(r"`(MXNET_[A-Z0-9_]+)`")
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+_METRIC_SUFFIX_RE = re.compile(r"^\.[a-z0-9_.]+$")
+_EVENT_KIND_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+ENV_DOC = "docs/env_vars.md"
+REPORT = "tools/telemetry_report.py"
+CHAOS_MODULE = "mxnet_tpu/chaos.py"
+
+
+# ---------------------------------------------------------------------------
+# env vars vs docs/env_vars.md
+# ---------------------------------------------------------------------------
+
+def _env_reads(tree):
+    """[(var, line, col)] env-var READS in one module."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            path = dotted(node.func) or ""
+            name = callee_name(node) or ""
+            is_env_call = (
+                path.endswith("environ.get") or
+                path.endswith("os.getenv") or name == "getenv" or
+                name.startswith("_env"))
+            if is_env_call and node.args:
+                var = str_const(node.args[0])
+                if var and _ENV_VAR_RE.match(var):
+                    out.append((var, node.lineno, node.col_offset))
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            path = dotted(node.value) or ""
+            if path.endswith("environ"):
+                var = str_const(node.slice)
+                if var and _ENV_VAR_RE.match(var):
+                    out.append((var, node.lineno, node.col_offset))
+    return out
+
+
+@register
+class EnvDocRule(Rule):
+    id = "env-undocumented"
+    STALE = "env-stale-doc"
+
+    def check_file(self, ctx, project):
+        reads = project.data.setdefault("env-reads", {})
+        for var, line, col in _env_reads(ctx.tree):
+            reads.setdefault(var, (ctx.relpath, line, col))
+        return []
+
+    def check_project(self, project):
+        findings = []
+        doc = project.read_text(ENV_DOC)
+        if doc is None:
+            return [Finding(self.id, ENV_DOC, 1, 0,
+                            "%s is missing" % ENV_DOC)]
+        documented = {}
+        for i, line in enumerate(doc.splitlines(), 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            for var in _DOC_ROW_RE.findall(line):
+                documented.setdefault(var, i)
+        reads = project.data.get("env-reads", {})
+        for var in sorted(set(reads) - set(documented)):
+            path, line, col = reads[var]
+            findings.append(Finding(
+                self.id, path, line, col,
+                "env var %s is read here but has no row in %s"
+                % (var, ENV_DOC)))
+        # reverse (stale-row) check only on a full-surface run: a subtree
+        # run has not seen the reads that keep most rows alive
+        if not project.partial:
+            for var in sorted(set(documented) - set(reads)):
+                findings.append(Finding(
+                    self.STALE, ENV_DOC, documented[var], 0,
+                    "documented env var %s is read nowhere in the tree "
+                    "(stale row?)" % var))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# telemetry names vs tools/telemetry_report.py
+# ---------------------------------------------------------------------------
+
+_EMIT_METHODS = {"inc", "set_gauge", "observe", "counter", "gauge",
+                 "histogram"}
+WILD = "\x00"
+
+
+def _name_patterns(node):
+    """Metric-name expression -> [(pattern, dynamic_param)] where the
+    pattern uses WILD for unknown segments and dynamic_param names the
+    single parameter feeding one wildcard (for call-site substitution).
+    An IfExp contributes both branches; a fully-dynamic expression
+    contributes nothing resolvable ([(None, None)])."""
+    s = str_const(node)
+    if s is not None:
+        return [(s, None)]
+    if isinstance(node, ast.IfExp):
+        return _name_patterns(node.body) + _name_patterns(node.orelse)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        out = []
+        for left, _ in _name_patterns(node.left):
+            if left is None or WILD in left or "%s" not in left:
+                continue
+            param = None
+            right = node.right
+            vals = right.elts if isinstance(right, ast.Tuple) else [right]
+            if len(vals) == 1 and isinstance(vals[0], ast.Name) and \
+                    left.count("%s") == 1:
+                param = vals[0].id
+            out.append((left.replace("%s", WILD).replace("%d", WILD),
+                        param))
+        return out or [(None, None)]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        out = []
+        for lp, _ in _name_patterns(node.left):
+            for rp, _ in _name_patterns(node.right):
+                lp2 = lp if lp is not None else WILD
+                rp2 = rp if rp is not None else WILD
+                if lp2 == WILD and rp2 == WILD:
+                    continue
+                param = None
+                if lp2 == WILD and isinstance(node.left, ast.Name):
+                    param = node.left.id
+                if rp2 == WILD and isinstance(node.right, ast.Name):
+                    param = node.right.id
+                out.append((lp2 + rp2, param))
+        return out or [(None, None)]
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append(WILD)
+        pat = "".join(parts)
+        return [(pat, None)] if pat.strip(WILD) else [(None, None)]
+    if isinstance(node, ast.Name):
+        return [(WILD, node.id)]
+    return [(None, None)]
+
+
+def _fn_params(fn):
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+class _Emissions:
+    def __init__(self):
+        self.literals = {}    # name -> (path, line, col), counters etc.
+        self.counter_literals = {}   # inc()/set_gauge()/observe() full
+        #                              literals, for the reverse check
+        self.patterns = []    # pattern strings with WILD
+        self.event_kinds = {}  # kind -> (path, line, col)
+
+    def add_name(self, name, where, is_counter):
+        if WILD in name:
+            self.patterns.append(name)
+            return
+        self.literals.setdefault(name, where)
+        if is_counter:
+            self.counter_literals.setdefault(name, where)
+
+    def emitted(self, name):
+        if name in self.literals:
+            return True
+        return any(_pat_match(p, name) for p in self.patterns)
+
+    def emitted_suffix(self, suffix):
+        """Per-replica suffixes/fragments render as `.blocks_free`-style
+        tails matched against `serve.<name>.` + literal emissions; the
+        emission side's replica prefix is a wildcard, so match on the
+        literal tail (dot stripped)."""
+        frag = suffix.lstrip(".")
+        if any(frag in n for n in self.literals):
+            return True
+        for p in self.patterns:
+            if any(frag in part for part in p.split(WILD) if part):
+                return True
+        return False
+
+    def rendered_by(self, rendered_names, rendered_suffixes,
+                    name):
+        if name in rendered_names:
+            return True
+        return any(name.endswith(s) for s in rendered_suffixes)
+
+
+def _pat_match(pattern, name):
+    rx = ".*".join(re.escape(part) for part in pattern.split(WILD))
+    return re.fullmatch(rx, name) is not None
+
+
+@register
+class TelemetryDriftRule(Rule):
+    id = "telemetry-unemitted"
+    UNRENDERED = "telemetry-unrendered"
+
+    def check_file(self, ctx, project):
+        if ctx.relpath == REPORT:
+            self._collect_rendered(ctx, project)
+            return []
+        if not (ctx.relpath.startswith("mxnet_tpu/")
+                or ctx.relpath in ("bench.py",)
+                or ctx.relpath.startswith("tools/")):
+            return []
+        self._collect_emissions(ctx, project)
+        return []
+
+    # -- emission side ------------------------------------------------------
+    def _collect_emissions(self, ctx, project):
+        em = project.data.setdefault("telemetry-emissions", _Emissions())
+        # enclosing-function map for call-site parameter substitution
+        templates = []   # (funcname, param, prefix_pattern)
+        calls = []       # (funcname, args, keywords)
+
+        def enclosing_defs(tree):
+            stack = []
+
+            def visit(node, fns):
+                for child in ast.iter_child_nodes(node):
+                    nfns = fns
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        nfns = fns + [child]
+                    yield child, nfns
+                    yield from visit(child, nfns)
+            yield from visit(tree, stack)
+
+        for node, fns in enclosing_defs(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = callee_name(node)
+            calls.append((fname, node))
+            if fname == "record_event" and node.args:
+                kind = str_const(node.args[0])
+                if kind:
+                    em.event_kinds.setdefault(
+                        kind, (ctx.relpath, node.lineno, node.col_offset))
+                continue
+            # any inc/set_gauge/observe/counter/... call counts as an
+            # emission site — the method names are distinctive enough
+            # that a generous match only makes the forward check safer
+            if not (fname in _EMIT_METHODS and node.args):
+                continue
+            where = (ctx.relpath, node.lineno, node.col_offset)
+            for pattern, param in _name_patterns(node.args[0]):
+                if pattern is None:
+                    continue
+                reverse = fname in ("inc", "set_gauge", "observe")
+                if param and fns and param in _fn_params(fns[-1]):
+                    templates.append((fns[-1].name, fns[-1], param,
+                                      pattern, reverse))
+                    continue
+                em.add_name(pattern, where, reverse)
+
+        # substitute call-site literals into helper templates
+        for tname, tfn, param, pattern, is_counter in templates:
+            params = _fn_params(tfn)
+            try:
+                pos = params.index(param)
+            except ValueError:
+                continue
+            skip_self = 1 if params and params[0] == "self" else 0
+            found = False
+            for fname, call in calls:
+                if fname != tname or call is None:
+                    continue
+                lit = None
+                argpos = pos - skip_self
+                if 0 <= argpos < len(call.args):
+                    lit = str_const(call.args[argpos])
+                if lit is None:
+                    for kw in call.keywords:
+                        if kw.arg == param:
+                            lit = str_const(kw.value)
+                if lit is not None:
+                    found = True
+                    name = pattern.replace(WILD, lit, 1)
+                    em.add_name(
+                        name, (ctx.relpath, call.lineno, call.col_offset),
+                        is_counter)
+            if not found:
+                # unresolvable helper: keep the wildcard so the forward
+                # check stays sound (it just can't prove drift through it)
+                em.patterns.append(pattern)
+
+    # -- rendered side ------------------------------------------------------
+    def _collect_rendered(self, ctx, project):
+        rendered = project.data.setdefault("telemetry-rendered", {
+            "names": {}, "suffixes": {}, "kinds": {}})
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                s = node.value
+                where = (node.lineno, node.col_offset)
+                if _METRIC_NAME_RE.match(s):
+                    rendered["names"].setdefault(s, where)
+                elif _METRIC_SUFFIX_RE.match(s):
+                    rendered["suffixes"].setdefault(s, where)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and \
+                        t.id.endswith("_EVENT_KINDS"):
+                    vals = node.value.elts if isinstance(
+                        node.value, (ast.Tuple, ast.List)) else []
+                    for e in vals:
+                        k = str_const(e)
+                        if k and _EVENT_KIND_RE.match(k):
+                            rendered["kinds"].setdefault(
+                                k, (e.lineno, e.col_offset))
+            elif isinstance(node, ast.Compare):
+                # e.get("kind") == "serve_x" comparisons render an event
+                sides = [node.left] + list(node.comparators)
+                has_kind_get = any(
+                    isinstance(x, ast.Call) and callee_name(x) == "get"
+                    and x.args and str_const(x.args[0]) == "kind"
+                    for x in sides)
+                if has_kind_get:
+                    for x in sides:
+                        k = str_const(x)
+                        if k and _EVENT_KIND_RE.match(k):
+                            rendered["kinds"].setdefault(
+                                k, (x.lineno, x.col_offset))
+
+    def check_project(self, project):
+        findings = []
+        if project.partial:
+            # both directions need the full emission + rendering surface:
+            # a subtree run would read every unseen emission as drift
+            return findings
+        em = project.data.get("telemetry-emissions", _Emissions())
+        rendered = project.data.get("telemetry-rendered")
+        if rendered is None:
+            return findings
+        for name, (line, col) in sorted(rendered["names"].items()):
+            if not em.emitted(name):
+                findings.append(Finding(
+                    self.id, REPORT, line, col,
+                    "report renders metric '%s' but nothing in the tree "
+                    "emits it" % name))
+        for suffix, (line, col) in sorted(rendered["suffixes"].items()):
+            if not em.emitted_suffix(suffix):
+                findings.append(Finding(
+                    self.id, REPORT, line, col,
+                    "report renders per-replica suffix '%s' but nothing "
+                    "emits a matching gauge" % suffix))
+        for kind, (line, col) in sorted(rendered["kinds"].items()):
+            if kind not in em.event_kinds:
+                findings.append(Finding(
+                    self.id, REPORT, line, col,
+                    "report renders event kind '%s' but nothing calls "
+                    "record_event(%r)" % (kind, kind)))
+        # reverse: serving counters/events emitted but never rendered
+        names = set(rendered["names"])
+        suffixes = set(rendered["suffixes"])
+        for name, (path, line, col) in sorted(
+                em.counter_literals.items()):
+            if not name.startswith("serve."):
+                continue
+            if em.rendered_by(names, suffixes, name):
+                continue
+            findings.append(Finding(
+                self.UNRENDERED, path, line, col,
+                "serving metric '%s' is emitted here but %s never "
+                "renders it (add a report row or drop the metric)"
+                % (name, REPORT)))
+        for kind, (path, line, col) in sorted(em.event_kinds.items()):
+            if not kind.startswith("serve_"):
+                continue
+            if kind not in rendered["kinds"]:
+                findings.append(Finding(
+                    self.UNRENDERED, path, line, col,
+                    "serving event kind '%s' is emitted here but %s "
+                    "never renders it" % (kind, REPORT)))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# chaos clauses vs chaos.py
+# ---------------------------------------------------------------------------
+
+_SH_SPEC_RE = re.compile(r'MXNET_CHAOS="?([A-Za-z0-9_:.,+-]+)"?')
+_CLAUSE_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _spec_clauses(spec):
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        yield clause.split(":")[0]
+
+
+def _chaos_defined(tree):
+    """Clause names chaos.py parses: string comparisons against `kind`."""
+    defined = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Name) and \
+                node.left.id == "kind":
+            for comp in node.comparators:
+                k = str_const(comp)
+                if k:
+                    defined.add(k)
+    return defined
+
+
+@register
+class ChaosClauseRule(Rule):
+    id = "chaos-unknown-clause"
+
+    def check_file(self, ctx, project):
+        if ctx.relpath == CHAOS_MODULE:
+            project.data.setdefault(
+                "chaos-defined", set()).update(_chaos_defined(ctx.tree))
+        uses = project.data.setdefault("chaos-uses", [])
+        for node in ast.walk(ctx.tree):
+            spec = None
+            if isinstance(node, ast.Assign):
+                # os.environ["MXNET_CHAOS"] = "..." / d["MXNET_CHAOS"] = x
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            str_const(t.slice) == "MXNET_CHAOS":
+                        spec = str_const(node.value)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if k is not None and str_const(k) == "MXNET_CHAOS":
+                        spec = str_const(v)
+            elif isinstance(node, ast.Call):
+                args = list(node.args)
+                for i, a in enumerate(args[:-1]):
+                    if str_const(a) == "MXNET_CHAOS":
+                        spec = str_const(args[i + 1])
+            if spec:
+                uses.append((ctx.relpath, node.lineno, spec))
+        return []
+
+    def check_project(self, project):
+        findings = []
+        defined = project.data.get("chaos-defined", set())
+        if not defined:
+            # subtree run that excluded chaos.py: load the reference
+            # module directly so the forward check stays meaningful
+            text = project.read_text(CHAOS_MODULE)
+            if text:
+                try:
+                    defined = _chaos_defined(ast.parse(text))
+                except SyntaxError:
+                    pass
+        if not defined:
+            return [Finding(self.id, CHAOS_MODULE, 1, 0,
+                            "could not extract any clause names from "
+                            "chaos.py (parser drift?)")]
+        uses = list(project.data.get("chaos-uses", []))
+        # shell specs: nightly.sh / run_tests.sh / scripts/*.sh
+        shell_files = ["tests/nightly.sh", "run_tests.sh"]
+        scripts_dir = os.path.join(project.root, "scripts")
+        if os.path.isdir(scripts_dir):
+            shell_files += sorted(
+                "scripts/" + f for f in os.listdir(scripts_dir)
+                if f.endswith(".sh"))
+        for sh in shell_files:
+            text = project.read_text(sh)
+            if not text:
+                continue
+            for i, line in enumerate(text.splitlines(), 1):
+                m = _SH_SPEC_RE.search(line)
+                if m:
+                    uses.append((sh, i, m.group(1)))
+        for path, line, spec in uses:
+            for name in _spec_clauses(spec):
+                if not _CLAUSE_NAME_RE.match(name):
+                    continue   # not a clause spec after all
+                if name not in defined:
+                    findings.append(Finding(
+                        self.id, path, line, 0,
+                        "MXNET_CHAOS clause '%s' is not parsed by "
+                        "chaos.py (known: %s)"
+                        % (name, ", ".join(sorted(defined)))))
+        return findings

@@ -1,0 +1,378 @@
+"""Trace-safety rules: host syncs and Python control flow inside traced
+functions.
+
+A function is "traced" when it is (a) decorated with ``jit``/``pjit``
+(bare or via ``partial``), (b) passed to ``jax.jit``/``pjit``/
+``lax.scan``/``shard_map`` anywhere in the module, or (c) defined inside
+a traced function (closures only ever run at trace time).  Inside a
+traced body, values derived from the function's parameters are tracers:
+
+* ``trace-host-sync`` — ``.item()``/``.tolist()``, ``float()``/``int()``/
+  ``bool()`` casts, or ``np.*`` calls on a tracer-derived value.  Each
+  forces a device→host readback (or a concretization error) mid-trace —
+  the class of bug the PR-2 retrace watchdog only diagnoses at runtime.
+* ``trace-py-branch`` — ``if``/``while``/``assert``/ternary on a
+  tracer-derived VALUE.  Tracers have no truth value; this either raises
+  at trace time or (via a cached host value) silently bakes one branch
+  into the program.
+* ``trace-shape-branch`` — ``if`` on a tracer's ``.shape``/``.ndim``/
+  ``len()``.  Legal (shapes are static) but every distinct shape traces
+  a distinct program: under the serving AOT-bucket contract this is a
+  retrace risk, so it must be deliberate.  Validation branches whose
+  body only raises are exempt — trace-time shape checks are idiomatic.
+
+Taint is per-parameter and flows through assignments to a fixpoint;
+``.shape``/``.ndim``/``.dtype``/``len()`` launder value-taint into
+shape-taint (branching on them is the weaker finding).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, Finding, register, callee_name
+
+_JIT_NAMES = {"jit", "pjit"}
+_WRAP_NAMES = {"jit", "pjit", "scan", "shard_map", "checkpoint_wrapper"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_CAST_NAMES = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+_NP_MODULES = {"np", "numpy", "onp"}
+
+
+def _is_jit_decorator(dec):
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @functools.partial(...)"""
+    if callee_name(dec) in _JIT_NAMES and not isinstance(dec, ast.Call):
+        return True
+    if isinstance(dec, ast.Call):
+        if callee_name(dec) in _JIT_NAMES:
+            return True
+        if callee_name(dec) == "partial" and dec.args:
+            return callee_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _traced_defs(tree):
+    """All FunctionDef nodes in the module that get traced, plus every
+    def nested inside one of them."""
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                traced.add(node)
+        elif isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name not in _WRAP_NAMES or not node.args:
+                continue
+            target = node.args[0]
+            if name == "partial":
+                continue
+            if isinstance(target, ast.Name):
+                for d in defs_by_name.get(target.id, ()):
+                    traced.add(d)
+    # nested defs inherit traced-ness
+    out = set(traced)
+    for d in traced:
+        for node in ast.walk(d):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.add(node)
+    return out
+
+
+class _Taint:
+    """(value_tainted, shape_tainted) of an expression under a taint env."""
+
+    def __init__(self, vtaint, staint):
+        self.vtaint = vtaint
+        self.staint = staint
+
+    def of(self, node):
+        v = s = False
+        if isinstance(node, ast.Name):
+            return (node.id in self.vtaint, node.id in self.staint)
+        if isinstance(node, ast.Attribute):
+            bv, bs = self.of(node.value)
+            if node.attr in _SHAPE_ATTRS:
+                return (False, bv or bs)
+            return (bv, bs)
+        if isinstance(node, ast.Call):
+            if callee_name(node) == "len" and node.args:
+                av, as_ = self.of(node.args[0])
+                return (False, av or as_)
+            for child in ast.iter_child_nodes(node):
+                cv, cs = self.of(child)
+                v, s = v or cv, s or cs
+            return (v, s)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._of_comp(node)
+        for child in ast.iter_child_nodes(node):
+            cv, cs = self.of(child)
+            v, s = v or cv, s or cs
+        return (v, s)
+
+    def _of_comp(self, node):
+        """Comprehensions: bind targets to the iterable's taint, then
+        evaluate the element under the extended environment.  Iterating
+        ``d.items()`` of a traced dict taints only the VALUE target —
+        pytree keys are static Python structure, not tracer data."""
+        inner = _Taint(set(self.vtaint), set(self.staint))
+        for gen in node.generators:
+            iv, is_ = inner.of(gen.iter)
+            names = []
+
+            def flat(t):
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        flat(e)
+                elif isinstance(t, ast.Name):
+                    names.append(t.id)
+            flat(gen.target)
+            it = gen.iter
+            itname = callee_name(it) if isinstance(it, ast.Call) else None
+            if itname == "keys":
+                names = []
+            elif itname == "items" and isinstance(
+                    gen.target, ast.Tuple) and len(gen.target.elts) == 2 \
+                    and isinstance(gen.target.elts[0], ast.Name):
+                names = [n for n in names
+                         if n != gen.target.elts[0].id]
+            for n in names:
+                if iv:
+                    inner.vtaint.add(n)
+                if is_:
+                    inner.staint.add(n)
+        parts = [node.key, node.value] if isinstance(node, ast.DictComp) \
+            else [node.elt]
+        v = s = False
+        for p in parts + [i for g in node.generators for i in g.ifs]:
+            pv, ps = inner.of(p)
+            v, s = v or pv, s or ps
+        return (v, s)
+
+
+def _test_taint(node, taint):
+    """Taint of a branch TEST, with the static-at-trace idioms exempted:
+    ``x is None`` / ``x in d`` (object identity / container structure,
+    never tracer data) and ``isinstance(x, T)``."""
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in node.ops):
+            return (False, False)
+        return taint.of(node)
+    if isinstance(node, ast.Call) and callee_name(node) in (
+            "isinstance", "hasattr", "callable", "getattr"):
+        return (False, False)
+    if isinstance(node, ast.BoolOp):
+        v = s = False
+        for val in node.values:
+            cv, cs = _test_taint(val, taint)
+            v, s = v or cv, s or cs
+        return (v, s)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _test_taint(node.operand, taint)
+    return taint.of(node)
+
+
+def _assign_targets(node):
+    out = []
+
+    def flat(t):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                flat(e)
+        elif isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Starred):
+            flat(t.value)
+    for t in (node.targets if isinstance(node, ast.Assign)
+              else [node.target]):
+        flat(t)
+    return out
+
+
+def _taint_env(fn, inherited):
+    """Fixpoint taint sets for one traced function body.
+
+    Parameters WITH DEFAULTS are not tainted: the ``def f(x, _flag=flag)``
+    closure-binding idiom passes static Python config through the
+    signature, and jit call sites never supply those positions (a traced
+    boolean there would already fail at trace time)."""
+    pos = fn.args.posonlyargs + fn.args.args
+    n_def = len(fn.args.defaults)
+    defaulted = {a.arg for a in pos[len(pos) - n_def:]} if n_def else set()
+    defaulted |= {a.arg for a, d in zip(fn.args.kwonlyargs,
+                                        fn.args.kw_defaults)
+                  if d is not None}
+    vtaint = set(inherited) | {
+        a.arg for a in (pos + fn.args.kwonlyargs)
+        if a.arg not in ("self", "cls") and a.arg not in defaulted}
+    for a in (fn.args.vararg, fn.args.kwarg):
+        if a is not None:
+            vtaint.add(a.arg)
+    staint = set()
+    for _ in range(10):
+        taint = _Taint(vtaint, staint)
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if getattr(node, "value", None) is None:
+                    continue
+                v, s = taint.of(node.value)
+                for name in _assign_targets(node):
+                    if v and name not in vtaint:
+                        vtaint.add(name)
+                        changed = True
+                    if s and name not in staint:
+                        staint.add(name)
+                        changed = True
+            elif isinstance(node, ast.For):
+                v, s = taint.of(node.iter)
+                if isinstance(node.target, (ast.Name, ast.Tuple, ast.List)):
+                    names = []
+
+                    def flat(t):
+                        if isinstance(t, (ast.Tuple, ast.List)):
+                            for e in t.elts:
+                                flat(e)
+                        elif isinstance(t, ast.Name):
+                            names.append(t.id)
+                    flat(node.target)
+                    itname = callee_name(node.iter) \
+                        if isinstance(node.iter, ast.Call) else None
+                    if itname == "keys":
+                        names = []
+                    elif itname == "items" and isinstance(
+                            node.target, ast.Tuple) \
+                            and len(node.target.elts) == 2 \
+                            and isinstance(node.target.elts[0], ast.Name):
+                        names = [n for n in names
+                                 if n != node.target.elts[0].id]
+                    for name in names:
+                        if v and name not in vtaint:
+                            vtaint.add(name)
+                            changed = True
+                        if s and name not in staint:
+                            staint.add(name)
+                            changed = True
+        if not changed:
+            break
+    return vtaint, staint
+
+
+def _raise_only(body):
+    return all(isinstance(s, (ast.Raise, ast.Assert)) for s in body)
+
+
+@register
+class TraceSafetyRule(Rule):
+    id = "trace-host-sync"
+    serving = True
+
+    # companion ids emitted by the same pass
+    PY_BRANCH = "trace-py-branch"
+    SHAPE_BRANCH = "trace-shape-branch"
+
+    def check_file(self, ctx, project):
+        findings = []
+        traced = _traced_defs(ctx.tree)
+        analyzed = set()
+        # analyze outermost traced defs; nested defs are visited inline
+        # with the parent's taint environment inherited
+        nested = set()
+        for d in traced:
+            for node in ast.walk(d):
+                if node is not d and isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested.add(node)
+        for d in sorted(traced - nested, key=lambda n: n.lineno):
+            self._analyze(ctx, d, set(), findings, analyzed)
+        return findings
+
+    def _analyze(self, ctx, fn, inherited, findings, analyzed):
+        if fn in analyzed:
+            return
+        analyzed.add(fn)
+        vtaint, staint = _taint_env(fn, inherited)
+        taint = _Taint(vtaint, staint)
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    self._analyze(ctx, child, vtaint, findings, analyzed)
+                    continue
+                self._check(ctx, fn, child, taint, findings)
+                visit(child)
+        self._check(ctx, fn, fn, taint, findings)
+        visit(fn)
+
+    def _check(self, ctx, fn, node, taint, findings):
+        rel = ctx.relpath
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _SYNC_METHODS:
+                v, _ = taint.of(func.value)
+                if v:
+                    findings.append(Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        "host sync in traced '%s': .%s() on a traced "
+                        "value" % (fn.name, func.attr)))
+            elif isinstance(func, ast.Name) and name in _CAST_NAMES \
+                    and len(node.args) == 1:
+                v, _ = taint.of(node.args[0])
+                if v:
+                    findings.append(Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        "host sync in traced '%s': %s() concretizes a "
+                        "traced value" % (fn.name, name)))
+            elif isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in _NP_MODULES:
+                if any(taint.of(a)[0] for a in node.args) or \
+                        any(taint.of(k.value)[0] for k in node.keywords):
+                    findings.append(Finding(
+                        self.id, rel, node.lineno, node.col_offset,
+                        "host sync in traced '%s': %s.%s() on a traced "
+                        "value (use jnp)" % (fn.name, func.value.id,
+                                             func.attr)))
+        elif isinstance(node, ast.If):
+            v, s = _test_taint(node.test, taint)
+            if v:
+                findings.append(Finding(
+                    self.PY_BRANCH, rel, node.lineno, node.col_offset,
+                    "Python `if` on a traced value in '%s' (use "
+                    "jnp.where / lax.cond)" % fn.name))
+            elif s and not _raise_only(node.body):
+                findings.append(Finding(
+                    self.SHAPE_BRANCH, rel, node.lineno, node.col_offset,
+                    "shape-dependent `if` in traced '%s': each distinct "
+                    "shape traces a new program (retrace risk under the "
+                    "AOT bucket contract)" % fn.name))
+        elif isinstance(node, ast.While):
+            v, _ = _test_taint(node.test, taint)
+            if v:
+                findings.append(Finding(
+                    self.PY_BRANCH, rel, node.lineno, node.col_offset,
+                    "Python `while` on a traced value in '%s' (use "
+                    "lax.while_loop)" % fn.name))
+        elif isinstance(node, ast.IfExp):
+            v, _ = _test_taint(node.test, taint)
+            if v:
+                findings.append(Finding(
+                    self.PY_BRANCH, rel, node.lineno, node.col_offset,
+                    "ternary on a traced value in '%s' (use jnp.where)"
+                    % fn.name))
+        elif isinstance(node, ast.Assert):
+            v, _ = _test_taint(node.test, taint)
+            if v:
+                findings.append(Finding(
+                    self.PY_BRANCH, rel, node.lineno, node.col_offset,
+                    "assert on a traced value in '%s' (trace-time bool "
+                    "of a tracer)" % fn.name))

@@ -3050,14 +3050,10 @@ class ServingEngine:
         with self._qlock:
             stranded = list(self._queue)
             self._queue.clear()
-        for slot, seq in list(self._active.items()):
-            self._retire_error(slot, seq, err)
-        for pf in list(self._prefilling.values()):
-            self._drop_prefill(pf)
-            pf.req._finish(error=err)
-        for rs in list(self._restoring.values()):
-            self._drop_restore(rs)
-            rs.req._finish(error=err)
+        # post-join the caller owns the scheduler state: reuse the same
+        # sweep `_die`/`drain` use so release accounting cannot diverge
+        for req in self._sweep_inflight():
+            req._finish(error=err)
         for req in stranded:
             req._finish(error=err)
 
@@ -3084,7 +3080,10 @@ class ServingEngine:
                                depth=self.depth())
         t0 = time.monotonic()
         budget_s = None if deadline_ms is None else float(deadline_ms) / 1e3
-        while self._dead is None and not self._stopped.is_set():
+        while not self._stopped.is_set():
+            with self._qlock:   # _die publishes _dead under _qlock
+                if self._dead is not None:
+                    break
             if self._thread is not None and self._thread.is_alive():
                 if self.depth() == 0:
                     break
@@ -3125,7 +3124,9 @@ class ServingEngine:
         t0 = time.perf_counter()
         steps = 0
         while True:
-            if self._dead is not None:
+            with self._qlock:   # _die publishes _dead under _qlock
+                dead = self._dead
+            if dead is not None:
                 return steps
             thread_driven = self._thread is not None and \
                 self._thread.is_alive()
@@ -3385,7 +3386,9 @@ class ReplicaRouter:
     def submit(self, prompt, **kw):
         if self._stopped:
             raise ServeEngineDead("ReplicaRouter: router stopped")
-        telemetry.set_gauge("serve.replicas", len(self.engines))
+        with self._lock:   # monitor/drain swap replicas under _lock
+            fleet = len(self.engines)
+        telemetry.set_gauge("serve.replicas", fleet)
         last_err = None
         session = kw.get("session")
         # two rounds: a replica dying (or respawning) between the snapshot
@@ -3439,7 +3442,7 @@ class ReplicaRouter:
                     "(%s)" % (shed, last_err))
         raise ServeEngineDead(
             "ReplicaRouter: no live replica among %d (%s)"
-            % (len(self.engines), last_err))
+            % (fleet, last_err))
 
     def _resolve_engine(self, replica):
         """An engine by object, index, or replica name."""
@@ -3519,7 +3522,9 @@ class ReplicaRouter:
 
     def start(self):
         self._stopped = False
-        for e in self.engines:
+        with self._lock:   # monitor/drain swap replicas under _lock
+            engines = list(self.engines)
+        for e in engines:
             e.start()
         if self._monitor is None or not self._monitor.is_alive():
             self._mon_stop.clear()

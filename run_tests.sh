@@ -13,6 +13,14 @@ T1="timeout -k 10 870"
 if [ $# -eq 0 ]; then
     set -- tests/ -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly
+elif [ "$1" = "--lint" ]; then
+    # static-analysis gate (docs/static_analysis.md): tools/mxlint.py
+    # proves the graph-safety + concurrency invariants — trace safety,
+    # donation discipline, lock discipline, registry drift, AOT-shape
+    # hygiene.  Zero unsuppressed findings or the gate fails.  Runs on a
+    # bare interpreter (no jax import), so it is the cheapest gate here.
+    shift
+    exec env PYTHONPATH= python "$(dirname "$0")/tools/mxlint.py" --json "$@"
 elif [ "$1" = "--serve-smoke" ]; then
     # fast serving smoke: KV-cache decode parity, admit/retire scheduling,
     # the zero-retrace bucket contract, and the 2-replica CPU-mesh

@@ -8,6 +8,13 @@
 # bench smoke.
 set -e
 cd "$(dirname "$0")/.."
+
+# -- static-analysis gate (docs/static_analysis.md) -----------------------
+# First and cheapest: zero unsuppressed mxlint findings (trace safety,
+# donation discipline, lock discipline, registry drift, AOT-shape
+# hygiene) before any compute is spent on the suites below.
+./run_tests.sh --lint
+
 ./run_tests.sh tests/ -q
 
 # -- full multi-process chaos sweep (docs/fault_tolerance.md) -------------

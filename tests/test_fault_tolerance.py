@@ -103,6 +103,7 @@ def test_chaos_spec_parsing_and_determinism(monkeypatch):
     assert chaos.rpc_action("heartbeat") is None
     assert chaos.rpc_action("goodbye") is None
 
+    # mxlint: disable=chaos-unknown-clause -- deliberately unknown clause: asserts spec() rejects typos
     monkeypatch.setenv("MXNET_CHAOS", "bogus_clause:1")
     chaos.reset()
     with pytest.raises(ValueError):

@@ -1,0 +1,479 @@
+"""mxlint analyzer tests (mxnet_tpu/analysis + tools/mxlint.py).
+
+Three layers per rule family — a seeded violation is DETECTED, a
+suppression with a reason silences exactly that finding, and idiomatic
+clean code stays silent — plus the suppression grammar itself, the CLI
+contract (exit codes, JSON shape, --scope/--list-rules), and the
+self-check that matters most: the REPO ITSELF lints clean, so any PR
+that reintroduces a host sync, a donated-buffer reuse, an unguarded
+shared attribute, registry drift, or a dynamic serving shape fails
+tier-1 here instead of shipping.
+
+The fixtures run the analyzer over throwaway trees in tmp_path with the
+rule under test isolated (``rules=[...]``), so a fixture exercising
+trace safety doesn't need a docs/env_vars.md to keep the drift rules
+quiet.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from mxnet_tpu.analysis import run, all_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MXLINT = os.path.join(REPO, "tools", "mxlint.py")
+
+
+def lint(tmp_path, files, rules=None, scope=None):
+    """Materialize {relpath: source} under tmp_path and lint it.
+
+    Fixture sources spell suppressions ``# MXLINT: ...`` (uppercase):
+    the suppression scanner reads raw lines, so a literal lowercase
+    marker inside these string fixtures would register as a suppression
+    of THIS file when the repo self-check lints tests/."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src).replace("MXLINT:", "mxlint:"))
+    targets = tuple(r for r in files if r.endswith(".py"))
+    return run(str(tmp_path), targets=targets, rules=rules, scope=scope)
+
+
+def rule_ids(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# trace safety
+# ---------------------------------------------------------------------------
+
+def test_trace_host_sync_detected(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            n = y.item()          # device->host readback mid-trace
+            z = float(x)          # concretizes a tracer
+            w = np.sum(y)         # numpy on a traced value
+            return n + z + w
+    """}, rules=["trace-host-sync"])
+    assert rule_ids(res) == ["trace-host-sync"] * 3
+
+
+def test_trace_host_sync_suppressed_and_clean(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, cfg=None):
+            if cfg is None:            # identity test: static at trace
+                x = x + 1
+            y = x.item()  # MXLINT: disable=trace-host-sync -- fixture
+            return jnp.sum(x) + y      # jnp on tracers is the clean path
+    """}, rules=["trace-host-sync"])
+    assert res.findings == []
+    assert [r for _, r in res.suppressed] == ["fixture"]
+
+
+def test_trace_py_branch_and_shape_branch(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+        from jax import lax
+
+        def body(c, x):
+            if x > 0:                  # tracer truth value
+                c = c + x
+            while c > 0:               # tracer while
+                c = c - 1
+            return c, x
+
+        def outer(xs):
+            return lax.scan(body, 0, xs)
+
+        @jax.jit
+        def g(x):
+            if x.shape[0] == 4:        # legal but retraces per shape
+                x = x * 2
+            if x.shape[0] > 128:       # raise-only guard: idiomatic
+                raise ValueError("too long")
+            return x
+    """}, rules=["trace-py-branch", "trace-shape-branch"])
+    assert sorted(rule_ids(res)) == [
+        "trace-py-branch", "trace-py-branch", "trace-shape-branch"]
+
+
+def test_untraced_function_is_exempt(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        def host_side(x):
+            if x > 0:                  # plain python: no trace, no rule
+                return float(x)
+            return x.item()
+    """}, rules=["trace-host-sync", "trace-py-branch"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# donation discipline
+# ---------------------------------------------------------------------------
+
+def test_donate_reuse_detected(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        def train_step(params, grads):
+            upd = jax.jit(apply, donate_argnums=(0,))
+            new = upd(params, grads)
+            return params, new         # params' buffer was consumed
+    """}, rules=["donate-reuse"])
+    assert rule_ids(res) == ["donate-reuse"]
+
+
+def test_donate_rebind_lower_and_suppression_clean(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        def train_loop(params, batches):
+            upd = jax.jit(apply, donate_argnums=(0,))
+            lowered = upd.lower(params)    # compile-time: no donation
+            for g in batches:
+                params = upd(params, g)    # rebound: name is live again
+            return params, lowered
+
+        def sneaky(params, grads):
+            upd = jax.jit(apply, donate_argnums=(0,))
+            out = upd(params, grads)
+            return params + out  # MXLINT: disable=donate-reuse -- fixture
+    """}, rules=["donate-reuse"])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_donate_dup_detected(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        def step(x):
+            f = jax.jit(combine, donate_argnums=(0, 1))
+            return f(x, x)             # one buffer donated twice
+    """}, rules=["donate-dup"])
+    assert rule_ids(res) == ["donate-dup"]
+
+
+def test_donate_class_attribute_tracked_across_methods(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        class Stepper:
+            def __init__(self):
+                self._step = jax.jit(apply, donate_argnums=(0,))
+
+            def go(self, carry, x):
+                out = self._step(carry, x)
+                return carry           # consumed by the class donator
+    """}, rules=["donate-reuse"])
+    assert rule_ids(res) == ["donate-reuse"]
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._queue = []
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self._queue.append(1)
+
+        def submit(self, x):
+            %s
+"""
+
+
+def test_lock_unguarded_read_detected(tmp_path):
+    res = lint(tmp_path, {"mod.py": _LOCKED_CLASS
+                          % "return len(self._queue)"},
+               rules=["lock-unguarded"])
+    assert rule_ids(res) == ["lock-unguarded"]
+    assert "submit" in res.findings[0].message
+    assert "_loop" in res.findings[0].message
+
+
+def test_lock_guarded_read_clean(tmp_path):
+    res = lint(tmp_path, {"mod.py": _LOCKED_CLASS % (
+        "with self._lock:\n                return len(self._queue)")},
+        rules=["lock-unguarded"])
+    assert res.findings == []
+
+
+def test_lock_single_group_attribute_clean(tmp_path):
+    # an attribute only the background thread touches has no race partner
+    res = lint(tmp_path, {"mod.py": """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._scratch = []
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self._lock:
+                    self._scratch.append(1)
+                self._scratch.pop()    # same thread as the guarded write
+    """}, rules=["lock-unguarded"])
+    assert res.findings == []
+
+
+def test_lock_rule_clean_on_repo_serving_engine():
+    """Regression for the PR-15 fixes: ServingEngine/ReplicaRouter carry
+    no unguarded cross-thread accesses (stop/drain/run_until_idle/
+    submit/start were all findings once)."""
+    res = run(REPO, targets=("mxnet_tpu/serving/engine.py",),
+              rules=["lock-unguarded"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# registry drift
+# ---------------------------------------------------------------------------
+
+def test_env_undocumented_and_stale(tmp_path):
+    res = lint(tmp_path, {
+        "mxnet_tpu/mod.py": """
+            import os
+            KNOB = os.environ.get("MXNET_FIXTURE_KNOB", "0")
+        """,
+        "docs/env_vars.md": """
+            | var | default | meaning |
+            |---|---|---|
+            | `MXNET_GONE_KNOB` | 0 | removed long ago |
+        """,
+    }, rules=["env-undocumented", "env-stale-doc"])
+    assert sorted(rule_ids(res)) == ["env-stale-doc", "env-undocumented"]
+
+
+def test_env_documented_clean(tmp_path):
+    res = lint(tmp_path, {
+        "mxnet_tpu/mod.py": """
+            import os
+            KNOB = os.environ.get("MXNET_FIXTURE_KNOB", "0")
+        """,
+        "docs/env_vars.md": """
+            | var | default | meaning |
+            |---|---|---|
+            | `MXNET_FIXTURE_KNOB` | 0 | a documented knob |
+        """,
+    }, rules=["env-undocumented", "env-stale-doc"])
+    assert res.findings == []
+
+
+def test_telemetry_drift_both_directions(tmp_path):
+    res = lint(tmp_path, {
+        "mxnet_tpu/mod.py": """
+            from mxnet_tpu import telemetry
+
+            def f():
+                telemetry.inc("serve.orphan_counter")
+        """,
+        "tools/telemetry_report.py": """
+            def summarize(final):
+                return {"ghost": final.get("serve.ghost_metric", 0)}
+        """,
+    }, rules=["telemetry-unemitted", "telemetry-unrendered"])
+    assert sorted(rule_ids(res)) == [
+        "telemetry-unemitted", "telemetry-unrendered"]
+
+
+def test_telemetry_rendered_and_emitted_clean(tmp_path):
+    res = lint(tmp_path, {
+        "mxnet_tpu/mod.py": """
+            from mxnet_tpu import telemetry
+
+            def f():
+                telemetry.inc("serve.good_counter")
+        """,
+        "tools/telemetry_report.py": """
+            def summarize(final):
+                return {"good": final.get("serve.good_counter", 0)}
+        """,
+    }, rules=["telemetry-unemitted", "telemetry-unrendered"])
+    assert res.findings == []
+
+
+def test_chaos_unknown_clause(tmp_path):
+    files = {
+        "mxnet_tpu/chaos.py": """
+            def _parse_clause(kind, args):
+                if kind == "flaky_rpc":
+                    return ("flaky_rpc", args)
+                raise ValueError(kind)
+        """,
+        "tests/test_x.py": """
+            import os
+
+            def test_chaos(monkeypatch):
+                os.environ["MXNET_CHAOS"] = "not_a_clause:1"
+                os.environ["MXNET_CHAOS"] = "flaky_rpc:0.5"
+        """,
+    }
+    res = lint(tmp_path, files, rules=["chaos-unknown-clause"])
+    assert rule_ids(res) == ["chaos-unknown-clause"]
+    assert "not_a_clause" in res.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# AOT-shape hygiene
+# ---------------------------------------------------------------------------
+
+def test_aot_dynamic_shape_detected_and_bucketed_clean(tmp_path):
+    res = lint(tmp_path, {"mxnet_tpu/serving/launch.py": """
+        import jax.numpy as jnp
+
+        def admit_bad(req):
+            n = len(req.prompt)
+            return jnp.zeros((n, 4))       # per-request dimension
+
+        def admit_good(self, req):
+            b = self._bucket_for(len(req.prompt))
+            pad = jnp.zeros((b, 4))        # bucket table: sanctioned
+            return pad.reshape(b, 2, 2)
+    """}, rules=["aot-dynamic-shape"])
+    assert rule_ids(res) == ["aot-dynamic-shape"]
+    assert "admit_bad" in res.findings[0].message
+
+
+def test_aot_rule_only_fires_in_serving(tmp_path):
+    res = lint(tmp_path, {"mxnet_tpu/ops/pad.py": """
+        import jax.numpy as jnp
+
+        def pad_host(req):
+            return jnp.zeros((len(req.prompt), 4))   # not a serving path
+    """}, rules=["aot-dynamic-shape"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar
+# ---------------------------------------------------------------------------
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()  # MXLINT: disable=trace-host-sync
+    """}, rules=["trace-host-sync"])
+    assert sorted(rule_ids(res)) == ["bad-suppression", "trace-host-sync"]
+
+
+def test_suppression_comment_line_covers_next_line(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            # MXLINT: disable=trace-host-sync -- fixture: next-line form
+            return x.item()
+    """}, rules=["trace-host-sync"])
+    assert res.findings == []
+    assert len(res.suppressed) == 1
+
+
+def test_suppression_matches_full_rule_id_only(tmp_path):
+    # regression: the grammar once parsed a 1-char rule id and dumped the
+    # rest into the reason, so no suppression ever matched its finding
+    res = lint(tmp_path, {"mod.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x.item()  # MXLINT: disable=trace-py-branch -- wrong rule
+            return y
+    """}, rules=["trace-host-sync", "trace-py-branch"])
+    assert rule_ids(res) == ["trace-host-sync"]   # unrelated id: no match
+
+
+def test_disable_file_suppresses_whole_file(tmp_path):
+    res = lint(tmp_path, {"mod.py": """
+        # MXLINT: disable-file=trace-host-sync -- fixture: file-wide
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item() + float(x)
+    """}, rules=["trace-host-sync"])
+    assert res.findings == []
+    assert len(res.suppressed) == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI + self-check
+# ---------------------------------------------------------------------------
+
+def test_cli_json_exit_codes_and_scope():
+    out = subprocess.run(
+        [sys.executable, MXLINT, "--json"], cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert report["ok"] and report["findings"] == []
+    usage = subprocess.run(
+        [sys.executable, MXLINT, "--rules", "no-such-rule"], cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert usage.returncode == 2
+    listed = subprocess.run(
+        [sys.executable, MXLINT, "--list-rules"], cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert listed.returncode == 0
+    ids = set(listed.stdout.split())
+    assert {"trace-host-sync", "donate-reuse", "lock-unguarded",
+            "env-undocumented", "aot-dynamic-shape"} <= ids
+
+
+def test_subtree_run_skips_reverse_drift_checks():
+    """Regression: `mxlint mxnet_tpu/serving` once emitted ~54 false
+    findings — every env row kept alive by an unscanned file read as
+    stale, and chaos.py 'parser drift' because it was never parsed.  A
+    partial-surface run must stand down the reverse checks (and load
+    chaos.py on demand for the forward one) so a subtree lint is usable."""
+    res = run(REPO, targets=("mxnet_tpu/serving",))
+    assert res.findings == [], "\n".join(str(f) for f in res.findings)
+
+
+def test_missing_target_is_usage_error():
+    import pytest
+    with pytest.raises(ValueError, match="does not exist"):
+        run(REPO, targets=("no_such_dir_typo",))
+    out = subprocess.run(
+        [sys.executable, MXLINT, "no_such_dir_typo"], cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 2   # a typo'd CI target must not pass green
+
+
+def test_serving_scope_runs_serving_rules_only():
+    res = run(REPO, scope="serving")
+    assert set(res.rules) == {r.id for r in all_rules() if r.serving}
+    assert res.findings == []
+
+
+def test_repo_lints_clean_with_reasoned_suppressions():
+    """THE gate: zero unsuppressed findings on the tree, and every
+    suppression carries a recorded reason."""
+    res = run(REPO)
+    assert res.findings == [], "\n".join(str(f) for f in res.findings)
+    assert all(reason.strip() for _, reason in res.suppressed)

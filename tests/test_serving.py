@@ -743,3 +743,106 @@ def test_two_replica_cpu_mesh_dispatch(model_and_params):
     assert all(e.stats["prefills"] > 0 for e in router.engines)
     for p, o in zip(prompts, outs):
         assert o == _oracle(model, params, p, max_new=4)
+
+
+# ---------------------------------------------------------------------------
+# 5. lock-discipline regressions (the mxlint lock-unguarded fixes, PR-15)
+# ---------------------------------------------------------------------------
+
+class _LockCheckedList(list):
+    """`router.engines` stand-in recording reads made without
+    `router._lock` held — the submit-vs-monitor replica-swap race the
+    mxlint lock-unguarded rule proves absent statically
+    (docs/static_analysis.md)."""
+
+    def __init__(self, items, lock):
+        super().__init__(items)
+        self._lock = lock
+        self.unlocked_reads = []
+
+    def _note(self, op):
+        if not self._lock.locked():
+            self.unlocked_reads.append(op)
+
+    def __len__(self):
+        self._note("len")
+        return super().__len__()
+
+    def __iter__(self):
+        self._note("iter")
+        return super().__iter__()
+
+    def __getitem__(self, i):
+        self._note("getitem")
+        return super().__getitem__(i)
+
+
+def test_router_engine_list_reads_hold_lock(model_and_params):
+    """Every post-warmup read of `router.engines` must hold `_lock`:
+    the monitor and `drain` swap replicas under it, and an unlocked
+    `len`/iteration races the swap (submit and start once read bare).
+    The monitor thread is joined first so `_lock.locked()` reflects
+    exactly the calling thread's holds."""
+    model, params = model_and_params
+    engines = [_engine(model, params, max_new_tokens=2) for _ in range(2)]
+    engines[1].name = "replica1"
+    engines[1]._gauge = "serve.replica1."
+    router = ReplicaRouter(engines, respawn=False, journal=False)
+    router.warmup()   # pre-start by serving contract: exempt from the rule
+    router.start()
+    router._mon_stop.set()
+    router._monitor.join(timeout=10)
+    router.engines = _LockCheckedList(engines, router._lock)
+    try:
+        router.start()                   # second start: idempotent path
+        req = router.submit([1, 2, 3])
+        assert len(req.result(timeout=60)) == 2
+        assert router.depth() >= 0
+        router.run_until_idle(timeout=30)
+    finally:
+        router.stop()
+    assert router.engines.unlocked_reads == []
+    assert telemetry.registry().gauge("serve.replicas").value == 2
+
+
+def test_drain_returns_promptly_on_dead_engine(model_and_params,
+                                               monkeypatch):
+    """`drain` polls scheduler liveness under `_qlock` (the lock `_die`
+    publishes `_dead` under): draining an engine whose scheduler died
+    must return immediately — not spin stepping a dead engine until a
+    deadline."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    eng.warmup()
+
+    def boom(b_bucket):
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(eng, "_compiled_decode", boom)
+    eng.start()
+    req = eng.submit([1, 2, 3])
+    with pytest.raises(MXNetError, match="device exploded"):
+        req.result(timeout=60)
+    t0 = time.monotonic()
+    stragglers = eng.drain()     # deadline None = wait-for-idle mode
+    assert time.monotonic() - t0 < 10
+    assert stragglers == []      # death already failed everything typed
+
+
+def test_stop_resolves_active_and_queued_typed_releasing_slots(
+        model_and_params):
+    """`stop()` walks the same `_sweep_inflight` release path `_die` and
+    `drain` use: active + queued requests all resolve typed
+    `ServeEngineDead` and every slot returns to the free list."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_batch=2)
+    eng.warmup()
+    reqs = [eng.submit([1 + i, 2, 3]) for i in range(4)]
+    eng.step()                   # admit up to max_batch; rest stay queued
+    assert len(eng._active) == 2 and len(eng._queue) == 2
+    eng.stop()
+    for r in reqs:
+        with pytest.raises(ServeEngineDead):
+            r.result(timeout=5)
+    assert eng._active == {} and len(eng._free) == eng.max_batch
+    assert eng.depth() == 0

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""mxlint: the repo's graph-safety + concurrency static-analysis gate.
+
+    python tools/mxlint.py                  # lint the whole tree
+    python tools/mxlint.py mxnet_tpu/serving
+    python tools/mxlint.py --json           # machine-readable report
+    python tools/mxlint.py --scope serving  # bench.py --serve preflight set
+    python tools/mxlint.py --list-rules
+
+Exit code 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+Rule families and the suppression contract are documented in
+docs/static_analysis.md.  The analysis package is loaded standalone
+(stdlib only — no jax/numpy import), so the gate runs on any checkout.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(_HERE)
+
+
+def load_analysis():
+    """Import mxnet_tpu.analysis WITHOUT importing mxnet_tpu (which pulls
+    jax): the lint gate must run on a bare interpreter."""
+    try:
+        return sys.modules["mxnet_tpu.analysis"]
+    except KeyError:
+        pass
+    pkg_dir = os.path.join(ROOT, "mxnet_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_mxlint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_mxlint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="*",
+                    help="files/dirs relative to the repo root "
+                         "(default: the standard lint surface)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the JSON report")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--scope", choices=("serving",), default=None,
+                    help="'serving': the serving-marked rules over "
+                         "mxnet_tpu/serving (the bench --serve preflight)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings with reasons")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+    if args.list_rules:
+        ids = set()
+        for rule in analysis.all_rules():
+            ids |= analysis.rule_ids(rule)
+        for rid in sorted(ids):
+            print(rid)
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    try:
+        result = analysis.run(
+            ROOT, targets=tuple(args.targets) or None,
+            rules=rules, scope=args.scope)
+    except ValueError as e:
+        print("mxlint: %s" % e, file=sys.stderr)
+        return 2
+    if args.json:
+        print(result.render_json())
+    else:
+        print(result.render_text(show_suppressed=args.show_suppressed))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,6 +271,13 @@ def summarize(records):
     age = _merge_hists(records, "serve.queue_age_ms")
     if age:
         resilience["serve.queue_age_ms"] = age
+    # live replica count: last-seen value of the router's submit-side
+    # gauge — end-of-stream N below the configured fleet means a dead
+    # replica was never respawned
+    for r in records:
+        v = r.get("gauges", {}).get("serve.replicas")
+        if v is not None:
+            resilience["serve.replicas"] = v
     if resilience:
         out["resilience"] = resilience
     durability = {k: int(final.get(k, 0))
@@ -280,6 +287,12 @@ def summarize(records):
                 if e.get("kind") == kind)
         if n:
             durability["%s_events" % kind] = n
+    # journal occupancy: last-seen depth of the router's request journal
+    # — nonzero at end-of-stream means handles outlived their requests
+    for r in records:
+        v = r.get("gauges", {}).get("serve.journal_depth")
+        if v is not None:
+            durability["serve.journal_depth"] = v
     if durability:
         out["durability"] = durability
     tiering = {k: int(final.get(k, 0)) for k in SERVE_TIER_COUNTERS
