@@ -856,6 +856,24 @@ def serve_bench(record=True, with_chaos=False):
                 "session_hits": _sum("session_hits"),
             },
         }
+    # decode-loop accounting (docs/serving.md "Megastep decode &
+    # streaming"): host_frac = exposed host time / decode-loop wall —
+    # reported for EVERY leg (the single-step baseline included), so the
+    # megastep A/B can show the double-buffered sweep drove it down
+    wall_s = sum(e.stats["wall_s"] for e in router.engines)
+    host_s = sum(e.stats["host_s"] for e in router.engines)
+    mega_engines = [e for e in router.engines if e._mega_m]
+    decode_loop = {
+        "megastep_m": mega_engines[0]._mega_m if mega_engines else 0,
+        "megasteps": sum(e.stats["megasteps"] for e in router.engines),
+        "megastep_tokens": sum(e.stats["megastep_tokens"]
+                               for e in router.engines),
+        "ingraph_retired": sum(e.stats["ingraph_retired"]
+                               for e in router.engines),
+        "host_frac": round(host_s / wall_s, 4) if wall_s else None,
+        "host_s": round(host_s, 4),
+        "wall_s": round(wall_s, 4),
+    }
     spec_engines = [e for e in router.engines if e._spec]
     spec_stats = None
     if spec_engines:
@@ -950,6 +968,7 @@ def serve_bench(record=True, with_chaos=False):
         "max_concurrent": max_concurrent,
         "cache": "paged" if paged_engines else "slot",
         "blocks": blocks,
+        "decode_loop": decode_loop,
         "spec": spec_stats,
         "trace": trace,
         "prompt_len_mean": round(float(np.mean(plens)), 2),
@@ -1345,6 +1364,86 @@ def serve_spec_bench(record=True):
     return result
 
 
+def serve_megastep_bench(record=True):
+    """Megastep-decode A/B at EQUAL config and small batch
+    (``python bench.py --serve --megastep``).
+
+    Both legs run the same paged engine geometry over the same request
+    set; the `off` leg pins ``MXNET_SERVE_MEGASTEP=0`` (the PR-15
+    single-step loop: one launch, one host sweep per token), the
+    `megastep` leg fuses ``MXNET_SERVE_MEGASTEP_STEPS`` decode steps
+    into one `lax.scan` launch with in-graph retirement and runs the
+    host sweep double-buffered under the in-flight launch.  Small batch
+    is the point: there the loop is host-bound, so amortizing +
+    overlapping the sweep is the whole win.  The acceptance contract
+    (ISSUE 16, gated nightly): tok/s/chip strictly higher, `host_frac`
+    (exposed host time / decode-loop wall) strictly lower and small,
+    token-for-token output parity (`output_sig` equal — greedy is
+    bit-identical), zero leaked blocks, and zero steady-state
+    recompiles on either leg (every `(bucket, m)` megastep shape joins
+    the frozen warmup set).
+    """
+    from mxnet_tpu import telemetry
+
+    shared = {"SERVE_TRACE": os.environ.get("SERVE_TRACE", "mixed"),
+              "SERVE_RATE": "0",
+              # small batch: host-bound territory — the regime the
+              # megastep targets (SERVE_* env still overrides)
+              "MXNET_SERVE_MAX_BATCH":
+                  os.environ.get("MXNET_SERVE_MAX_BATCH", "4"),
+              "MXNET_SERVE_BLOCK_SIZE":
+                  os.environ.get("MXNET_SERVE_BLOCK_SIZE", "8"),
+              "SERVE_NEW": os.environ.get("SERVE_NEW", "32"),
+              "SERVE_PROMPT_MAX": os.environ.get("SERVE_PROMPT_MAX", "24")}
+    mega_env = {"MXNET_SERVE_MEGASTEP": "1",
+                "MXNET_SERVE_MEGASTEP_STEPS":
+                    os.environ.get("MXNET_SERVE_MEGASTEP_STEPS", "4")}
+    runs = {}
+    for mode, env in (("off", {"MXNET_SERVE_MEGASTEP": "0"}),
+                      ("megastep", mega_env)):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    off, mega = runs["off"], runs["megastep"]
+    result = {
+        "metric": "serve_megastep_vs_decode",
+        # the acceptance ratio: tok/s/chip at equal config (mega / off)
+        "value": round(mega["value"] / max(off["value"], 1e-9), 3),
+        "unit": "megastep/off tok/s/chip ratio (m fused steps + double-"
+                "buffered sweep vs one launch per token, equal config, "
+                "small batch)",
+        "off": off,
+        "megastep": mega,
+        "token_parity": off["output_sig"] == mega["output_sig"],
+        "m": mega["decode_loop"]["megastep_m"],
+        "megasteps": mega["decode_loop"]["megasteps"],
+        "megastep_tokens": mega["decode_loop"]["megastep_tokens"],
+        "ingraph_retired": mega["decode_loop"]["ingraph_retired"],
+        "host_frac": {"off": off["decode_loop"]["host_frac"],
+                      "megastep": mega["decode_loop"]["host_frac"]},
+        "ttft_p50_ms": {"off": off["ttft_ms"]["p50"],
+                        "megastep": mega["ttft_ms"]["p50"]},
+        "tok_s": {"off": off["value"], "megastep": mega["value"]},
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def serve_quant_bench(record=True):
     """Quantized-serving A/B at EQUAL HBM under the mixed-length trace
     (``python bench.py --serve --quant``).
@@ -1706,6 +1805,8 @@ if __name__ == "__main__":
             serve_tier_bench()
         elif "--quant" in sys.argv:
             serve_quant_bench()
+        elif "--megastep" in sys.argv:
+            serve_megastep_bench()
         elif "--durability" in sys.argv:
             serve_durability_bench()
         else:

@@ -16,6 +16,12 @@ attribute (``.prompt``/``.generated``/``.ctx``/``.tokens``/
 ``.max_new_tokens``/``.pos``), directly or through a local variable.
 Shapes built from ``.shape`` of an existing (already-bucketed) array,
 ``self._*`` configuration, or literals stay silent.
+
+A ``lax.scan`` length is a shape too: the megastep decode scan compiles
+one program per distinct ``length=``, so a per-request value leaking
+into it (``length=req.max_new_tokens``) is the same per-request
+recompile storm — the rule fires on a tainted scan length (keyword or
+4th positional), and only ``*bucket*``-table lookups are sanctioned.
 """
 from __future__ import annotations
 
@@ -99,7 +105,28 @@ class AotShapeRule(Rule):
                     and func.value.id in ("np", "jnp", "numpy", "jax"))
                 is_reshape = (name == "reshape"
                               and isinstance(func, ast.Attribute))
-                if not (is_creator or is_reshape):
+                is_scan = (name == "scan"
+                           and isinstance(func, ast.Attribute))
+                if not (is_creator or is_reshape or is_scan):
+                    continue
+                if is_scan:
+                    # the scan LENGTH is a compiled shape: length= kwarg
+                    # or the 4th positional (f, init, xs, length)
+                    dims = [kw.value for kw in node.keywords
+                            if kw.arg == "length"] + node.args[3:4]
+                    for dim in dims:
+                        if _req_tainted(dim, tainted):
+                            findings.append(Finding(
+                                self.id, ctx.relpath, node.lineno,
+                                node.col_offset,
+                                "lax.scan length in '%s' takes a per-"
+                                "request value — the scan length is a "
+                                "compiled shape; megastep/draft scan "
+                                "lengths must come from the warmup "
+                                "tables (only *bucket* lookups are "
+                                "sanctioned) or this compiles a new "
+                                "program per request" % fn.name))
+                            break
                     continue
                 dims = node.args[:1] if is_creator else node.args
                 for dim in dims:

@@ -225,7 +225,16 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, pos, num_heads,
     by table index, then run the same single-query position-masked
     attention.  The gather is the only extra work — numerics are
     identical to the slot cache (masked tail positions contribute exact
-    zeros either way)."""
+    zeros either way).
+
+    Dead-row contract (megastep decode): a retired/padding row is fed
+    ``pos = n_table * block_size`` — the first position PAST its table
+    coverage — so its K/V write redirects to the trash block (entry
+    index ``pos // bs == n_table`` maps to block 0) and its validity
+    mask here goes all-valid over whatever the gathered blocks hold.
+    That output is garbage by construction and is discarded in-graph
+    (the scan emits the ``-2`` dead sentinel instead); it cannot
+    contaminate live rows because every row's softmax is independent."""
     kc = gather_paged_kv(k_pool, block_tables)
     vc = gather_paged_kv(v_pool, block_tables)
     return decode_attention(q, kc, vc, pos, num_heads, scale=scale)

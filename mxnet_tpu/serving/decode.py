@@ -595,6 +595,70 @@ class TransformerKVModel:
             x = x + self._proj(params, f, p + "ffn2")
         return self._head(params, x), self._pack_pool(pool, scales)
 
+    def decode_megastep(self, params, pool, token, pos, left, eos, tables,
+                        steps, pick):
+        """``steps`` fused generation steps in ONE launch: a `lax.scan`
+        over the `decode_paged` body with per-row active masks, so a row
+        that finishes (EOS / generation budget / cache depth) mid-scan
+        retires IN-GRAPH — its remaining iterations run at the DEAD
+        position one past the table's coverage, which `decode_paged`'s
+        trash redirect sends to block 0 (and the pos-embed clamp keeps
+        in range), exactly the mechanism the speculative drafter's scan
+        already rides.
+
+        token: (b,) int32 — each row's current token (fed at ``pos``).
+        pos:   (b,) int32 — the position ``token`` occupies.
+        left:  (b,) int32 — tokens the row may still emit
+               (``max_new_tokens - n_new``); <= 0 marks the row inactive
+               from step 0 (padding rows pass 0).
+        eos:   (b,) int32 — per-row EOS id, -1 for none.
+        steps: int (a warmup-table constant, never per-request) — the
+               scan length m.
+        pick:  ``pick(logits, newpos) -> (b,) int32`` — the engine's
+               sampling tail (position-folded RNG + quant logit guard).
+               Each scan step passes the CARRIED position + 1, so the
+               fused run draws with the same fold keys as ``steps``
+               sequential launches: bit-identical tokens.
+
+        Returns ``(toks (b, steps) int32, new_pool)``.  Row semantics of
+        ``toks[r, j]``: >= 0 — the j-th token emitted by row r (host
+        bookkeeping replays them one at a time through the sequential
+        accounting); -1 — the quant logit guard tripped at this step
+        (earlier emits stand, the row froze in-graph); -2 — the row was
+        already retired (or never active) when step j ran.
+        """
+        raw, _ = self._pool_parts(pool)
+        bs = raw.shape[3]
+        # one past the table's coverage: decode_paged redirects the
+        # write to the trash block instead of clamping onto a real one
+        dead = jnp.int32(tables.shape[1] * bs)
+        seq_end = jnp.int32(self.seq_len)
+
+        def step(carry, _):
+            pool, tok, p, lf, act = carry
+            logits, pool = self.decode_paged(
+                params, pool, tok, jnp.where(act, p, dead), tables)
+            picked = pick(logits, p + 1)
+            trip = act & (picked < 0)
+            adv = act & ~trip
+            p2 = jnp.where(adv, p + 1, p)
+            lf2 = jnp.where(adv, lf - 1, lf)
+            tok2 = jnp.where(adv, picked, tok)
+            # the same three stop predicates _seq_finished checks host-
+            # side, evaluated on the post-advance state — a finishing
+            # token is emitted and THEN deactivates the row
+            fin = ((eos >= 0) & (picked == eos)) | (lf2 <= 0) | \
+                (p2 >= seq_end)
+            act2 = adv & ~fin
+            emit = jnp.where(act, picked, jnp.int32(-2))
+            return (pool, tok2, p2, lf2, act2), emit
+
+        carry = (pool, token.astype(jnp.int32), pos.astype(jnp.int32),
+                 left.astype(jnp.int32), left > 0)
+        (pool, _, _, _, _), toks = jax.lax.scan(step, carry, None,
+                                                length=steps)
+        return toks.T, pool
+
     def verify_paged(self, params, pool, tokens, pos, length, tables):
         """Speculative-decoding verify: score a whole draft run with ONE
         launch (the draft-verify counterpart of `decode_paged`).

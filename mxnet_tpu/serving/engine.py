@@ -234,6 +234,16 @@ class ServeRequest:
         #                           preemption until it advances
         #                           MXNET_SERVE_MIN_PROGRESS tokens past it
         self._migrated = False    # journal migration pending its replay
+        # streaming (docs/serving.md "Megastep decode & streaming"):
+        # `stream()` iterators sleep on this condition; `_published` is
+        # the scheduler's delivery high-water mark into `self.tokens`.
+        # Exactly-once across preemption/migration is structural: every
+        # resume path rebuilds context from (prompt+generated)[:pos]
+        # and NEVER truncates or re-appends `tokens`, so indices below
+        # the mark are final and new tokens only ever appear above it.
+        self._stream_cond = threading.Condition()
+        self._published = 0
+        self._on_token = None     # optional submit(on_token=...) callback
 
     @property
     def done(self):
@@ -266,6 +276,64 @@ class ServeRequest:
             raise cls(msg if tag in msg else "%s: %s" % (tag, msg))
         return list(self.tokens)
 
+    def stream(self, timeout=None):
+        """Iterate this request's generated tokens as the scheduler
+        publishes them — one `int` per generated token, in order, each
+        exactly once — instead of waiting for `result()` at retire.
+        (Named `stream()` rather than `tokens()`: `self.tokens` is the
+        generated-token LIST, the journal's durable record.)
+
+        Tokens become visible after every scheduler iteration (every
+        megastep with `MXNET_SERVE_MEGASTEP`, every decode/verify round
+        without), so a consumer sees at most one iteration of latency.
+        Preemption, quant-gate requeues and journal migration are
+        invisible mid-stream: the resume replays context, not output,
+        so the iterator never re-yields and never skips.  Ends when the
+        request finishes; a failed request raises its typed error (after
+        yielding everything that was delivered first).  ``timeout``
+        bounds each WAIT for the next token (`ServeTimeout`), not the
+        whole stream.  Multiple concurrent iterators each get the full
+        stream; `result()` still works alongside.
+        """
+        cursor = 0
+        while True:
+            with self._stream_cond:
+                while len(self.tokens) <= cursor and not self._done.is_set():
+                    if not self._stream_cond.wait(timeout):
+                        raise ServeTimeout(
+                            "ServeRequest %d: stream timed out after %ss"
+                            % (self.id, timeout))
+                # snapshot under the condition: the scheduler appends
+                # then notifies, so this view is never torn
+                batch = list(self.tokens[cursor:])
+            for t in batch:
+                cursor += 1
+                yield int(t)
+            if self._done.is_set() and cursor >= len(self.tokens):
+                if self.error is not None:
+                    self.result(timeout=0.001)  # raises the typed error
+                return
+
+    def _publish(self):
+        """Scheduler-side delivery point: wake `stream()` iterators and
+        fire the `on_token` callback for tokens newly appended to
+        `self.tokens`.  The high-water mark makes delivery exactly-once
+        — a replayed/migrated request re-enters decode with its token
+        list intact, so nothing below the mark is ever re-delivered."""
+        n = len(self.tokens)
+        if n <= self._published:
+            return
+        lo, self._published = self._published, n
+        with self._stream_cond:
+            self._stream_cond.notify_all()
+        cb = self._on_token
+        if cb is not None:
+            for t in self.tokens[lo:n]:
+                try:
+                    cb(int(t))
+                except Exception:  # a consumer bug must not kill the
+                    pass           # scheduler thread
+
     # latency views (ms), None until the corresponding stamp exists
     @property
     def ttft_ms(self):
@@ -283,6 +351,8 @@ class ServeRequest:
         self.error = error
         self.t_done = time.perf_counter()
         self._done.set()
+        with self._stream_cond:
+            self._stream_cond.notify_all()  # unblock stream() waiters
 
 
 class _Seq:
@@ -415,7 +485,8 @@ class ServingEngine:
                  prefix_pool=None, spec=None, spec_k=None,
                  spec_drafter=None, min_progress=None, thrash_trip=None,
                  tier=None, host_blocks=None, restore_ahead=None,
-                 quant=None, kv_quant=None):
+                 quant=None, kv_quant=None, megastep=None,
+                 megastep_steps=None):
         model.check_params(params)
         self.model = model
         self.name = name
@@ -619,6 +690,29 @@ class ServingEngine:
                 os.environ.get("MXNET_SERVE_SPEC_DRAFTER", "ngram")
                 if spec_drafter is None else spec_drafter)
             self._drafter.bind(self)
+        # megastep decode (docs/serving.md "Megastep decode & streaming"):
+        # MXNET_SERVE_MEGASTEP fuses m single-token decode launches into
+        # ONE lax.scan launch with in-graph retirement, and the scheduler
+        # runs its host sweep (retire/admission/journal) while the next
+        # megastep is already in flight.  =0 (the default) is the PR-15
+        # single-step loop bit-for-bit.
+        mega_on = _env_flag("MXNET_SERVE_MEGASTEP", "0") if megastep \
+            is None else bool(megastep)
+        self._mega_m = 0
+        if mega_on:
+            if not self._paged:
+                raise MXNetError(
+                    "ServingEngine: megastep decode needs the paged cache "
+                    "(MXNET_SERVE_MEGASTEP=1 with MXNET_SERVE_PAGED=0) — "
+                    "in-graph retirement parks dead rows on the trash "
+                    "block, which only the paged path has")
+            self._mega_m = int(
+                os.environ.get("MXNET_SERVE_MEGASTEP_STEPS", "4")
+                if megastep_steps is None else megastep_steps)
+            if self._mega_m < 1:
+                raise MXNetError(
+                    "ServingEngine: MXNET_SERVE_MEGASTEP_STEPS must be "
+                    ">= 1, got %d" % self._mega_m)
         self._aot = aot if aot is not None else AotCache("serve.aot")
         # gauges are namespaced per replica: engines share one process-wide
         # registry, and a global "serve.queue_depth" written by N scheduler
@@ -691,7 +785,17 @@ class ServingEngine:
                       "prefill_tokens": 0, "session_hits": 0,
                       "session_turns": 0,
                       # quantization (0s when disabled)
-                      "quant_trips": 0, "scale_corrupts": 0}
+                      "quant_trips": 0, "scale_corrupts": 0,
+                      # decode-loop accounting behind the host_frac
+                      # gauge: hidden_s spans launch-dispatch -> fetch-
+                      # complete (host work inside it rides under the
+                      # in-flight launch for free), host_s is the
+                      # EXPOSED remainder the device pipeline is not
+                      # covering — the thing double-buffering shrinks
+                      "megasteps": 0, "megastep_tokens": 0,
+                      "ingraph_retired": 0, "wall_s": 0.0,
+                      "host_s": 0.0, "hidden_s": 0.0,
+                      "fetch_wait_s": 0.0}
 
     # -- program building --------------------------------------------------
     _SAMPLE_NAMES = ("temp", "top_k", "top_p", "seed")
@@ -803,6 +907,35 @@ class ServingEngine:
                             *samp).compile()
 
         return self._aot.get(("decode", b_bucket, 1), build)
+
+    def _compiled_mega(self, b_bucket):
+        """The m-step fused decode megastep (docs/serving.md "Megastep
+        decode & streaming"): ONE launch scans ``self._mega_m`` copies
+        of the single-token decode body with per-row active masks, so
+        EOS / max_new_tokens / cache-depth retirement happens in-graph
+        mid-scan.  Output is a (b, m) int32 token grid: >=0 real token,
+        -1 quant trip (earlier emits stand), -2 dead row.  Sampling
+        folds the carried position per scan step, so the grid is
+        bit-identical to m sequential single-step launches."""
+        m = self._mega_m
+
+        def build():
+            def prog(params, pool, token, pos, left, eos, tables, *samp):
+                def pick(logits, newpos):
+                    return self._pick(logits, samp, newpos)
+                return self.model.decode_megastep(
+                    params, pool, token, pos, left, eos, tables, m, pick)
+
+            fn = jax.jit(prog, donate_argnums=(1,))
+            z = self._put(np.zeros((b_bucket,), np.int32))
+            tables = self._put(np.zeros((b_bucket, self._n_table),
+                                        np.int32))
+            samp = tuple(self._put(a)
+                         for a in self._sample_placeholders(b_bucket))
+            return fn.lower(self._params, self._cache, z, z, z, z,
+                            tables, *samp).compile()
+
+        return self._aot.get(("megastep", b_bucket, m), build)
 
     def _pick_cols(self, logits, samp, pos):
         """`_pick` over a (b, c, vocab) verify chunk: column j's token
@@ -957,6 +1090,14 @@ class ServingEngine:
         return ((z, z, z) + samp,
                 ("token", "pos", "slots") + self._SAMPLE_NAMES[:len(samp)])
 
+    def _mega_watch_arrays(self, b):
+        z = np.zeros((b,), np.int32)
+        samp = self._sample_placeholders(b)
+        tables = np.zeros((b, self._n_table), np.int32)
+        return ((z, z, z, z, tables) + samp,
+                ("token", "pos", "left", "eos", "tables")
+                + self._SAMPLE_NAMES[:len(samp)])
+
     def warmup(self):
         """AOT-compile every bucket shape up front, and pre-seed the
         retrace watchdog with each bucket's call signature (the watchdog
@@ -992,6 +1133,14 @@ class ServingEngine:
                 darrays, dnames = self._decode_watch_arrays(b)
                 self._watch("draft", darrays, dnames, b, seed=True)
             self._drafter.warmup()
+        if self._mega_m:
+            # every (bucket, m) megastep shape joins the frozen set —
+            # steady state with megastep on compiles nothing, same gate
+            # as plain decode
+            for b in self.decode_buckets:
+                self._compiled_mega(b)
+                arrays, names = self._mega_watch_arrays(b)
+                self._watch("megastep", arrays, names, b, seed=True)
         if self._prefix is not None:
             self._compiled_cow()
             arrays, names = self._cow_watch_arrays()
@@ -1014,6 +1163,8 @@ class ServingEngine:
                  "restore_ahead": self._restore_ahead},
                 "spec": None if not self._spec else
                 {"k": self._spec_k, "drafter": self._drafter.name},
+                "megastep": None if not self._mega_m else
+                {"m": self._mega_m},
                 "quant": None if not self._quant_gate else
                 {"weights": None if self._quant is None
                  else self._quant.name,
@@ -1048,7 +1199,9 @@ class ServingEngine:
             restore_ahead=self._restore_ahead,
             quant=self._quant if self._quant is not None else "0",
             kv_quant=self._kv_quant if self._kv_quant is not None
-            else "0")
+            else "0",
+            megastep=bool(self._mega_m),
+            megastep_steps=self._mega_m or None)
 
     # -- request intake ----------------------------------------------------
     def has_session(self, key):
@@ -1141,16 +1294,16 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
                deadline_ms=None, temperature=0.0, top_k=0, top_p=1.0,
-               seed=None, session=None, _count_shed=True):
+               seed=None, session=None, on_token=None, _count_shed=True):
         if session is None:
             return self._submit(prompt, max_new_tokens, eos_id,
                                 deadline_ms, temperature, top_k, top_p,
-                                seed, None, _count_shed)
+                                seed, None, on_token, _count_shed)
         prompt = self._session_prompt(session, prompt)  # claims the turn
         try:
             return self._submit(prompt, max_new_tokens, eos_id,
                                 deadline_ms, temperature, top_k, top_p,
-                                seed, session, _count_shed)
+                                seed, session, on_token, _count_shed)
         except BaseException:
             # shed/rejected after the claim: the conversation reverts to
             # exactly its pre-submit state — retryable, never bricked
@@ -1158,7 +1311,8 @@ class ServingEngine:
             raise
 
     def _submit(self, prompt, max_new_tokens, eos_id, deadline_ms,
-                temperature, top_k, top_p, seed, session, _count_shed):
+                temperature, top_k, top_p, seed, session, on_token,
+                _count_shed):
         if max_new_tokens is None:
             max_new_tokens = self.max_new_default
         elif int(max_new_tokens) < 1:
@@ -1177,6 +1331,7 @@ class ServingEngine:
                            deadline_ms=deadline_ms,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, seed=seed, session=session)
+        req._on_token = on_token
         if not (self._paged and self._chunk_prefill) and \
                 len(req.prompt) > self.prefill_buckets[-1]:
             # chunked prefill streams any prompt through bucket-sized
@@ -1812,6 +1967,7 @@ class ServingEngine:
             self._retire(slot, seq, enter=False)
         else:
             self._active[slot] = seq
+        req._publish()
         return True
 
     # -- paged admission / chunked prefill ---------------------------------
@@ -2212,6 +2368,7 @@ class ServingEngine:
             self._retire(pf.row, seq, enter=False)
         else:
             self._active[pf.row] = seq
+        req._publish()
 
     def _grow_active(self):
         """Before a decode step, every active row must EXCLUSIVELY own
@@ -2237,6 +2394,10 @@ class ServingEngine:
         # drafts, clipped at the cache end), so every block the span
         # lands in — not just one — must exist and be exclusively owned
         span = self._spec_k + 1 if self._spec else 1
+        if self._mega_m:
+            # a megastep writes up to m positions before the host sees
+            # any of them, so the whole m-span must be covered up front
+            span = max(span, self._mega_m)
         self._stalled.clear()
         oldest = self._oldest_inflight()
         for row, seq in list(self._active.items()):
@@ -2583,9 +2744,39 @@ class ServingEngine:
                 pass  # shed: exactly the pressure the clause probes
 
     def step(self):
-        """One scheduler iteration: sweep deadlines/cancellations, admit
-        while there is room, then one decode step over the active set.
-        Returns the number of sequences still active (0 = idle)."""
+        """One scheduler iteration.  Dispatches to the PR-15 single-step
+        body (`_step`) or the double-buffered megastep body
+        (`_step_mega`), wrapped in the decode-loop wall/host accounting
+        behind the `serve.<name>.host_frac` gauge.  host_frac is the
+        EXPOSED host fraction: wall time outside any launch-dispatch ->
+        fetch-complete span — host work the in-flight launch was NOT
+        hiding.  Single-step fetches right after dispatch, so its whole
+        sweep is exposed; the double-buffered megastep runs the sweep
+        inside the span, so the gauge collapses toward the walk/launch
+        residue.  Only iterations that actually launched accumulate (an
+        idle or admission-only iteration has no decode loop to
+        attribute).  Returns the number of sequences still active
+        (0 = idle)."""
+        t0 = time.perf_counter()
+        h0 = self.stats["hidden_s"]
+        if self._mega_m and not self._spec:
+            n = self._step_mega()
+        else:
+            n = self._step()
+        dh = self.stats["hidden_s"] - h0
+        if dh > 0:
+            wall = time.perf_counter() - t0
+            self.stats["wall_s"] += wall
+            self.stats["host_s"] += max(0.0, wall - dh)
+            telemetry.set_gauge(
+                self._gauge + "host_frac",
+                round(self.stats["host_s"] / self.stats["wall_s"], 4))
+        return n
+
+    def _step(self):
+        """One single-step scheduler iteration: sweep deadlines/
+        cancellations, admit while there is room, then one decode step
+        over the active set."""
         self.last_beat = time.monotonic()
         if chaos.enabled():
             self._inject_flood()
@@ -2690,6 +2881,7 @@ class ServingEngine:
         self._watch("decode", args,
                     names + self._SAMPLE_NAMES[:len(samp)], b)
         compiled = self._compiled_decode(b)
+        t_launch = time.perf_counter()
         try:
             if chaos.serve_launch_error():
                 raise chaos.ChaosError("chaos: injected decode launch error")
@@ -2701,7 +2893,11 @@ class ServingEngine:
             return len(self._active) + len(self._prefilling) + \
                 len(self._restoring)
         self._launch_fails = 0
+        t_fetch = time.perf_counter()
         nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
+        now = time.perf_counter()
+        self.stats["fetch_wait_s"] += now - t_fetch
+        self.stats["hidden_s"] += now - t_launch
         self.stats["decode_steps"] += 1
         self.stats["decode_rows"] += n
         self.stats["decode_padded"] += b - n
@@ -2724,8 +2920,216 @@ class ServingEngine:
                 self._drafter.observe(seq.ctx + [seq.last], 1)
             if finished:
                 self._retire(slot, seq)
+            seq.req._publish()
         return len(self._active) + len(self._prefilling) + \
                 len(self._restoring)
+
+    def _step_mega(self):
+        """One double-buffered megastep iteration (docs/serving.md
+        "Megastep decode & streaming"): the m-step launch is dispatched
+        FIRST, the host sweep (retire/admission/block accounting/
+        journal) runs while it is in flight, and only then does the
+        iteration block on the (b, m) token grid — the
+        `DevicePrefetchIter` two-stage overlap applied to `_sweep`.
+        Safe because the device stream is serial (a prefill queued
+        during the overlap window executes after the megastep's writes,
+        so a freed-and-reassigned block is rewritten by its new owner
+        before any read) and because `_finish_mega` identity-checks
+        each row against `_active` (a row swept or preempted mid-
+        flight just drops its in-flight tokens; replay resumes from
+        the pre-megastep journal position)."""
+        self.last_beat = time.monotonic()
+        if chaos.enabled():
+            self._inject_flood()
+            if self._kv_quant is not None:
+                u = chaos.serve_scale_corrupt()
+                if u is not None:
+                    self._corrupt_scales(u)
+            if self._prefix is not None and chaos.serve_prefix_evict():
+                evicted = self._prefix.evict(1)
+                if evicted:
+                    self._alloc.reclaim(evicted)
+                    self._count_evictions(len(evicted))
+        inflight = None
+        if self._active:
+            # grow BEFORE launch: the megastep writes up to m positions
+            # before the host sees any of them, so the whole span must
+            # be covered (and exclusively owned) up front
+            self._grow_active()
+            self._block_gauges(full=True)
+            inflight = self._launch_mega()
+        # -- overlap window: host work the device no longer waits on --
+        self._sweep()
+        self._advance_restores()
+        self._advance_prefills()
+        while self._free:
+            with self._qlock:
+                req = self._queue.popleft() if self._queue else None
+                if req is not None:
+                    self._admitting += 1
+                    self._qcond.notify_all()
+            if req is None:
+                break
+            try:
+                if req._cancelled or req.expired():
+                    self._finish_dropped(req)
+                    continue
+                if self._admit_one(req) is False:
+                    break
+            finally:
+                with self._qlock:
+                    self._admitting -= 1
+        with self._qlock:
+            telemetry.set_gauge(self._gauge + "queue_depth",
+                                len(self._queue))
+        n = len(self._active)
+        if n > self.stats["max_concurrent"]:
+            self.stats["max_concurrent"] = n
+        telemetry.set_gauge(self._gauge + "active", n)
+        if chaos.enabled() and inflight is not None:
+            if chaos.serve_engine_crash(self.name):
+                # the mid-megastep crash: the launch is in flight, its
+                # tokens are not yet journaled — replay must resume
+                # from the last PROCESSED position without re-streaming
+                raise chaos.ChaosEngineCrash(
+                    "chaos: engine_crash killed replica %s" % self.name)
+            ms = chaos.serve_decode_slow()
+            if ms:
+                time.sleep(ms / 1e3)
+        if inflight is not None:
+            self._finish_mega(inflight)
+        elif self._active:
+            # every active row is stalled on a denied allocation —
+            # back off briefly so the retry loop doesn't spin the host
+            time.sleep(0.001)
+        return len(self._active) + len(self._prefilling) + \
+            len(self._restoring)
+
+    def _launch_mega(self):
+        """Dispatch ONE m-step megastep over the non-stalled active
+        rows and return the in-flight handle WITHOUT blocking —
+        `_finish_mega` fetches after the host sweep has already run
+        under the launch.  Returns None when nothing launched (all
+        rows stalled, or the launch failed and took the retry
+        ladder)."""
+        slots = [s for s in self._active if s not in self._stalled]
+        nrows = len(slots)
+        if nrows == 0:
+            return None
+        b = self._bucket_for(nrows, self.decode_buckets)
+        seqs = [self._active[s] for s in slots]
+        token = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        left = np.zeros((b,), np.int32)
+        eos = np.full((b,), -1, np.int32)
+        tables = np.full((b, self._n_table), TRASH_BLOCK, np.int32)
+        for i, seq in enumerate(seqs):
+            token[i] = seq.last
+            pos[i] = seq.pos
+            left[i] = max(0, seq.req.max_new_tokens - seq.n_new)
+            if seq.req.eos_id is not None:
+                eos[i] = int(seq.req.eos_id)
+            tables[i, :len(seq.blocks)] = seq.blocks
+        samp = self._samp_device([s.req for s in seqs], b)
+        args = (self._put(token), self._put(pos), self._put(left),
+                self._put(eos), self._put(tables)) + samp
+        self._watch("megastep", args,
+                    ("token", "pos", "left", "eos", "tables")
+                    + self._SAMPLE_NAMES[:len(samp)], b)
+        compiled = self._compiled_mega(b)
+        t_launch = time.perf_counter()
+        try:
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError(
+                    "chaos: injected megastep launch error")
+            out, self._cache = compiled(self._params, self._cache, *args)
+        except Exception as e:
+            self._handle_launch_failure(e, "megastep")
+            return None
+        self._launch_fails = 0
+        return (slots, seqs, out, nrows, b, t_launch)
+
+    def _finish_mega(self, inflight):
+        """Fetch a megastep's (b, m) token grid and walk it row-major
+        through `_advance_one` — the SAME single bookkeeping site the
+        plain and speculative loops use, so stopping, ctx order and
+        prefix registration cannot diverge.  Grid sentinels: >=0 real
+        token, -1 quant trip at that step (earlier emits stand, the
+        trip scrubs/requeues exactly as a single-step trip would),
+        -2 dead (the row retired at an earlier step — or was launched
+        already-finished)."""
+        slots, seqs, out, nrows, b, t_launch = inflight
+        t_fetch = time.perf_counter()
+        out = np.asarray(out)  # the one per-megastep host fetch
+        now = time.perf_counter()
+        self.stats["fetch_wait_s"] += now - t_fetch
+        # the launch->fetch span: every host cycle spent inside it
+        # (the whole overlap window) rode under the in-flight megastep
+        self.stats["hidden_s"] += now - t_launch
+        m = self._mega_m
+        self.stats["megasteps"] += 1
+        self.stats["decode_rows"] += nrows
+        self.stats["decode_padded"] += b - nrows
+        telemetry.inc("serve.megasteps")
+        telemetry.inc("serve.decode_padded", b - nrows)
+        telemetry.set_gauge(self._gauge + "batch_occupancy",
+                            nrows / float(b))
+        emitted = retired = 0
+        for i, (slot, seq) in enumerate(zip(slots, seqs)):
+            if self._active.get(slot) is not seq:
+                # swept, preempted or vacated while in flight: its
+                # in-flight tokens drop on the floor; the journal still
+                # holds the pre-megastep position, so replay neither
+                # loses nor duplicates anything
+                continue
+            adv = 0
+            finished = tripped = False
+            for j in range(m):
+                t = int(out[i, j])
+                if t == -2:
+                    break
+                if t < 0:
+                    tripped = True
+                    break
+                finished = self._advance_one(seq, t)
+                adv += 1
+                if finished:
+                    break
+            emitted += adv
+            if tripped:
+                # quantization logit gate: never emit the flagged token
+                self._quant_trip_seq(slot, seq, "megastep")
+            elif finished:
+                retired += 1  # retirement decided in-graph, mid-scan
+                self._retire(slot, seq)
+            elif adv and self._drafter is not None \
+                    and seq.ctx is not None:
+                self._drafter.observe(seq.ctx + [seq.last], adv)
+            seq.req._publish()
+        self.stats["tokens"] += emitted
+        self.stats["megastep_tokens"] += emitted
+        self.stats["ingraph_retired"] += retired
+        telemetry.inc("serve.tokens", emitted)
+        telemetry.inc("serve.megastep_tokens", emitted)
+        if retired:
+            telemetry.inc("serve.ingraph_retired", retired)
+
+    def _decode_mega(self):
+        """Synchronous megastep round: launch + immediate fetch — the
+        speculative mode's no-usable-draft fallback when megastep is
+        also on.  Spec verify rounds and megasteps share
+        `_advance_one` and the block-span bookkeeping
+        (`_grow_active` covers max(k+1, m)), so the two interleave
+        without diverging from either oracle."""
+        inflight = self._launch_mega()
+        if inflight is None:
+            if self._active:
+                time.sleep(0.001)
+            return len(self._active) + len(self._prefilling) + \
+                len(self._restoring)
+        self._finish_mega(inflight)
+        return len(self._active) + len(self._prefilling) + \
+            len(self._restoring)
 
     def _advance_one(self, seq, t):
         """Advance one sequence by ONE emitted token ``t`` — the single
@@ -2841,7 +3245,12 @@ class ServingEngine:
             if not np.asarray(confident)[:n].any():
                 # adaptive speculation: with no usable draft anywhere in
                 # the batch a verify could only advance one token per
-                # row — run the (cheaper) plain decode round instead
+                # row — run the (cheaper) plain round instead; with
+                # megastep on the fallback fuses m steps (the megastep
+                # x speculation interlock: both paths run _advance_one
+                # and share the max(k+1, m) block-span bookkeeping)
+                if self._mega_m:
+                    return self._decode_mega()
                 return self._decode_plain()
         if chaos.enabled() and chaos.serve_draft_junk():
             # `draft_junk:P`: deterministically corrupt the round's
@@ -2859,6 +3268,7 @@ class ServingEngine:
                     ("tokens", "pos", "length", "tables")
                     + self._SAMPLE_NAMES[:len(samp)], b)
         compiled = self._compiled_verify(b)
+        t_launch = time.perf_counter()
         try:
             if chaos.serve_launch_error():
                 raise chaos.ChaosError("chaos: injected verify launch "
@@ -2869,7 +3279,11 @@ class ServingEngine:
             return len(self._active) + len(self._prefilling) + \
                 len(self._restoring)
         self._launch_fails = 0
+        t_fetch = time.perf_counter()
         out = np.asarray(out)  # (b, k+2): picks then n_accepted
+        now = time.perf_counter()
+        self.stats["fetch_wait_s"] += now - t_fetch
+        self.stats["hidden_s"] += now - t_launch
         self.stats["verify_steps"] += 1
         self.stats["decode_rows"] += n
         self.stats["decode_padded"] += b - n
@@ -2921,6 +3335,7 @@ class ServingEngine:
                     self._drafter.observe(seq.ctx + [seq.last],
                                           seq.n_new - seqs_n_new[i])
                 self._rewind_blocks(seq)
+            seq.req._publish()
         self.stats["tokens"] += emitted_total
         telemetry.inc("serve.tokens", emitted_total)
         if self.stats["spec_proposed"]:

@@ -40,6 +40,14 @@ this cheap — the prompt's shared blocks are likely resident), re-enter
 decode at the same position with the same request-keyed RNG, and the
 continuation is bit-identical to the undisturbed run.
 
+Positional resume is also what makes token STREAMING exactly-once: no
+recovery path ever truncates or re-appends ``req.tokens`` — replay
+regenerates only tokens that were never appended — so the handle's
+published high-water mark (`ServeRequest._publish`) and `stream()`
+cursors never see a position twice.  A megastep launch in flight at
+death was never fetched, so its rows' journal positions predate it and
+replay regenerates those tokens without a gap or a duplicate.
+
 ``MXNET_SERVE_JOURNAL=0`` disables the journal: replica death falls
 back to the PR-11 contract (admitted requests fail typed with
 `ServeEngineDead`, queued ones re-dispatch), bit for bit.

@@ -16,7 +16,10 @@ that will occupy absolute position P of request R uses
   alone or surrounded by neighbours joining/leaving mid-flight (the
   continuous-batching parity contract extends to sampled traffic);
 * a preempted-and-requeued sequence resumes drawing exactly where it
-  left off (position-keyed, not step-keyed).
+  left off (position-keyed, not step-keyed);
+* the megastep decode scan is bit-identical to m sequential launches —
+  each fused step folds in the CARRIED position, so the fused program
+  consumes exactly the RNG stream the single-step loop would.
 
 Padding rows ride the greedy path (temperature 0) and their output is
 discarded by the scheduler.

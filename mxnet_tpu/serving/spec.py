@@ -53,6 +53,12 @@ programs join the frozen AotCache bucket set), `on_prefill_chunk` after
 every target prefill chunk (the draft cache prefills in lockstep),
 `on_cow` after a target copy-on-write (same src/dst block pair), and
 `on_cache_rebuild` when the target pool is rebuilt.
+
+Megastep interlock: with `MXNET_SERVE_MEGASTEP` on too, speculation
+keeps the iteration (it already amortizes launches k+1-wide and its
+accept bookkeeping is host-sequential by design); the fused megastep
+replaces the plain single-token program as the fallback when no row
+has a usable draft, so cold batches still advance m tokens per launch.
 """
 from __future__ import annotations
 

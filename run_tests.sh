@@ -89,6 +89,17 @@ elif [ "$1" = "--serve-durability-smoke" ]; then
     T1=""
     set -- tests/test_serve_durability.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-megastep-smoke" ]; then
+    # fast megastep smoke: m-step fused decode vs the sequential
+    # single-step oracle (token parity across EOS/max_new/depth edges,
+    # T=0 and T>0, spec on/off), in-graph retirement accounting, the
+    # double-buffered sweep, token streaming (iterator + callback,
+    # exactly-once across crash/migration), and the megastep
+    # zero-retrace gate (docs/serving.md "Megastep decode & streaming")
+    shift
+    T1=""
+    set -- tests/test_serve_megastep.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

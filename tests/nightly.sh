@@ -294,6 +294,61 @@ PY
 # --serve-spec-smoke runs the same clauses as unit tests)
 ./run_tests.sh --serve-spec-smoke -k "chaos or preemption"
 
+# -- megastep-decode gate (docs/serving.md "Megastep decode &
+# streaming") -------------------------------------------------------------
+# one-token-per-launch vs m-step fused megastep A/B at small batch on
+# the templated mixed trace: the megastep leg must deliver STRICTLY
+# higher tok/s/chip (the whole point is removing the per-token host
+# round-trip), drive the exposed-host fraction below 0.5 and below the
+# single-step leg's, keep token-for-token greedy parity (the fused scan
+# is exact, not approximate), and leak nothing / recompile nothing on
+# either leg (every (bucket, m) megastep shape joins the frozen warmup
+# set); artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    SERVE_REQUESTS=64 \
+    python bench.py --serve --megastep | tee /tmp/nightly_serve_megastep.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_megastep.log").read().strip().splitlines()[-1])
+off, mega = rec["off"], rec["megastep"]
+for leg, r in (("off", off), ("megastep", mega)):
+    assert r["completed"] == r["requests"], \
+        "megastep gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["steady_state_recompiles"] == 0, \
+        "megastep gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "megastep gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+    assert r["blocks"]["leaked"] == 0, \
+        "megastep gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+assert rec["token_parity"], \
+    "megastep gate: outputs diverged between megastep and single-step legs"
+assert rec["value"] > 1.0, \
+    "megastep gate: %sx tok/s/chip — megastep must be strictly faster " \
+    "than one-token-per-launch at small batch" % rec["value"]
+hf_off, hf_mega = rec["host_frac"]["off"], rec["host_frac"]["megastep"]
+assert hf_mega is not None and hf_mega < 0.5, \
+    "megastep gate: exposed host fraction %s not driven below 0.5" % hf_mega
+assert hf_off is None or hf_mega < hf_off, \
+    "megastep gate: host_frac did not shrink (off %s -> megastep %s)" % (
+        hf_off, hf_mega)
+assert rec["ingraph_retired"] > 0, \
+    "megastep gate: no request ever retired in-graph mid-scan"
+print("megastep gate passed: %sx tok/s (%s -> %s), m=%s, host_frac "
+      "%s -> %s, ingraph_retired %s" % (
+          rec["value"], rec["tok_s"]["off"], rec["tok_s"]["megastep"],
+          rec["m"], hf_off, hf_mega, rec["ingraph_retired"]))
+PY
+
+# -- megastep chaos + streaming smoke: engine_crash mid-megastep and
+# mid-stream must replay from the journal without re-streaming delivered
+# tokens (run_tests.sh --serve-megastep-smoke runs the same clauses as
+# unit tests)
+./run_tests.sh --serve-megastep-smoke -k "chaos or crash or stream"
+
 # -- serve-chaos gate (docs/serving.md "Failure semantics") ---------------
 # the same Poisson run with one replica crashed mid-traffic, slow decode
 # steps, and injected launch errors: every request must RESOLVE (tokens

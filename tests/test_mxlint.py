@@ -356,6 +356,31 @@ def test_aot_dynamic_shape_detected_and_bucketed_clean(tmp_path):
     assert "admit_bad" in res.findings[0].message
 
 
+def test_aot_dynamic_scan_length_detected_and_bucketed_clean(tmp_path):
+    # the megastep decode scan compiles one program per distinct scan
+    # length: a per-request `m` leaking into `lax.scan(length=...)` is
+    # the same retrace storm as a per-request array dim — only
+    # *bucket*-table lookups are sanctioned
+    res = lint(tmp_path, {"mxnet_tpu/serving/mega.py": """
+        import jax
+
+        def fuse_bad(self, req, carry, body):
+            m = req.max_new_tokens
+            return jax.lax.scan(body, carry, None, length=m)
+
+        def fuse_bad_positional(self, req, carry, body):
+            return jax.lax.scan(body, carry, None, len(req.tokens))
+
+        def fuse_good(self, req, carry, body):
+            m = self._mega_bucket_for(req.max_new_tokens)
+            return jax.lax.scan(body, carry, None, length=m)
+    """}, rules=["aot-dynamic-shape"])
+    assert rule_ids(res) == ["aot-dynamic-shape", "aot-dynamic-shape"]
+    assert "fuse_bad" in res.findings[0].message
+    assert "fuse_bad_positional" in res.findings[1].message
+    assert all("scan length" in f.message for f in res.findings)
+
+
 def test_aot_rule_only_fires_in_serving(tmp_path):
     res = lint(tmp_path, {"mxnet_tpu/ops/pad.py": """
         import jax.numpy as jnp

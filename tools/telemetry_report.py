@@ -91,6 +91,14 @@ SERVE_TIER_COUNTERS = (
 SERVE_TIER_GAUGE_SUFFIX = ".host_blocks_used"
 SERVE_TIER_EVENT_KINDS = ("serve_spill_failed", "serve_restore_failed")
 
+# decode-loop accounting (docs/serving.md "Megastep decode &
+# streaming"): fused megastep launches/tokens, rows retired in-graph
+# mid-scan, and the per-replica exposed-host fraction gauge
+# (serve.<name>.host_frac) the double-buffered sweep drives down
+SERVE_DECODE_LOOP_COUNTERS = (
+    "serve.megasteps", "serve.megastep_tokens", "serve.ingraph_retired")
+SERVE_DECODE_LOOP_GAUGE_SUFFIX = ".host_frac"
+
 # quantization accounting (docs/serving.md "Quantization"): logit-gate
 # trips + chaos scale corruptions (serve.<name>.quant.* per replica,
 # process-wide serve.quant.*), and the live logit-error gauge the
@@ -312,6 +320,22 @@ def summarize(records):
         tiering["serve.restore_wait_ms"] = wait
     if tiering:
         out["tiering"] = tiering
+    decode_loop = {k: int(final.get(k, 0))
+                   for k in SERVE_DECODE_LOOP_COUNTERS if final.get(k)}
+    for r in records:
+        for k, v in r.get("gauges", {}).items():
+            if k.startswith("serve.") and \
+                    k.endswith(SERVE_DECODE_LOOP_GAUGE_SUFFIX):
+                decode_loop[k] = v  # last-seen per replica
+    if decode_loop:
+        megs = decode_loop.get("serve.megasteps", 0)
+        if megs:
+            # tokens each fused launch actually emitted — m minus the
+            # padding and the dead tail behind in-graph retirements
+            decode_loop["tokens_per_megastep"] = round(
+                decode_loop.get("serve.megastep_tokens", 0) / float(megs),
+                2)
+        out["decode_loop"] = decode_loop
     quantization = {k: int(final.get(k, 0)) for k in SERVE_QUANT_COUNTERS
                     if final.get(k)}
     for r in records:
@@ -399,6 +423,11 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %s" % (key, v))
+    decode_loop = summary.get("decode_loop")
+    if decode_loop:
+        lines.append("  decode loop:")
+        for key in sorted(decode_loop):
+            lines.append("    %-24s %s" % (key, decode_loop[key]))
     quantization = summary.get("quantization")
     if quantization:
         lines.append("  quantization:")
