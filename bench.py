@@ -620,7 +620,8 @@ def serve_bench(record=True, with_chaos=False):
             "MXNET_CHAOS",
             "engine_crash:%d:replica0,decode_slow:0.05:20,"
             "launch_error:0.02,block_exhaust:0.05,prefix_evict:0.05,"
-            "draft_junk:0.1,scale_corrupt:0.05" % max(4, n_requests // 6))
+            "draft_junk:0.1,scale_corrupt:0.05,handoff_fail:0.05"
+            % max(4, n_requests // 6))
         os.environ.setdefault("SERVE_REPLICAS", "2")
         os.environ.setdefault("SERVE_DEADLINE_MS", "10000")
         chaos_mod.reset()
@@ -749,6 +750,33 @@ def serve_bench(record=True, with_chaos=False):
         newlens = _lens(float(os.environ.get("SERVE_NEW_MEAN",
                                              str(max(2, max_new // 2)))),
                         max_new, n_requests)
+    elif trace == "burst":
+        # decode-heavy Poisson background + periodic long-prompt STORMS
+        # (the disaggregation A/B's traffic, docs/serving.md): background
+        # requests are short prompts with long outputs — steady decode
+        # streams whose inter-token latency is the metric — and every
+        # SERVE_BURST_EVERY submissions a storm of SERVE_BURST_SIZE
+        # near-cap prompts arrives back to back.  Colocated, each storm
+        # prompt's prefill chunks share the iteration loop with every
+        # decoding row; disaggregated, they queue on the prefill role.
+        burst_every = int(os.environ.get("SERVE_BURST_EVERY", "12"))
+        burst_size = int(os.environ.get("SERVE_BURST_SIZE", "4"))
+        burst_prompt = int(os.environ.get("SERVE_BURST_PROMPT",
+                                          str(prompt_max)))
+        plens, newlens, burst_mask = [], [], []
+        for i in range(n_requests):
+            storm = burst_every > 0 and i % burst_every < burst_size \
+                and i >= burst_size  # no storm before background exists
+            burst_mask.append(storm)
+            if storm:
+                plens.append(burst_prompt)
+                newlens.append(max(1, max_new // 4))
+            else:
+                plens.append(int(rng.randint(
+                    1, max(2, prompt_max // 4) + 1)))
+                newlens.append(max_new)
+        plens = np.array(plens)
+        newlens = np.array(newlens)
     else:
         plens = rng.randint(1, prompt_max + 1, size=n_requests)
         newlens = np.full(n_requests, max_new)
@@ -756,6 +784,8 @@ def serve_bench(record=True, with_chaos=False):
         prompts = [list(rng.randint(0, vocab, size=int(n))) for n in plens]
     if trace != "spec":
         phase1 = None
+    if trace != "burst":
+        burst_mask = None
     router.start()
     depth_samples = []
     reqs = []
@@ -763,10 +793,21 @@ def serve_bench(record=True, with_chaos=False):
     submit_rejected = 0
     hung = 0
     t_start = time.perf_counter()
+    # burst trace: per-token wall stamps on the BACKGROUND streams — the
+    # inter-token latency distribution is the disaggregation headline
+    # (a storm must not stall decoding rows); storm requests themselves
+    # are excluded, their cost is ttft
+    itl_stamps = {}
     try:
         for i, (p, m) in enumerate(zip(prompts, newlens)):
+            cb = None
+            if burst_mask is not None and not burst_mask[i]:
+                stamps = itl_stamps.setdefault(i, [])
+                cb = (lambda _t, _s=stamps:
+                      _s.append(time.perf_counter()))
             try:
-                reqs.append(router.submit(p, max_new_tokens=int(m)))
+                reqs.append(router.submit(p, max_new_tokens=int(m),
+                                          on_token=cb))
             except ServeOverload:
                 submit_shed += 1  # admission control shed at the door
             except ServeEngineDead:
@@ -910,6 +951,16 @@ def serve_bench(record=True, with_chaos=False):
         return None if not xs else round(xs[min(len(xs) - 1,
                                                 int(len(xs) * q))], 2)
 
+    itl = None
+    if burst_mask is not None:
+        gaps = []
+        for stamps in itl_stamps.values():
+            gaps.extend(1e3 * (b - a)
+                        for a, b in zip(stamps, stamps[1:]))
+        gaps.sort()
+        itl = {"p50": pct(gaps, 0.50), "p99": pct(gaps, 0.99),
+               "max": round(gaps[-1], 2) if gaps else None,
+               "streams": len(itl_stamps), "gaps": len(gaps)}
     ok_lat = sorted(r.latency_ms for r in reqs
                     if r.done and r.error is None
                     and r.latency_ms is not None)
@@ -924,7 +975,9 @@ def serve_bench(record=True, with_chaos=False):
                             "serve.chaos_flooded", "serve.preempted",
                             "serve.alloc_denied", "serve.migrated",
                             "serve.replays", "serve.drained",
-                            "serve.stalled", "serve.thrash_trips")
+                            "serve.stalled", "serve.thrash_trips",
+                            "serve.handoffs", "serve.handoff_fails",
+                            "serve.replays_from_handoff")
                   if reg.counter(k).value}
     result = {
         "metric": "serve_tokens_per_sec_per_chip",
@@ -962,6 +1015,7 @@ def serve_bench(record=True, with_chaos=False):
         "latency_ms": {"p50": pct(lat, 0.50), "p99": pct(lat, 0.99),
                        "max": round(lat[-1], 2) if lat else None},
         "ttft_ms": {"p50": pct(ttft, 0.50), "p99": pct(ttft, 0.99)},
+        "itl_ms": itl,
         "tokens_generated": n_tokens,
         "output_sig": sig,
         "batch_occupancy": round(rows / max(rows + padded, 1), 4),
@@ -1724,6 +1778,90 @@ def serve_durability_bench(record=True):
     return result
 
 
+def serve_disagg_bench(record=True):
+    """Disaggregated prefill/decode A/B at EQUAL chip count under the
+    burst trace (``python bench.py --serve --disagg``).
+
+    Both legs run the same replica count (``SERVE_REPLICAS``, default 2)
+    over the same ``burst`` trace — Poisson short-prompt/long-output
+    background decode streams punctuated by back-to-back long-prompt
+    storms.  The `colocated` leg pins ``MXNET_SERVE_DISAGG=0`` (every
+    replica interleaves storm prefill chunks with its decoding rows);
+    the `disagg` leg splits the same fleet into prefill and decode
+    roles (``MXNET_SERVE_PREFILL_REPLICAS``, default 1) with the paged
+    K/V handoff in between.
+
+    The acceptance contract (ISSUE 17, gated nightly): background
+    decode inter-token p99 strictly LOWER disaggregated (the storm
+    queues on the prefill role instead of stalling decode streams),
+    ttft no worse, token-for-token output parity (`output_sig` equal —
+    the handoff resumes the same resume tuple the colocated path never
+    builds), nonzero handoffs, zero handoff fails, zero leaked blocks
+    and zero steady-state recompiles on BOTH roles.
+    """
+    from mxnet_tpu import telemetry
+
+    replicas = os.environ.get("SERVE_REPLICAS", "2")
+    runs = {}
+    # the A/B premise: identical trace, identical chips — only the
+    # fleet topology differs (and is restored after: an in-process
+    # caller's later serve_bench must not inherit the split)
+    shared = {"SERVE_TRACE": "burst", "MXNET_SERVE_PAGED": "1",
+              "SERVE_REPLICAS": replicas}
+    for mode, env in (
+            ("colocated", {"MXNET_SERVE_DISAGG": "0"}),
+            ("disagg", {"MXNET_SERVE_DISAGG": "1",
+                        "MXNET_SERVE_PREFILL_REPLICAS":
+                            os.environ.get(
+                                "MXNET_SERVE_PREFILL_REPLICAS", "1")})):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    colo, dis = runs["colocated"], runs["disagg"]
+
+    def _p99(r):
+        return (r.get("itl_ms") or {}).get("p99") or 0.0
+
+    result = {
+        "metric": "serve_disagg_vs_colocated",
+        # the acceptance ratio: background decode inter-token p99 under
+        # storms (colocated / disagg — > 1.0 means role separation kept
+        # the decoding streams flat where colocation stalled them)
+        "value": round(_p99(colo) / max(_p99(dis), 1e-9), 3),
+        "unit": "colocated/disagg background inter-token p99 ratio "
+                "(equal chips, burst trace)",
+        "colocated": colo,
+        "disagg": dis,
+        "parity": colo["output_sig"] == dis["output_sig"],
+        "itl_p99_ms": {"colocated": _p99(colo), "disagg": _p99(dis)},
+        "ttft_p50_ms": {"colocated": colo["ttft_ms"]["p50"],
+                        "disagg": dis["ttft_ms"]["p50"]},
+        "handoffs": dis["resilience"].get("handoffs", 0),
+        "handoff_fails": dis["resilience"].get("handoff_fails", 0),
+        "replays_from_handoff": dis["resilience"].get(
+            "replays_from_handoff", 0),
+        "prefill_replicas": int(os.environ.get(
+            "MXNET_SERVE_PREFILL_REPLICAS", "1")),
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -1809,6 +1947,8 @@ if __name__ == "__main__":
             serve_megastep_bench()
         elif "--durability" in sys.argv:
             serve_durability_bench()
+        elif "--disagg" in sys.argv:
+            serve_disagg_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

@@ -74,6 +74,15 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           evict-and-destroy path: the block's K/V is
                           lost, the next hit re-prefills, nothing leaks
                           in either tier
+    handoff_fail:P        with probability P a disaggregated
+                          prefill→decode handoff transfer dies
+                          mid-flight (MXNET_SERVE_DISAGG): the staged
+                          block run is dropped and the request must
+                          requeue onto journal exact-replay on a
+                          survivor — typed, never hung, and never a
+                          duplicated token (the stream's positional
+                          high-water mark makes re-delivery
+                          structurally impossible)
     restore_slow:P:MS     with probability P a host→device block
                           restore sleeps MS ms before its pool write
                           lands (PCIe congestion pressure: deadlines
@@ -116,8 +125,8 @@ __all__ = [
     "reset", "rpc_action", "maybe_crash_server", "grad_poison",
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
     "serve_queue_flood", "serve_block_exhaust", "serve_prefix_evict",
-    "serve_draft_junk", "serve_spill_fail", "serve_restore_slow",
-    "serve_scale_corrupt",
+    "serve_draft_junk", "serve_spill_fail", "serve_handoff_fail",
+    "serve_restore_slow", "serve_scale_corrupt",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -155,6 +164,7 @@ class _Spec:
         self.prefix_evict = 0.0           # probability per scheduler step
         self.draft_junk = 0.0             # probability per spec round
         self.spill_fail = 0.0             # probability per spill attempt
+        self.handoff_fail = 0.0           # probability per handoff transfer
         self.restore_slow = (0.0, 0.0)    # (probability, milliseconds)
         self.scale_corrupt = 0.0          # probability per scheduler step
         for clause in filter(None, (c.strip() for c in raw.split(","))):
@@ -193,6 +203,8 @@ class _Spec:
                 self.draft_junk = float(parts[1])
             elif kind == "spill_fail":
                 self.spill_fail = float(parts[1])
+            elif kind == "handoff_fail":
+                self.handoff_fail = float(parts[1])
             elif kind == "restore_slow":
                 self.restore_slow = (float(parts[1]),
                                      float(parts[2]) if len(parts) > 2
@@ -432,6 +444,22 @@ def serve_spill_fail():
     with s.lock:
         return bool(s.rng_for("spill_fail").random_sample()
                     < s.spill_fail)
+
+
+def serve_handoff_fail():
+    """True when the CURRENT disaggregated prefill→decode handoff
+    transfer should die mid-flight (`handoff_fail:P`): the staged block
+    run is dropped on the floor and the source must fall back to
+    journal exact-replay on a survivor — the wire is allowed to be
+    lossy, so a flaky transport can only cost one replayed prefill,
+    never a hang, a duplicated token, or a leaked block on either
+    side."""
+    s = spec()
+    if s is None or s.handoff_fail <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("handoff_fail").random_sample()
+                    < s.handoff_fail)
 
 
 def serve_restore_slow():

@@ -67,16 +67,27 @@ package is the production path on top of it (ROADMAP item 1):
   restored through the host tier in the quantized dtype, guarded by
   an in-graph logit gate that fails typed (`ServeQuantError`) on
   corrupted scales instead of emitting silent wrong tokens.
+* `handoff` / disaggregated serving (``MXNET_SERVE_DISAGG``) — the
+  Splitwise/DistServe split: `ReplicaRouter` specializes the fleet
+  into prefill and decode roles; prefill replicas run chunked prefill
+  only and retire finished prompts into a `HandoffTicket` (the packed
+  K/V block run + the uniform resume tuple), decode replicas land the
+  ticket through the warmup-compiled restore scatter and megastep-
+  decode it — a long-prompt storm queues on the prefill side while
+  decode inter-token p99 stays flat.  A dead transfer or target falls
+  back to the journal's exact-replay road; ``=0`` (default) is the
+  colocated fleet bit for bit.
 * `errors` — the typed failure taxonomy every request resolves to.
 
 See docs/serving.md.
 """
 from .decode import TransformerKVModel
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
+from .handoff import HandoffTicket, disagg_enabled
 from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
-from .tiers import HostBlockTier
+from .tiers import HostBlockTier, pack_block_run
 from .spec import Drafter, NgramDrafter, ModelDrafter, make_drafter
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
@@ -85,9 +96,10 @@ from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeQuantError)
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
-           "ReplicaRouter", "RequestJournal", "journal_enabled",
+           "ReplicaRouter", "HandoffTicket", "disagg_enabled",
+           "RequestJournal", "journal_enabled",
            "BlockAllocator", "PrefixCache", "TRASH_BLOCK", "HostBlockTier",
-           "sample_tokens", "Drafter", "NgramDrafter", "ModelDrafter",
+           "pack_block_run", "sample_tokens", "Drafter", "NgramDrafter", "ModelDrafter",
            "make_drafter", "ServeError", "ServeTimeout", "ServeOverload",
            "ServeDeadlineExceeded", "ServeCancelled", "ServeQuarantined",
            "ServeBlocksExhausted", "ServeCacheInvalidated",

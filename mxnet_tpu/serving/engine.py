@@ -135,11 +135,12 @@ from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
 from ..quant.codec import resolve as quant_resolve
+from .handoff import HandoffLanding, HandoffTicket, disagg_enabled
 from .journal import RequestJournal, journal_enabled
 from .paged import BlockAllocator, PrefixCache, TRASH_BLOCK
 from .sampling import sample_tokens
 from .spec import make_drafter
-from .tiers import HostBlockTier
+from .tiers import HostBlockTier, pack_block_run
 from .errors import (ServeError, ServeTimeout, ServeOverload,
                      ServeDeadlineExceeded, ServeCancelled,
                      ServeQuarantined, ServeBlocksExhausted,
@@ -234,6 +235,11 @@ class ServeRequest:
         #                           preemption until it advances
         #                           MXNET_SERVE_MIN_PROGRESS tokens past it
         self._migrated = False    # journal migration pending its replay
+        self._no_handoff = False  # burned its one disagg handoff: a
+        #                           replayed-from-handoff request decodes
+        #                           wherever it lands (bounded churn —
+        #                           roles are dispatch policy, not a
+        #                           capability restriction)
         # streaming (docs/serving.md "Megastep decode & streaming"):
         # `stream()` iterators sleep on this condition; `_published` is
         # the scheduler's delivery high-water mark into `self.tokens`.
@@ -655,6 +661,7 @@ class ServingEngine:
                 host_drop_hook=self._host_dropped if self._tier is not None
                 else None) if prefix_on else None
             self._restoring = {}   # row -> _Restore (insertion-ordered)
+            self._landing = {}     # row -> HandoffLanding (disagg)
         else:
             self._chunk_prefill = False
             self.block_size = None
@@ -666,6 +673,7 @@ class ServingEngine:
             self._host_blocks = 0
             self._restore_ahead = 0
             self._restoring = {}
+            self._landing = {}
             # slot max_batch is the trash slot padding rows write into
             self._cache = model.init_cache(self.max_batch + 1,
                                            device=self._device)
@@ -732,6 +740,17 @@ class ServingEngine:
         self._dead = None         # scheduler-fatal error message, if any
         self._on_death = None     # router failover hook:
         #                           fn(engine, pending, inflight, msg)
+        # disaggregated prefill/decode fleet (docs/serving.md
+        # "Disaggregated prefill/decode"): the router assigns roles and
+        # wires the hooks BEFORE warmup (a decode role decides which
+        # restore buckets compile); role None = today's colocated
+        # engine, bit for bit
+        self.role = None          # None | "prefill" | "decode"
+        self._handoff_sink = None      # router: fn(ticket) stages it on
+        #                                a live decode replica or raises
+        self._handoff_fallback = None  # router: fn(req) -> bool, the
+        #                                journal exact-replay road
+        self._handoff_inbox = deque()  # tickets received, not yet staged
         self._launch_fails = 0    # consecutive decode launch failures
         # anti-thrash preemption (docs/serving.md "Durability"): a resumed
         # sequence is exempt from re-preemption until it advances
@@ -782,6 +801,8 @@ class ServingEngine:
                       # memory tiering + sessions (0s when disabled)
                       "spilled": 0, "restored": 0, "restored_tokens": 0,
                       "spill_fails": 0, "restore_fails": 0,
+                      # disaggregated prefill/decode (0s when off)
+                      "handoffs": 0, "handoffs_in": 0, "handoff_fails": 0,
                       "prefill_tokens": 0, "session_hits": 0,
                       "session_turns": 0,
                       # quantization (0s when disabled)
@@ -1145,9 +1166,13 @@ class ServingEngine:
             self._compiled_cow()
             arrays, names = self._cow_watch_arrays()
             self._watch("cow", arrays, names, 1, seed=True)
-        if self._tier is not None:
+        if self._tier is not None or (self._paged
+                                      and self.role == "decode"):
             # the restore writes join the frozen set too: a host hit in
-            # steady state compiles nothing, it only transfers
+            # steady state compiles nothing, it only transfers.  A
+            # decode-role replica needs the same bucketed scatters for
+            # handoff landings even without a host tier — the router
+            # wires roles BEFORE warmup precisely so this gate sees them
             for kb in self._restore_buckets():
                 self._compiled_restore(kb)
                 arrays, names = self._restore_watch_arrays(kb)
@@ -1490,7 +1515,8 @@ class ServingEngine:
         with self._qlock:
             return len(self._queue) + self._admitting + \
                 len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+                len(self._restoring) + len(self._landing) + \
+                len(self._handoff_inbox)
 
     # -- scheduling --------------------------------------------------------
     def _bucket_for(self, n, buckets):
@@ -1796,7 +1822,9 @@ class ServingEngine:
                          [(p.blocks, p.done)
                           for p in self._prefilling.values()] + \
                          [(r.blocks, r.done)
-                          for r in self._restoring.values()]:
+                          for r in self._restoring.values()] + \
+                         [(ld.blocks, ld.ticket.pos)
+                          for ld in self._landing.values()]:
             if holder is None:
                 continue
             for i, b in enumerate(holder):
@@ -1855,6 +1883,16 @@ class ServingEngine:
                 else:
                     self._quarantine(rs.req, "restore lost to a cache "
                                      "rebuild twice: %s" % reason[:200])
+            for row, ld in list(self._landing.items()):
+                # a staged handoff landing's target blocks died with the
+                # pool; the packed host bytes are useless without them —
+                # fall back to the journal exact-replay road
+                del self._landing[row]
+                self._free.append(row)
+                ld.blocks = None
+                self._handoff_lost(ld.ticket.req,
+                                   "handoff landing lost to a cache "
+                                   "rebuild: %s" % reason[:200])
             if self._prefix is not None:
                 self._prefix.clear()  # the pool its nodes point at is gone
             if self._tier is not None:
@@ -2057,14 +2095,7 @@ class ServingEngine:
             self._count("replays")
         if nodes:
             kb = self._restore_bucket(len(nodes))
-            data = self.model.block_run_placeholder(kb, self.block_size)
-            for j, a in enumerate(arrs):
-                if isinstance(data, tuple):
-                    # quantized tier entries are (int8 rows, f32 scales)
-                    data[0][:, :, j] = a[0]
-                    data[1][:, :, j] = a[1]
-                else:
-                    data[:, :, j] = a
+            data = pack_block_run(self.model, self.block_size, arrs, kb)
             dsts = np.full((kb,), TRASH_BLOCK, np.int32)
             dsts[:len(dst)] = dst
             self._restoring[row] = _Restore(req, row, list(tokens), blocks,
@@ -2099,7 +2130,8 @@ class ServingEngine:
             # decode); a resumed preemption continues its own counters.
             self.stats["prefix_bootstraps"] += 1
             self._count("prefix_bootstraps")
-            if req._resume is None:
+            resumed = req._resume is not None
+            if not resumed:
                 last, pos, n_new = int(tokens[-1]), len(tokens) - 1, 0
                 telemetry.observe(
                     "serve.queue_age_ms",
@@ -2107,11 +2139,14 @@ class ServingEngine:
             else:
                 last, pos, n_new = req._resume[1:]
                 req._resume = None
-                if self._drafter is not None and n_new:
-                    # seed the survivor's drafter with the replayed
-                    # generation: speculation recovers its accept rate on
-                    # the first post-resume round instead of re-learning
-                    self._drafter.on_resume(list(tokens) + [last])
+            if self._maybe_handoff(req, row, tokens, blocks,
+                                   last, pos, n_new):
+                return
+            if resumed and self._drafter is not None and n_new:
+                # seed the survivor's drafter with the replayed
+                # generation: speculation recovers its accept rate on
+                # the first post-resume round instead of re-learning
+                self._drafter.on_resume(list(tokens) + [last])
             seq = _Seq(req, last, pos, blocks=blocks,
                        ctx=list(tokens[:pos]))
             seq.n_new = n_new
@@ -2256,6 +2291,232 @@ class ServingEngine:
                 self._put(np.array([chunk], np.int32)), self._put(table))
             pos += chunk
 
+    # -- disaggregated prefill/decode handoff ------------------------------
+    # (docs/serving.md "Disaggregated prefill/decode")
+    def _maybe_handoff(self, req, row, tokens, blocks, last, pos, n_new):
+        """On a prefill-role replica, retire a prefill-complete sequence
+        into a handoff instead of decode: pack the cached block run into
+        ONE host array (`pack_block_run` — the tier-restore transfer
+        shape), hand a `HandoffTicket` to the router's sink, and free
+        the row and blocks HERE.  Returns True when the sequence was
+        consumed (handed off, or failed over to journal replay) — the
+        caller must not enter decode.  Colocated engines (role None)
+        return False without touching anything: the `MXNET_SERVE_DISAGG=0`
+        bit-for-bit contract lives on this first line."""
+        if self._handoff_sink is None or self.role != "prefill" \
+                or not self._paged or req._no_handoff or pos <= 0:
+            return False
+        ticket = None
+        try:
+            if chaos.enabled() and chaos.serve_handoff_fail():
+                raise chaos.ChaosError(
+                    "chaos: injected handoff transfer death")
+            k = (pos + self.block_size - 1) // self.block_size
+            arrs = []
+            for b in blocks[:k]:
+                data = self.model.slice_block(self._cache, b)
+                for leaf in (data if isinstance(data, tuple)
+                             else (data,)):
+                    copy_async = getattr(leaf, "copy_to_host_async",
+                                         None)
+                    if copy_async is not None:
+                        copy_async()
+                arrs.append(data)
+            # finalize to numpy AFTER all copies dispatched: each wait
+            # overlaps the remaining transfers
+            arrs = [tuple(np.asarray(x) for x in a)
+                    if isinstance(a, tuple) else np.asarray(a)
+                    for a in arrs]
+            kb = self._restore_bucket(k)
+            packed = pack_block_run(self.model, self.block_size, arrs,
+                                    kb)
+            ticket = HandoffTicket(req, list(tokens[:pos]), last, pos,
+                                   n_new, packed, k, kb, self.name)
+        except Exception as e:  # noqa: BLE001 — degrade to replay
+            self._free.append(row)
+            self._drop_refs(blocks)
+            self._block_gauges()
+            self._handoff_lost(req, "handoff pack failed: %s" % e)
+            return True
+        # the source is done with the sequence whatever happens next:
+        # the bytes are on host and the resume tuple is in the ticket
+        self._free.append(row)
+        self._drop_refs(blocks)
+        self._block_gauges()
+        try:
+            self._handoff_sink(ticket)
+        except Exception as e:  # noqa: BLE001 — no live decode target
+            self._handoff_lost(req, "handoff dispatch failed: %s" % e)
+            return True
+        self.stats["handoffs"] += 1
+        self._count("handoffs")
+        telemetry.inc("serve.handoff_bytes", ticket.nbytes)
+        return True
+
+    def _handoff_lost(self, req, msg):
+        """A handoff died (pack, dispatch, chaos, target death, cache
+        rebuild under a staged landing): count the typed failure and
+        requeue the request onto the router's journal exact-replay road.
+        Only when even that road is closed does the request fail typed —
+        never hung, and never duplicated (replay regenerates only tokens
+        streaming never published)."""
+        self.stats["handoff_fails"] += 1
+        self._count("handoff_fails")
+        telemetry.record_event("serve_handoff_fail", replica=self.name,
+                               request=req.id, error=str(msg)[:200])
+        ok = False
+        if self._handoff_fallback is not None:
+            try:
+                ok = self._handoff_fallback(req)
+            except Exception:  # noqa: BLE001 — fall through to typed
+                ok = False
+        if not ok and not req.done:
+            req._finish(error=ServeEngineDead(
+                "handoff failed with no replay road: %s" % str(msg)[:300]))
+
+    def receive_handoff(self, ticket):
+        """Router-facing: accept one handoff ticket onto this DECODE
+        replica's inbox (any thread).  Raises `ServeEngineDead` when
+        this replica is dead, draining, or stopped — the drain fence
+        the router's redirect logic relies on: a handoff must never
+        race admission-close on a draining target."""
+        if not self._paged:
+            raise MXNetError("receive_handoff: paged serving only")
+        with self._qlock:
+            self._check_alive_locked()
+            self._handoff_inbox.append(ticket)
+        self._wake.set()
+
+    def _stage_handoffs(self):
+        """Stage received tickets (scheduler thread): claim a row,
+        allocate fresh target blocks, and dispatch the packed run's
+        async ``device_put`` so the PCIe copy rides under this
+        iteration's decode launch — `_advance_landings` completes it
+        next iteration, exactly the `_Restore` two-stage overlap.  A
+        denied allocation leaves the ticket queued (blocks can only
+        appear when something retires)."""
+        while self._free:
+            with self._qlock:
+                if not self._handoff_inbox:
+                    return
+                ticket = self._handoff_inbox.popleft()
+            req = ticket.req
+            if req.done:
+                continue
+            row = self._free.pop()
+            fresh = self._alloc_blocks(
+                self._alloc.blocks_for(ticket.pos + 1))
+            if fresh is None:
+                self._free.append(row)
+                self.stats["alloc_denied"] += 1
+                self._count("alloc_denied")
+                with self._qlock:
+                    self._handoff_inbox.appendleft(ticket)
+                return
+            dsts = np.full((ticket.kb,), TRASH_BLOCK, np.int32)
+            dsts[:ticket.k] = fresh[:ticket.k]
+            self._landing[row] = HandoffLanding(
+                ticket, row, fresh, self._put(ticket.data),
+                self._put(dsts))
+            self._block_gauges()
+
+    def _drop_landing(self, ld):
+        """Remove a staged landing: row and blocks return to their
+        pools; the caller resolves the request."""
+        self._landing.pop(ld.row, None)
+        self._free.append(ld.row)
+        self._release_blocks(ld)
+
+    def _advance_landings(self):
+        """Land every handoff staged in a PREVIOUS iteration (the
+        `_advance_restores` twin — the staged ``device_put`` rode under
+        that iteration's decode launch)."""
+        for ld in list(self._landing.values()):
+            if ld.row in self._landing:
+                self._complete_landing(ld)
+
+    def _complete_landing(self, ld):
+        """Scatter one staged handoff's blocks into the pool with the
+        warmup-compiled bucketed ``write_block`` (AotCache stays
+        frozen), register the context in this replica's OWN prefix
+        index, and enter decode at the ticket's resume tuple.  Failure
+        scoping mirrors `_complete_restore`: device death is
+        scheduler-fatal; a consumed pool rebuilds; a scoped fault drops
+        the staged bytes and falls back to journal exact-replay."""
+        t = ld.ticket
+        req = t.req
+        try:
+            compiled = self._compiled_restore(t.kb)
+            staged = ld.staged if isinstance(ld.staged, tuple) \
+                else (ld.staged,)
+            self._watch("restore", (ld.dst_d,) + staged,
+                        ("dst", "data", "data_scale")[:1 + len(staged)],
+                        t.kb)
+            if chaos.serve_launch_error():
+                raise chaos.ChaosError(
+                    "chaos: injected handoff landing launch error")
+            self._cache = compiled(self._cache, ld.dst_d, ld.staged)
+        except Exception as e:
+            kind = self._classify_failure(e)
+            if kind == "device":
+                self._drop_landing(ld)
+                req._finish(error=ServeEngineDead(
+                    "handoff landing failed: %s" % str(e)[:400]))
+                raise _EngineFatal(
+                    "handoff landing failed: %s" % e) from e
+            if kind == "cache":
+                self._rebuild_cache("handoff landing failed: %s" % e)
+                return
+            self._drop_landing(ld)
+            self._handoff_lost(req, "handoff landing failed: %s" % e)
+            return
+        # landed: the context's FULL blocks publish in this replica's
+        # prefix index (follow-up session turns share them here — the
+        # tier entry lives where decode happens)
+        self._register_prefix(t.ctx, ld.blocks, t.pos)
+        self.stats["handoffs_in"] += 1
+        self._count("handoffs_in")
+        telemetry.observe("serve.handoff_wait_ms",
+                          1e3 * (time.perf_counter() - t.t_start))
+        del self._landing[ld.row]
+        if self._drafter is not None and t.n_new:
+            # the handed-off generation seeds the drafter store, same
+            # as any resume: full accept rate on the first round
+            self._drafter.on_resume(list(t.ctx) + [t.last])
+        seq = _Seq(req, t.last, t.pos, blocks=ld.blocks,
+                   ctx=list(t.ctx))
+        seq.n_new = t.n_new
+        self._active[ld.row] = seq
+        self._block_gauges()
+
+    def _pending_work(self):
+        """Admitted-but-not-decoding work still owed to callers:
+        mid-stream prefills, staged restores, staged handoff landings,
+        and received-but-unstaged tickets.  The scheduler's idle test —
+        every `_step` variant counts these before sleeping."""
+        return len(self._prefilling) + len(self._restoring) \
+            + len(self._landing) + len(self._handoff_inbox)
+
+    def decode_depth(self):
+        """Decode-side load for the router's least-loaded handoff
+        targeting: active rows plus handoffs already owed to this
+        replica (staged or inboxed)."""
+        with self._qlock:
+            return len(self._active) + len(self._landing) \
+                + len(self._handoff_inbox)
+
+    def prefill_backlog(self):
+        """Prompt tokens queued or mid-stream on this replica — the
+        ttft-ordered dispatch key for prefill-role replicas (queue
+        depth alone starves short prompts behind storms).  Snapshot
+        reads of prefill progress are tolerated: this is a load signal,
+        not an invariant."""
+        with self._qlock:
+            t = sum(len(r.prompt) for r in self._queue)
+            for pf in list(self._prefilling.values()):
+                t += max(0, len(pf.tokens) - pf.done)
+        return t
+
     def _advance_chunk(self, pf):
         """Launch one prefill chunk; the final chunk moves the sequence
         to the active set.  Failure scoping mirrors the slot path:
@@ -2338,9 +2599,12 @@ class ServingEngine:
             # continues from the token the preemption interrupted (no
             # re-sampling — the interrupted draw never happened)
             last, pos, n_new = pf.resume
+            req._resume = None
+            if self._maybe_handoff(req, pf.row, pf.tokens, blocks,
+                                   last, pos, n_new):
+                return
             seq = _Seq(req, last, pos, blocks=blocks, ctx=pf.tokens)
             seq.n_new = n_new
-            req._resume = None
             if self._drafter is not None and n_new:
                 # replayed generation seeds the drafter store (migration
                 # and preempt-resume alike): full accept rate immediately
@@ -2366,8 +2630,12 @@ class ServingEngine:
         seq = _Seq(req, first, total, blocks=blocks, ctx=pf.tokens)
         if self._seq_finished(seq, first):
             self._retire(pf.row, seq, enter=False)
-        else:
+        elif not self._maybe_handoff(req, pf.row, pf.tokens, blocks,
+                                     first, total, 1):
             self._active[pf.row] = seq
+        # the first token publishes from the SOURCE exactly once —
+        # streaming's positional high-water mark; the decode side
+        # resumes at n_new=1 and appends from position 1 on
         req._publish()
 
     def _grow_active(self):
@@ -2702,6 +2970,21 @@ class ServingEngine:
             if r._cancelled or r.expired(now):
                 dropped.append(r)
                 self._drop_restore(rs)
+        for ld in list(self._landing.values()):
+            r = ld.ticket.req
+            if r._cancelled or r.expired(now):
+                dropped.append(r)
+                self._drop_landing(ld)
+        with self._qlock:
+            if any(t.req._cancelled or t.req.expired(now)
+                   for t in self._handoff_inbox):
+                keep = deque()
+                for t in self._handoff_inbox:
+                    if t.req._cancelled or t.req.expired(now):
+                        dropped.append(t.req)
+                    else:
+                        keep.append(t)
+                self._handoff_inbox = keep
         for r in dropped:
             self._finish_dropped(r, now)
 
@@ -2796,9 +3079,12 @@ class ServingEngine:
         if self._paged:
             # restores staged last iteration land BEFORE new prefill
             # chunks and admissions: their transfers already overlapped
-            # the previous decode launch
+            # the previous decode launch (handoff landings ride the
+            # same two-stage overlap)
             self._advance_restores()
+            self._advance_landings()
             self._advance_prefills()
+            self._stage_handoffs()
         while self._free:
             with self._qlock:
                 req = self._queue.popleft() if self._queue else None
@@ -2828,10 +3114,10 @@ class ServingEngine:
             self.stats["max_concurrent"] = n
         telemetry.set_gauge(self._gauge + "active", n)
         if n == 0:
-            # mid-stream chunked prefills and staged restores still
-            # count as work: the scheduler keeps stepping until they
-            # land
-            return len(self._prefilling) + len(self._restoring)
+            # mid-stream chunked prefills, staged restores and staged
+            # handoffs still count as work: the scheduler keeps stepping
+            # until they land
+            return self._pending_work()
         if chaos.enabled():
             if chaos.serve_engine_crash(self.name):
                 raise chaos.ChaosEngineCrash(
@@ -2856,8 +3142,7 @@ class ServingEngine:
             # to launch — back off briefly so the retry loop doesn't spin
             # the host while it waits for room (or a deadline) to resolve
             time.sleep(0.001)
-            return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+            return len(self._active) + self._pending_work()
         b = self._bucket_for(n, self.decode_buckets)
         seqs = [self._active[s] for s in slots]
         token = np.zeros((b,), np.int32)
@@ -2890,8 +3175,7 @@ class ServingEngine:
             # scoped/transient: the donated cache survived — retry the
             # same decode next iteration, escalate after N consecutive
             self._handle_launch_failure(e, "decode")
-            return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+            return len(self._active) + self._pending_work()
         self._launch_fails = 0
         t_fetch = time.perf_counter()
         nxt = np.asarray(nxt)  # the one per-step host fetch (b ints)
@@ -2921,8 +3205,7 @@ class ServingEngine:
             if finished:
                 self._retire(slot, seq)
             seq.req._publish()
-        return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+        return len(self._active) + self._pending_work()
 
     def _step_mega(self):
         """One double-buffered megastep iteration (docs/serving.md
@@ -2961,7 +3244,9 @@ class ServingEngine:
         # -- overlap window: host work the device no longer waits on --
         self._sweep()
         self._advance_restores()
+        self._advance_landings()
         self._advance_prefills()
+        self._stage_handoffs()
         while self._free:
             with self._qlock:
                 req = self._queue.popleft() if self._queue else None
@@ -3002,8 +3287,7 @@ class ServingEngine:
             # every active row is stalled on a denied allocation —
             # back off briefly so the retry loop doesn't spin the host
             time.sleep(0.001)
-        return len(self._active) + len(self._prefilling) + \
-            len(self._restoring)
+        return len(self._active) + self._pending_work()
 
     def _launch_mega(self):
         """Dispatch ONE m-step megastep over the non-stalled active
@@ -3125,11 +3409,9 @@ class ServingEngine:
         if inflight is None:
             if self._active:
                 time.sleep(0.001)
-            return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+            return len(self._active) + self._pending_work()
         self._finish_mega(inflight)
-        return len(self._active) + len(self._prefilling) + \
-            len(self._restoring)
+        return len(self._active) + self._pending_work()
 
     def _advance_one(self, seq, t):
         """Advance one sequence by ONE emitted token ``t`` — the single
@@ -3217,8 +3499,7 @@ class ServingEngine:
         n = len(rows)
         if n == 0:
             time.sleep(0.001)  # all rows stalled: retry next iteration
-            return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+            return len(self._active) + self._pending_work()
         b = self._bucket_for(n, self.decode_buckets)
         k = self._spec_k
         c = k + 1
@@ -3276,8 +3557,7 @@ class ServingEngine:
             out, self._cache = compiled(self._params, self._cache, *args)
         except Exception as e:
             self._handle_launch_failure(e, "verify")
-            return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+            return len(self._active) + self._pending_work()
         self._launch_fails = 0
         t_fetch = time.perf_counter()
         out = np.asarray(out)  # (b, k+2): picks then n_accepted
@@ -3343,8 +3623,7 @@ class ServingEngine:
                 self._gauge + "spec_accept_rate",
                 round(self.stats["spec_accepted"]
                       / float(self.stats["spec_proposed"]), 4))
-        return len(self._active) + len(self._prefilling) + \
-                len(self._restoring)
+        return len(self._active) + self._pending_work()
 
     # -- worker loop -------------------------------------------------------
     def start(self):
@@ -3376,7 +3655,8 @@ class ServingEngine:
                 # leaves the event set and wait() returns immediately.
                 self._wake.clear()
                 with self._qlock:
-                    queued = bool(self._queue)
+                    queued = bool(self._queue) or \
+                        bool(self._handoff_inbox)
                 if not queued and not self._stopped.is_set():
                     self._wake.wait(0.05)
 
@@ -3433,6 +3713,18 @@ class ServingEngine:
             self._free.append(rs.row)
             self._release_blocks(rs)
             inflight.append(rs.req)
+        for ld in list(self._landing.values()):
+            # a staged handoff landing dies with this replica: the
+            # request rejoins the failover walk and migrates (journal
+            # exact-replay) like any other in-flight sequence — the
+            # target-death-mid-transfer road
+            del self._landing[ld.row]
+            self._free.append(ld.row)
+            self._release_blocks(ld)
+            inflight.append(ld.ticket.req)
+        with self._qlock:
+            while self._handoff_inbox:
+                inflight.append(self._handoff_inbox.popleft().req)
         return inflight
 
     def _join_thread(self):
@@ -3613,7 +3905,8 @@ class ReplicaRouter:
     _MONITOR_PERIOD = 0.2
     _BREAKER_RESET_S = 10.0   # healthy-for-this-long clears the breaker
 
-    def __init__(self, engines, respawn=None, journal=None):
+    def __init__(self, engines, respawn=None, journal=None, disagg=None,
+                 prefill_replicas=None):
         if not engines:
             raise MXNetError("ReplicaRouter: need at least one engine")
         self.engines = list(engines)
@@ -3629,12 +3922,55 @@ class ReplicaRouter:
         self._monitor = None
         self._mon_stop = threading.Event()
         self._breaker = {}   # replica name -> (fails, next_try monotonic)
-        for e in self.engines:
-            e._on_death = self._handle_death
+        # disaggregated prefill/decode (docs/serving.md "Disaggregated
+        # prefill/decode"): the first MXNET_SERVE_PREFILL_REPLICAS
+        # engines specialize to prefill, the rest to decode.  Off (the
+        # default) assigns no roles at all — bit-for-bit colocated.
+        if disagg is None:
+            disagg = disagg_enabled()
+        self._disagg = bool(disagg) and len(self.engines) >= 2
+        n = len(self.engines)
+        if self._disagg:
+            if not all(e._paged for e in self.engines):
+                raise MXNetError(
+                    "ReplicaRouter: MXNET_SERVE_DISAGG needs paged=True "
+                    "on every replica (the handoff is a paged block-run "
+                    "transfer)")
+            p = int(os.environ.get("MXNET_SERVE_PREFILL_REPLICAS", "0")
+                    if prefill_replicas is None else prefill_replicas)
+            if p <= 0:
+                p = max(1, n // 4)
+            if p >= n:
+                raise MXNetError(
+                    "ReplicaRouter: MXNET_SERVE_PREFILL_REPLICAS=%d "
+                    "leaves no decode replica among %d" % (p, n))
+            self._n_prefill = p
+        for i, e in enumerate(self.engines):
+            self._wire(e, self._role_for(i))
+
+    def _role_for(self, i):
+        if not self._disagg:
+            return None
+        return "prefill" if i < self._n_prefill else "decode"
+
+    def _wire(self, engine, role):
+        """Attach one engine to this router: death hook, role, and (for
+        role-bearing replicas) the handoff sink and the journal-replay
+        fallback.  MUST run before the engine's `warmup()` — a decode
+        role decides which restore buckets join the frozen AOT set."""
+        engine._on_death = self._handle_death
+        engine.role = role
+        if role is not None:
+            engine._handoff_sink = self._dispatch_handoff
+            engine._handoff_fallback = \
+                lambda req, _e=engine: self._handoff_replay(req, source=_e)
+        telemetry.set_gauge("serve.%s.role" % engine.name,
+                            {"prefill": 1, "decode": 2}.get(role, 0))
 
     @classmethod
     def from_mesh(cls, model, params, mesh=None, n_replicas=None,
-                  respawn=None, journal=None, **kw):
+                  respawn=None, journal=None, disagg=None,
+                  prefill_replicas=None, **kw):
         devices = (list(np.asarray(mesh.devices).reshape(-1))
                    if mesh is not None else jax.devices())
         if n_replicas is not None:
@@ -3642,7 +3978,8 @@ class ReplicaRouter:
         engines = [ServingEngine(model, params, ctx=d,
                                  name="replica%d" % i, **kw)
                    for i, d in enumerate(devices)]
-        return cls(engines, respawn=respawn, journal=journal)
+        return cls(engines, respawn=respawn, journal=journal,
+                   disagg=disagg, prefill_replicas=prefill_replicas)
 
     def warmup(self):
         return [e.warmup() for e in self.engines]
@@ -3743,6 +4080,59 @@ class ReplicaRouter:
             return True
         return False
 
+    # -- disaggregated handoff routing -------------------------------------
+    def _dispatch_handoff(self, ticket):
+        """Stage one prefill→decode ticket on the least-loaded LIVE
+        decode replica (runs on the source's scheduler thread).
+        `_live_engines` already fences out dead, stopped AND DRAINING
+        replicas — a handoff must redirect to a survivor rather than
+        race a draining target's admission-close — and the target's
+        `receive_handoff` re-checks under its own lock for the window
+        in between.  Raises `ServeEngineDead` when no decode replica
+        can take it; the source then falls back to journal replay."""
+        last = None
+        targets = [e for e in self._live_engines()
+                   if e.role == "decode"]
+        for eng in sorted(targets, key=lambda e: e.decode_depth()):
+            try:
+                eng.receive_handoff(ticket)
+            except ServeError as e:
+                last = e
+                continue  # died/started draining in the window
+            telemetry.record_event(
+                "serve_handoff", request=ticket.req.id, source=ticket.src,
+                target=eng.name, blocks=ticket.k, nbytes=ticket.nbytes)
+            return True
+        raise ServeEngineDead(
+            "ReplicaRouter: no live decode replica for handoff (%s)"
+            % last)
+
+    def _handoff_replay(self, req, source=None):
+        """The failed-handoff fallback: requeue ``req`` onto journal
+        exact-replay on any survivor (the same road engine death takes).
+        ``_no_handoff`` pins the retry to ordinary decode — a replay
+        that handed off again could ping-pong forever.  The last resort
+        retries WITHOUT excluding the source: roles are routing policy,
+        and a prefill replica that must decode one stray request beats
+        failing it."""
+        req._no_handoff = True
+        if req.done:
+            return True
+        ok = False
+        if self._migrate(req, exclude=source):
+            ok = True
+        elif not req.tokens and self._redispatch(req, exclude=source):
+            ok = True
+        elif source is not None and \
+                (self._migrate(req) or
+                 (not req.tokens and self._redispatch(req))):
+            ok = True
+        if ok:
+            telemetry.inc("serve.replays_from_handoff")
+            if self.journal is not None:
+                self.journal.handoff_replays += 1
+        return ok
+
     def _monitor_loop(self):
         """Replica health: export heartbeat-age gauges, and respawn dead
         replicas behind a capped-exp-backoff circuit breaker."""
@@ -3772,6 +4162,9 @@ class ReplicaRouter:
                     fails + 1, now + min(0.05 * (2 ** fails), 5.0))
                 try:
                     fresh = e.respawn()
+                    # role (and its warmup bucket set) carries over —
+                    # wired BEFORE warmup, like first construction
+                    self._wire(fresh, e.role)
                     compiled_before = fresh._aot.compiles
                     fresh.warmup()
                     if fresh._aot.compiles != compiled_before:
@@ -3780,7 +4173,6 @@ class ReplicaRouter:
                         telemetry.record_event(
                             "serve_respawn_compiled", replica=e.name,
                             n=fresh._aot.compiles - compiled_before)
-                    fresh._on_death = self._handle_death
                     fresh.start()
                 except Exception as ex:  # noqa: BLE001
                     telemetry.record_event("serve_respawn_failed",
@@ -3824,10 +4216,35 @@ class ReplicaRouter:
             # state is engine-local and dies with its replica) the turn
             # routes least-depth as a fresh conversation.
             order = sorted(live, key=lambda e: e.depth())
+            if self._disagg:
+                # two-stage dispatch: every fresh request enters through
+                # a PREFILL replica, ordered by prompt-token backlog
+                # (the ttft signal — queue depth alone starves short
+                # prompts behind a storm); the handoff picks the decode
+                # replica later, at least-decode-depth
+                pre = [e for e in live if e.role == "prefill"]
+                if pre:
+                    order = sorted(pre,
+                                   key=lambda e: e.prefill_backlog())
+                telemetry.set_gauge(
+                    "serve.prefill_depth",
+                    sum(e.depth() for e in pre))
+                telemetry.set_gauge(
+                    "serve.decode_depth",
+                    sum(e.decode_depth() for e in live
+                        if e.role == "decode"))
             if session is not None:
                 holders = [e for e in live if e.has_session(session)]
                 if holders:
-                    order = sorted(holders, key=lambda e: e.depth())
+                    # disagg: prefer DECODE-role holders — `_retire`
+                    # stores the session history on the replica that
+                    # decoded the previous turn, and the prefill source
+                    # keeps only an unresolved claim; landing the
+                    # follow-up on the decode holder reattaches its
+                    # cached blocks instead of forking the history
+                    dec = [e for e in holders if e.role == "decode"]
+                    order = sorted(dec or holders,
+                                   key=lambda e: e.depth())
             for eng in order:
                 try:
                     req = eng.submit(prompt, _count_shed=False, **kw)
@@ -3914,6 +4331,7 @@ class ReplicaRouter:
         if respawn and not self._stopped:
             try:
                 fresh = eng.respawn()
+                self._wire(fresh, eng.role)  # role before warmup
                 fresh.warmup()  # pure AotCache hits: the restart compiles 0
             except Exception as ex:  # noqa: BLE001
                 # don't strand the fleet a replica short: mark the drained
@@ -3924,7 +4342,6 @@ class ReplicaRouter:
                                        replica=eng.name,
                                        error=str(ex)[:200])
                 return None
-            fresh._on_death = self._handle_death
             with self._lock:
                 try:
                     self.engines[self.engines.index(eng)] = fresh

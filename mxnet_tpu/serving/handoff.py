@@ -1,0 +1,105 @@
+"""Disaggregated prefill/decode handoff (``MXNET_SERVE_DISAGG``).
+
+With PR 16 hiding the host floor inside a replica, the next decode-p99
+ceiling is *between* requests: a colocated replica runs prefill chunks
+in the same iteration loop as its decoding rows, so a long-prompt storm
+inflates every in-flight stream's inter-token latency by one chunk per
+iteration.  Splitwise and DistServe's answer — and this module's — is
+role specialization: **prefill replicas** run chunked prefill only (plus
+the first sampled token admission already produces) and retire the
+sequence into a *handoff* instead of decode; **decode replicas** receive
+the packed K/V block run plus the request state, scatter it through the
+warmup-compiled ``write_block`` run-length buckets, and enter the
+megastep decode loop.  Decode replicas never see a prefill chunk, so a
+prompt storm queues on the prefill side while inter-token latency stays
+flat.
+
+The transfer reuses the two primitives the host tier already proved:
+
+* `tiers.pack_block_run` packs the whole run into ONE padded
+  placeholder (quant scales ride along as the (int8, f32) tuple), and
+* the target lands it exactly like a staged `_Restore`: one async
+  ``device_put`` rides under the current decode launch, one bucketed
+  pool scatter (AotCache stays frozen — zero steady-state compiles on
+  both roles) lands the bytes next iteration.
+
+A `HandoffTicket` is the unit on the wire: the request handle itself
+(sampling params, RNG seed, deadline stamps — nothing resets), the
+uniform resume tuple ``(ctx, last, pos, n_new)``, and the packed host
+bytes.  Failure is scoped to the transfer: a dead pack, a dead target,
+or the ``handoff_fail:P`` chaos clause drops the staged bytes and the
+request requeues onto the journal's exact-replay road on any survivor —
+typed, never hung, and never duplicated (streaming's positional
+high-water mark makes re-delivery structurally impossible; replay
+regenerates only tokens that were never appended).
+
+``MXNET_SERVE_DISAGG=0`` (the default) is the colocated fleet bit for
+bit: no roles, no tickets, no new dispatch order.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["HandoffTicket", "HandoffLanding", "disagg_enabled"]
+
+
+def disagg_enabled(default="0"):
+    """The ``MXNET_SERVE_DISAGG`` switch (default off)."""
+    return os.environ.get("MXNET_SERVE_DISAGG", default).lower() \
+        not in ("0", "false", "no")
+
+
+class HandoffTicket:
+    """One prefill→decode handoff in flight: the request, its uniform
+    resume tuple, and the packed K/V run.
+
+    ``ctx`` is the token list cached at rows ``[0, pos)`` and ``last``
+    the sampled-but-not-fed token that re-enters decode at ``pos`` —
+    the SAME resume formula preemption, journal migration and the
+    session tier use, which is why a dead transfer can always fall
+    back to exact replay.  ``data`` is the host-side packed run
+    (`tiers.pack_block_run` of the first ``k`` blocks, padded up to
+    the ``kb`` restore bucket; a (rows, scales) tuple under KV quant);
+    the partial tail block's garbage rows are never read before the
+    target overwrites them — attention masks by position.  Prefix
+    registration metadata needs no extra field: the target re-registers
+    ``ctx``'s full blocks in its OWN index at landing."""
+
+    __slots__ = ("req", "ctx", "last", "pos", "n_new", "data", "k", "kb",
+                 "src", "nbytes", "t_start")
+
+    def __init__(self, req, ctx, last, pos, n_new, data, k, kb, src):
+        self.req = req
+        self.ctx = ctx            # tokens cached at rows [0, pos)
+        self.last = last          # fed (never re-sampled) at pos
+        self.pos = pos
+        self.n_new = n_new        # generated so far (0 = pure bootstrap)
+        self.data = data          # packed host run (array or quant tuple)
+        self.k = k                # real blocks in the run
+        self.kb = kb              # the restore bucket the run padded to
+        self.src = src            # source replica name (events)
+        self.nbytes = sum(a.nbytes for a in data) \
+            if isinstance(data, tuple) else data.nbytes
+        self.t_start = time.perf_counter()
+
+
+class HandoffLanding:
+    """A received ticket staged on the decode side: a row and fresh
+    blocks are held, the packed run's async ``device_put`` is in
+    flight under the current decode launch, and next iteration one
+    warmup-compiled bucketed pool write lands it
+    (`ServingEngine._complete_landing`) — the `_Restore` two-stage
+    stage-ahead, minus the host-tier bookkeeping.  ``blocks`` is held
+    at ordinary refcounts so every failure path funnels through
+    `_release_blocks` like any other holder."""
+
+    __slots__ = ("ticket", "row", "blocks", "staged", "dst_d", "t_stage")
+
+    def __init__(self, ticket, row, blocks, staged, dst_d):
+        self.ticket = ticket
+        self.row = row
+        self.blocks = blocks      # full target-side table, fresh blocks
+        self.staged = staged      # the device_put in flight
+        self.dst_d = dst_d        # (kb,) destination ids, trash-padded
+        self.t_stage = time.perf_counter()

@@ -97,6 +97,8 @@ class RequestJournal:
         self.migrations = 0         # requests moved to a survivor
         # (the landing side — serve.replays — is counted by the engine
         # that actually re-prefills the migrated context)
+        self.handoff_replays = 0    # migrations that were failed
+        # disaggregated handoffs falling back to exact replay
 
     def record(self, req):
         """Enter ``req`` in the ledger; returns the live depth (one scan

@@ -57,7 +57,29 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["HostBlockTier"]
+__all__ = ["HostBlockTier", "pack_block_run"]
+
+
+def pack_block_run(model, block_size, arrs, kb):
+    """Pack an ordered run of per-block K/V payloads into ONE padded
+    `block_run_placeholder` — the single-transfer shape both the
+    host-tier restore and the disaggregated prefill→decode handoff
+    stage, so one async ``device_put`` (not one per block) carries the
+    whole run and one bucketed ``write_block`` scatter lands it.
+    ``arrs`` holds host copies of each block's rows — arrays, or the
+    (int8 rows, f32 scales) tuple under serving KV quantization, in
+    which case the placeholder is the matching tuple and both leaves
+    pack in lockstep.  Entries past ``len(arrs)`` stay zero; the
+    caller's trash-padded destination ids scatter them into the trash
+    block."""
+    data = model.block_run_placeholder(kb, block_size)
+    for j, a in enumerate(arrs):
+        if isinstance(data, tuple):
+            data[0][:, :, j] = a[0]
+            data[1][:, :, j] = a[1]
+        else:
+            data[:, :, j] = a
+    return data
 
 
 class HostBlockTier:

@@ -100,6 +100,17 @@ elif [ "$1" = "--serve-megastep-smoke" ]; then
     T1=""
     set -- tests/test_serve_megastep.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-disagg-smoke" ]; then
+    # fast disaggregation smoke: prefill/decode role split with paged-KV
+    # handoff — colocated-oracle parity (T=0 and seeded T>0), the
+    # exact-replay fallback under handoff_fail / target death, session
+    # affinity to the decode holder, the drain fence (rolling restart,
+    # zero failed), the kill-switch, and the per-role zero-retrace gate
+    # (docs/serving.md "Disaggregated prefill/decode")
+    shift
+    T1=""
+    set -- tests/test_serve_disagg.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

@@ -359,7 +359,7 @@ env PYTHONPATH= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     SERVE_REQUESTS=24 SERVE_RATE=12 SERVE_REPLICAS=2 SERVE_SEQ=64 \
     SERVE_NEW=8 SERVE_PROMPT_MAX=16 SERVE_DEADLINE_MS=30000 \
-    MXNET_CHAOS="engine_crash:6:replica0,decode_slow:0.1:10,launch_error:0.05,block_exhaust:0.1,prefix_evict:0.1" \
+    MXNET_CHAOS="engine_crash:6:replica0,decode_slow:0.1:10,launch_error:0.05,block_exhaust:0.1,prefix_evict:0.1,handoff_fail:0.05" \
     python bench.py --serve --chaos | tee /tmp/nightly_serve_chaos.log
 python - <<'PY'
 import json
@@ -480,4 +480,64 @@ PY
 
 # -- serve-durability smoke: migration/drain/anti-thrash unit coverage ----
 ./run_tests.sh --serve-durability-smoke
+
+# -- disaggregation gate (docs/serving.md "Disaggregated
+# prefill/decode") --------------------------------------------------------
+# colocated vs prefill/decode-split fleet at EQUAL chips on the burst
+# trace (Poisson short-prompt/long-output background + periodic
+# long-prompt storms): the disagg leg must keep background decode
+# inter-token p99 STRICTLY lower (storms queue on the prefill role
+# instead of stalling decode streams), ttft no worse, token-for-token
+# output parity (the handoff resumes the exact uniform resume tuple),
+# nonzero handoffs with zero fails, zero leaked blocks and zero
+# steady-state compiles on BOTH legs (the decode role's restore-scatter
+# buckets join the frozen warmup set); artifact lands in
+# bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SERVE_REQUESTS=48 \
+    python bench.py --serve --disagg | tee /tmp/nightly_serve_disagg.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_disagg.log").read().strip().splitlines()[-1])
+for leg in ("colocated", "disagg"):
+    r = rec[leg]
+    assert r["hung"] == 0, \
+        "disagg gate (%s): %d hung requests" % (leg, r["hung"])
+    assert r["completed"] == r["requests"], \
+        "disagg gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["blocks"]["leaked"] == 0, \
+        "disagg gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+    assert r["steady_state_recompiles"] == 0, \
+        "disagg gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "disagg gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+assert rec["parity"], \
+    "disagg gate: outputs diverged between colocated and disagg legs"
+assert rec["value"] > 1.0, \
+    "disagg gate: %sx background inter-token p99 — role separation " \
+    "must keep decode strictly flatter under storms" % rec["value"]
+colo_ttft, dis_ttft = (rec["ttft_p50_ms"]["colocated"],
+                       rec["ttft_p50_ms"]["disagg"])
+assert dis_ttft <= colo_ttft * 1.25, \
+    "disagg gate: ttft p50 regressed (%s -> %s ms)" % (colo_ttft,
+                                                       dis_ttft)
+assert rec["handoffs"] >= 1, \
+    "disagg gate: the disagg leg never handed off a prefill"
+assert rec["handoff_fails"] == 0, \
+    "disagg gate: %d handoff transfers died" % rec["handoff_fails"]
+print("disagg gate passed: itl p99 %sx (%s -> %s ms), ttft p50 "
+      "%s -> %s ms, %s handoffs" % (
+          rec["value"], rec["itl_p99_ms"]["colocated"],
+          rec["itl_p99_ms"]["disagg"], colo_ttft, dis_ttft,
+          rec["handoffs"]))
+PY
+
+# -- disaggregation smoke: handoff parity/failure/affinity/drain-fence
+# unit coverage (run_tests.sh --serve-disagg-smoke)
+./run_tests.sh --serve-disagg-smoke
 echo "nightly: all gates passed"

@@ -1,0 +1,444 @@
+"""Disaggregated prefill/decode serving (ISSUE-17): role-specialized
+replicas with paged-KV handoff.
+
+Contracts under test:
+
+1. `handoff_fail:P` parses like the other serving chaos clauses and
+   the router validates the fleet split (at least one decode replica,
+   paged cache required).
+2. Handoff parity: a 2-replica disagg fleet (1 prefill + 1 decode)
+   produces token-for-token the colocated oracle's output at T=0 AND
+   under seeded T>0 sampling (the request-keyed position-folded RNG
+   makes the continuation topology-invariant); tickets are counted on
+   both sides, nothing leaks, and compiles stay frozen at warmup on
+   BOTH roles (the zero-retrace gate per role).
+3. Kill-switch: `MXNET_SERVE_DISAGG=0` (default) wires no roles, no
+   sinks, and builds no restore-scatter programs — the colocated
+   fleet bit for bit.
+4. Failure roads: `handoff_fail:1.0` (every transfer dies) resolves
+   every request through the journal's exact-replay fallback with
+   parity; a decode target crashing mid-transfer migrates the inboxed
+   /staged tickets' requests to a survivor with parity.
+5. Session affinity: a follow-up turn lands on the DECODE replica
+   holding the session history (where `_retire` stored it), not the
+   prefill source's stale claim.
+6. Drain fence (ISSUE-17 satellite bugfix): a rolling restart with
+   disagg on finishes with zero failed requests — a draining replica
+   is fenced out of handoff *targeting* too, and respawned
+   replacements inherit their predecessor's role.
+7. Chaos composition: `handoff_fail` + `engine_crash` +
+   `block_exhaust` in one Poisson run — zero hung handles, every
+   request resolves (tokens or typed), zero leaks on survivors.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (ReplicaRouter, ServingEngine,
+                               TransformerKVModel, ServeError,
+                               ServeTimeout, disagg_enabled)
+
+V, S, L, H, E = 61, 32, 2, 2, 32
+
+
+@pytest.fixture
+def model_and_params():
+    model = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E)
+    return model, model.init_params(np.random.RandomState(7))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("MXNET_CHAOS", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_DISAGG", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_PREFILL_REPLICAS", raising=False)
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "0")
+    telemetry.reset()
+    chaos.reset()
+    yield
+    telemetry.reset()
+    chaos.reset()
+
+
+def _engine(model, params, name=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("sampling", False)
+    eng = ServingEngine(model, params, **kw)
+    if name is not None:
+        eng.name = name
+        eng._gauge = "serve.%s." % name
+    return eng
+
+
+def _fleet(model, params, n, **kw):
+    return [_engine(model, params, name="replica%d" % i, **kw)
+            for i in range(n)]
+
+
+def _chaos(monkeypatch, spec):
+    monkeypatch.setenv("MXNET_CHAOS", spec)
+    chaos.reset()
+
+
+def _run_router(router, submits, timeout=300):
+    """Submit (prompt, kwargs) pairs through a started router; returns
+    the request handles after every one resolved."""
+    router.start()
+    try:
+        reqs = [router.submit(p, **kw) for p, kw in submits]
+        for r in reqs:
+            try:
+                r.result(timeout=timeout)
+            except ServeError:
+                pass  # r.error carries it; callers assert as needed
+    finally:
+        router.stop()
+    return reqs
+
+
+_oracle_state = {}
+
+
+def _oracle(model, params, prompt, max_new):
+    """Colocated single-replica truth for one greedy request."""
+    key = (tuple(prompt), max_new)
+    if key not in _oracle_state:
+        eng = _oracle_state.get("engine")
+        if eng is None:
+            eng = _oracle_state["engine"] = _engine(model, params,
+                                                    max_batch=1)
+        req = eng.submit(prompt, max_new_tokens=max_new)
+        eng.run_until_idle(timeout=300)
+        _oracle_state[key] = req.result(1)
+    return _oracle_state[key]
+
+
+# ---------------------------------------------------------------------------
+# 1. clause parsing + fleet validation
+# ---------------------------------------------------------------------------
+
+def test_handoff_fail_clause_parses(monkeypatch):
+    _chaos(monkeypatch, "handoff_fail:0.25")
+    assert chaos.spec().handoff_fail == 0.25
+
+
+def test_disagg_enabled_parsing(monkeypatch):
+    assert not disagg_enabled()               # default off
+    for v, want in (("1", True), ("0", False), ("false", False),
+                    ("no", False), ("yes", True)):
+        monkeypatch.setenv("MXNET_SERVE_DISAGG", v)
+        assert disagg_enabled() is want
+
+
+def test_split_must_leave_a_decode_replica(model_and_params):
+    model, params = model_and_params
+    engines = _fleet(model, params, 2)
+    with pytest.raises(MXNetError, match="decode"):
+        ReplicaRouter(engines, respawn=False, disagg=True,
+                      prefill_replicas=2)
+
+
+# ---------------------------------------------------------------------------
+# 2. handoff parity + zero-retrace per role
+# ---------------------------------------------------------------------------
+
+def test_disagg_parity_t0(model_and_params):
+    """Every prompt prefills on replica0, hands off, and decodes on
+    replica1 — token-for-token the colocated oracle, zero leaks, zero
+    steady-state compiles on either role."""
+    model, params = model_and_params
+    prompts = [[3, 4, 5], [7, 8], [9] * 6, [2], [5, 6, 7, 8, 9]]
+    oracles = [_oracle(model, params, p, 6) for p in prompts]
+    engines = _fleet(model, params, 2)
+    router = ReplicaRouter(engines, respawn=False, disagg=True,
+                           prefill_replicas=1)
+    assert [e.role for e in engines] == ["prefill", "decode"]
+    router.warmup()
+    # decode-role warmup pulled the restore scatter into the frozen set
+    assert any(k[0] == "tier_restore" for k in engines[1]._aot.keys())
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    reqs = _run_router(router, [(p, {"max_new_tokens": 6})
+                                for p in prompts])
+    assert [r.result(1) for r in reqs] == oracles
+    assert engines[0].stats["handoffs"] == len(prompts)
+    assert engines[1].stats["handoffs_in"] == len(prompts)
+    assert engines[0].stats["handoff_fails"] == 0
+    assert reg.counter("serve.handoffs").value == len(prompts)
+    assert reg.counter("serve.handoffs_in").value == len(prompts)
+    assert reg.counter("serve.handoff_bytes").value > 0
+    for e in engines:
+        assert e.leaked_blocks() == 0
+    # the zero-retrace gate, per role: nothing compiled after warmup
+    assert reg.counter("serve.aot.compiles").value == compiles
+    assert [e for e in telemetry.events("retrace")
+            if str(e.get("site", "")).startswith("serving.")] == []
+
+
+def test_disagg_parity_seeded_sampling(model_and_params):
+    """T>0: the request-keyed position-folded RNG makes the sampled
+    continuation a function of (seed, context) — identical whether the
+    request decodes where it prefilled or across a handoff."""
+    model, params = model_and_params
+    prompts = [[3, 4, 5], [7, 8, 9, 10], [2] * 5]
+    kw = {"max_new_tokens": 6, "temperature": 0.8, "top_k": 8}
+
+    colo = _fleet(model, params, 1, sampling=True)
+    router = ReplicaRouter(colo, respawn=False)
+    router.warmup()
+    want = [r.result(1) for r in _run_router(
+        router, [(p, dict(kw, seed=100 + i))
+                 for i, p in enumerate(prompts)])]
+
+    engines = _fleet(model, params, 2, sampling=True)
+    router = ReplicaRouter(engines, respawn=False, disagg=True,
+                           prefill_replicas=1)
+    router.warmup()
+    got = [r.result(1) for r in _run_router(
+        router, [(p, dict(kw, seed=100 + i))
+                 for i, p in enumerate(prompts)])]
+    assert got == want
+    assert engines[1].stats["handoffs_in"] == len(prompts)
+    for e in engines:
+        assert e.leaked_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. kill-switch
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_is_colocated_bit_for_bit(model_and_params):
+    """Default (no MXNET_SERVE_DISAGG): no roles, no restore programs,
+    no handoff counters — PR-16 colocated dispatch exactly."""
+    model, params = model_and_params
+    engines = _fleet(model, params, 2)
+    router = ReplicaRouter(engines, respawn=False)
+    assert router._disagg is False
+    assert all(e.role is None for e in engines)
+    router.warmup()
+    # no decode role, no tier: the restore scatter is never built
+    assert all(not any(k[0] == "tier_restore" for k in e._aot.keys())
+               for e in engines)
+    prompts = [[3, 4, 5], [7, 8], [9] * 6]
+    reqs = _run_router(router, [(p, {"max_new_tokens": 6})
+                                for p in prompts])
+    assert [r.result(1) for r in reqs] == \
+        [_oracle(model, params, p, 6) for p in prompts]
+    reg = telemetry.registry()
+    for k in ("serve.handoffs", "serve.handoffs_in",
+              "serve.handoff_fails", "serve.replays_from_handoff"):
+        assert reg.counter(k).value == 0
+    assert all(e.stats["handoffs"] == 0 for e in engines)
+
+
+# ---------------------------------------------------------------------------
+# 4. failure roads: dead transfer, dead target
+# ---------------------------------------------------------------------------
+
+def test_handoff_fail_falls_back_to_exact_replay(model_and_params,
+                                                 monkeypatch):
+    """handoff_fail:1.0 — every transfer dies at the pack.  Every
+    request must still resolve with oracle parity via the journal's
+    exact-replay road (typed, never hung, never duplicated)."""
+    model, params = model_and_params
+    prompts = [[3 + i, 4, 5] for i in range(6)]
+    oracles = [_oracle(model, params, p, 6) for p in prompts]
+    engines = _fleet(model, params, 2)
+    router = ReplicaRouter(engines, respawn=False, disagg=True,
+                           prefill_replicas=1)
+    router.warmup()
+    _chaos(monkeypatch, "handoff_fail:1.0")
+    reqs = _run_router(router, [(p, {"max_new_tokens": 6})
+                                for p in prompts])
+    assert [r.result(1) for r in reqs] == oracles
+    assert engines[0].stats["handoffs"] == 0       # none ever left
+    assert engines[0].stats["handoff_fails"] == len(prompts)
+    assert router.journal.handoff_replays == len(prompts)
+    reg = telemetry.registry()
+    assert reg.counter("serve.handoff_fails").value == len(prompts)
+    assert reg.counter("serve.replays_from_handoff").value == \
+        len(prompts)
+    for e in engines:
+        assert e.leaked_blocks() == 0
+
+
+def test_decode_target_death_mid_transfer(model_and_params, monkeypatch):
+    """engine_crash kills the sole initially-targeted decode replica
+    while tickets are inboxed/staged: their requests ride the death
+    sweep into journal migration and finish with parity on the
+    surviving decode replica."""
+    model, params = model_and_params
+    prompts = [[3 + i, 4, 5] for i in range(8)]
+    oracles = [_oracle(model, params, p, 6) for p in prompts]
+    engines = _fleet(model, params, 3)
+    router = ReplicaRouter(engines, respawn=False, disagg=True,
+                           prefill_replicas=1)
+    assert [e.role for e in engines] == ["prefill", "decode", "decode"]
+    router.warmup()
+    _chaos(monkeypatch, "engine_crash:2:replica1")
+    reqs = _run_router(router, [(p, {"max_new_tokens": 6,
+                                     "deadline_ms": 60000})
+                                for p in prompts])
+    assert engines[1]._dead is not None           # the crash happened
+    assert [r.result(1) for r in reqs] == oracles
+    for e in engines:
+        if e._dead is None:
+            assert e.leaked_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. session affinity across the role split
+# ---------------------------------------------------------------------------
+
+def test_session_follow_up_lands_on_decode_holder(model_and_params):
+    """Turn 1 prefills on the prefill replica but its history is stored
+    where it DECODED; turn 2 must land there (reattach, suffix-only
+    prefill) — not on the prefill source's stale claim."""
+    model, params = model_and_params
+    turn1, suffix = [3, 4, 5, 6], [7, 8]
+
+    colo = _engine(model, params, name="oracle_sess", tier=True,
+                   host_blocks=32)
+    colo.warmup()
+    r1 = colo.submit(turn1, max_new_tokens=4, session="chat")
+    colo.run_until_idle(timeout=300)
+    want1 = r1.result(1)
+    turn2 = turn1 + want1 + suffix
+    r2 = colo.submit(turn2, max_new_tokens=4, session="chat")
+    colo.run_until_idle(timeout=300)
+    want2 = r2.result(1)
+    colo.stop()
+    telemetry.reset()
+
+    engines = _fleet(model, params, 2, tier=True, host_blocks=32)
+    router = ReplicaRouter(engines, respawn=False, disagg=True,
+                           prefill_replicas=1)
+    router.warmup()
+    router.start()
+    try:
+        q1 = router.submit(turn1, max_new_tokens=4, session="chat")
+        assert q1.result(timeout=120) == want1
+        q2 = router.submit(turn1 + q1.tokens + suffix,
+                           max_new_tokens=4, session="chat")
+        assert q2.result(timeout=120) == want2
+    finally:
+        router.stop()
+    # the DECODE replica held the history and served the follow-up
+    assert engines[1].stats["session_hits"] == 1
+    assert engines[0].stats["session_hits"] == 0
+    for e in engines:
+        assert e.leaked_blocks() == 0
+        assert e.leaked_host_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 6. drain fence + rolling restart (the satellite bugfix regression)
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_with_disagg_zero_failed(model_and_params):
+    """Drain every replica in turn under live disagg traffic: zero
+    failed requests (the draining replica is fenced out of handoff
+    TARGETING, tickets redirect to survivors), and the respawned
+    replacements keep their predecessor's role."""
+    from mxnet_tpu.parallel import make_mesh
+
+    model, params = model_and_params
+    mesh = make_mesh(shape=(3,), axis_names=("data",))
+    router = ReplicaRouter.from_mesh(
+        model, params, mesh=mesh, max_batch=4, prefill_buckets=[8, 16],
+        max_new_tokens=6, sampling=False, respawn=True, disagg=True,
+        prefill_replicas=1)
+    router.warmup()
+    rng = np.random.RandomState(3)
+    router.start()
+    reqs, stop_feed = [], threading.Event()
+
+    def feed():
+        for _ in range(24):
+            if stop_feed.is_set():
+                return
+            prompt = list(rng.randint(0, V, size=int(rng.randint(1, 8))))
+            reqs.append(router.submit(prompt, max_new_tokens=4))
+            time.sleep(0.02)
+
+    feeder = threading.Thread(target=feed)
+    feeder.start()
+    try:
+        for name in ("replica0", "replica1", "replica2"):
+            time.sleep(0.1)
+            router.drain(name, deadline_ms=200)
+        feeder.join(timeout=120)
+        assert not feeder.is_alive()
+        for r in list(reqs):
+            r.result(timeout=120)        # raises on ANY failure
+    finally:
+        stop_feed.set()
+        feeder.join(timeout=120)
+        router.stop()
+    assert len(reqs) == 24
+    assert all(r.done and r.error is None for r in reqs)
+    assert [e.role for e in router.engines] == \
+        ["prefill", "decode", "decode"]
+    for e in router.engines:
+        if e._dead is None:
+            assert e.leaked_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 7. chaos composition
+# ---------------------------------------------------------------------------
+
+def test_chaos_composition_disagg(model_and_params, monkeypatch):
+    """handoff_fail + engine_crash (a decode replica) + block_exhaust
+    simultaneously: zero hung handles, every request resolves (tokens
+    or typed) in bounded time, zero leaks on survivors, compiles
+    frozen at warmup."""
+    from mxnet_tpu.parallel import make_mesh
+
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "5")
+    _chaos(monkeypatch,
+           "handoff_fail:0.3,engine_crash:5:replica1,block_exhaust:0.1")
+    deadline_ms = 60000.0
+    mesh = make_mesh(shape=(3,), axis_names=("data",))
+    router = ReplicaRouter.from_mesh(
+        model, params, mesh=mesh, max_batch=4, prefill_buckets=[8, 16],
+        max_new_tokens=4, deadline_ms=deadline_ms, sampling=False,
+        respawn=True, disagg=True, prefill_replicas=1)
+    router.warmup()
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    rng = np.random.RandomState(3)
+    router.start()
+    try:
+        reqs = []
+        for _ in range(24):
+            prompt = list(rng.randint(0, V, size=int(rng.randint(1, 8))))
+            reqs.append(router.submit(prompt))
+            time.sleep(float(rng.exponential(0.02)))
+        ok, typed = 0, 0
+        for r in reqs:
+            try:
+                r.result(timeout=120)
+                ok += 1
+            except ServeTimeout:
+                pytest.fail("request %d hung (no resolution)" % r.id)
+            except ServeError:
+                typed += 1
+        assert ok + typed == len(reqs)
+        assert all(r.done for r in reqs)
+        assert ok > 0
+    finally:
+        router.stop()
+    for e in router.engines:
+        if e._dead is None:
+            assert e.leaked_blocks() == 0
+    assert reg.counter("serve.aot.compiles").value == compiles
+    assert [e for e in telemetry.events("retrace")
+            if str(e.get("site", "")).startswith("serving.")] == []

@@ -99,6 +99,18 @@ SERVE_DECODE_LOOP_COUNTERS = (
     "serve.megasteps", "serve.megastep_tokens", "serve.ingraph_retired")
 SERVE_DECODE_LOOP_GAUGE_SUFFIX = ".host_frac"
 
+# disaggregation accounting (docs/serving.md "Disaggregated
+# prefill/decode"): prefill→decode handoff traffic (tickets out/in,
+# bytes, fails, exact-replay fallbacks), the per-role replica gauge
+# (serve.<name>.role: 1=prefill 2=decode), the router's per-role queue
+# gauges, and the staging-to-landing wait histogram
+SERVE_DISAGG_COUNTERS = (
+    "serve.handoffs", "serve.handoffs_in", "serve.handoff_bytes",
+    "serve.handoff_fails", "serve.replays_from_handoff")
+SERVE_DISAGG_GAUGES = ("serve.prefill_depth", "serve.decode_depth")
+SERVE_DISAGG_GAUGE_SUFFIX = ".role"
+SERVE_DISAGG_EVENT_KINDS = ("serve_handoff", "serve_handoff_fail")
+
 # quantization accounting (docs/serving.md "Quantization"): logit-gate
 # trips + chaos scale corruptions (serve.<name>.quant.* per replica,
 # process-wide serve.quant.*), and the live logit-error gauge the
@@ -336,6 +348,24 @@ def summarize(records):
                 decode_loop.get("serve.megastep_tokens", 0) / float(megs),
                 2)
         out["decode_loop"] = decode_loop
+    disagg = {k: int(final.get(k, 0)) for k in SERVE_DISAGG_COUNTERS
+              if final.get(k)}
+    for r in records:
+        for k, v in r.get("gauges", {}).items():
+            if k in SERVE_DISAGG_GAUGES or (
+                    k.startswith("serve.") and
+                    k.endswith(SERVE_DISAGG_GAUGE_SUFFIX)):
+                disagg[k] = v  # last-seen (role flips only on respawn)
+    for kind in SERVE_DISAGG_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            disagg["%s_events" % kind] = n
+    wait = _merge_hists(records, "serve.handoff_wait_ms")
+    if wait:
+        disagg["serve.handoff_wait_ms"] = wait
+    if disagg:
+        out["disaggregation"] = disagg
     quantization = {k: int(final.get(k, 0)) for k in SERVE_QUANT_COUNTERS
                     if final.get(k)}
     for r in records:
@@ -428,6 +458,17 @@ def format_summary(summary):
         lines.append("  decode loop:")
         for key in sorted(decode_loop):
             lines.append("    %-24s %s" % (key, decode_loop[key]))
+    disagg = summary.get("disaggregation")
+    if disagg:
+        lines.append("  disaggregation:")
+        for key in sorted(disagg):
+            v = disagg[key]
+            if isinstance(v, dict):
+                lines.append("    %-24s n=%d mean=%.1f p99<=%.1f max=%.1f"
+                             % (key, v["count"], v["mean"], v["p99_max"],
+                                v["max"]))
+            else:
+                lines.append("    %-24s %s" % (key, v))
     quantization = summary.get("quantization")
     if quantization:
         lines.append("  quantization:")
