@@ -1862,6 +1862,148 @@ def serve_disagg_bench(record=True):
     return result
 
 
+def serve_tracing_bench(record=True):
+    """Request-tracing overhead A/B on the disaggregated burst trace
+    (``python bench.py --serve --tracing``).
+
+    Two legs, identical trace and fleet (2 replicas split into
+    prefill/decode roles so spans cross the handoff boundary): the
+    `untraced` leg pins ``MXNET_SERVE_TRACING=0`` (every tracing call
+    site no-ops), the `traced` leg runs the default-on span layer.  The
+    headline is the overhead: traced tok/s must be within 3% of
+    untraced (the nightly tracing gate asserts it), with `output_sig`
+    bit-for-bit equal, zero steady-state recompiles and zero retrace
+    events on BOTH legs — tracing is host-side bookkeeping and must
+    never perturb the device program.
+
+    The traced leg's telemetry stream is then audited as the span-tree
+    witness: one root per completed request, no orphan spans (every
+    parent sid resolves inside its trace), at least one trace crossing
+    replicas when handoffs happened, interval phases tiling ~all of
+    e2e (`attributed_frac`), and the stream well-formed enough for
+    tools/trace_report.py to consume.
+    """
+    from mxnet_tpu import telemetry, tracing
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    replicas = os.environ.get("SERVE_REPLICAS", "2")
+    shared = {"SERVE_TRACE": "burst", "MXNET_SERVE_PAGED": "1",
+              "SERVE_REPLICAS": replicas,
+              "MXNET_SERVE_DISAGG": "1",
+              "MXNET_SERVE_PREFILL_REPLICAS": os.environ.get(
+                  "MXNET_SERVE_PREFILL_REPLICAS", "1")}
+    runs = {}
+    streams = {}
+    # untraced first so the traced leg's stream (same JSONL path) is
+    # the one left on disk for trace_report / the nightly gate
+    for mode, env in (("untraced", {"MXNET_SERVE_TRACING": "0"}),
+                      ("traced", {"MXNET_SERVE_TRACING": "1"})):
+        env = dict(shared, **env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        tracing.reset()    # fresh rings/open traces per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        path = os.path.join(here, runs[mode]["telemetry_stream"])
+        spans, recorders = [], []
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("type") == "span":
+                        spans.append(rec)
+                    elif rec.get("type") == "flight_recorder":
+                        recorders.append(rec)
+        except OSError:
+            pass
+        streams[mode] = (spans, recorders)
+    off, on = runs["untraced"], runs["traced"]
+    spans, recorders = streams["traced"]
+
+    # span-tree audit (traced leg)
+    traces = {}
+    for s in spans:
+        traces.setdefault(s.get("trace", 0), []).append(s)
+    traces.pop(0, None)  # replica-scoped megastep/sweep/spec spans
+    orphans = 0
+    cross = 0
+    roots_ok = 0
+    fracs = []
+    for t, lst in traces.items():
+        sids = {s.get("sid") for s in lst}
+        orphans += sum(1 for s in lst
+                       if s.get("parent") not in sids
+                       and s.get("parent") not in (0, None))
+        if len({s.get("replica") for s in lst}) > 1:
+            cross += 1
+        for s in lst:
+            if s.get("phase") != "request":
+                continue
+            attrs = s.get("attrs") or {}
+            if not attrs.get("ok"):
+                continue
+            roots_ok += 1
+            e2e = s.get("ms") or 0.0
+            attributed = sum(v for k, v in attrs.items()
+                             if k.endswith("_ms") and
+                             k not in ("ttft_ms", "e2e_ms") and
+                             isinstance(v, (int, float)))
+            if e2e > 0:
+                fracs.append(attributed / e2e)
+
+    tok_on = on["value"]
+    tok_off = off["value"]
+    result = {
+        "metric": "serve_tracing_overhead",
+        # the acceptance ratio: traced / untraced tok/s/chip — the
+        # nightly gate requires >= 0.97 (within 3% of free)
+        "value": round(tok_on / max(tok_off, 1e-9), 4),
+        "unit": "traced/untraced tok/s/chip ratio (disagg burst trace, "
+                "%s replicas)" % replicas,
+        "traced": on,
+        "untraced": off,
+        "parity": on["output_sig"] == off["output_sig"],
+        "tok_s": {"traced": tok_on, "untraced": tok_off},
+        "steady_state_recompiles": {
+            "traced": on["steady_state_recompiles"],
+            "untraced": off["steady_state_recompiles"]},
+        "steady_state_retrace_events": {
+            "traced": on["steady_state_retrace_events"],
+            "untraced": off["steady_state_retrace_events"]},
+        "spans": {
+            "records": len(spans),
+            "traces": len(traces),
+            "roots_ok": roots_ok,
+            "completed": on["completed"],
+            "orphans": orphans,
+            "cross_replica_traces": cross,
+            "handoffs": on["resilience"].get("handoffs", 0),
+            "attributed_frac": round(sum(fracs) / len(fracs), 4)
+            if fracs else None,
+            "recorder_dumps": len(recorders),
+        },
+        # the kill-switch witness: =0 must emit NOTHING
+        "untraced_span_records": len(streams["untraced"][0]),
+    }
+    if record:
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -1949,6 +2091,8 @@ if __name__ == "__main__":
             serve_durability_bench()
         elif "--disagg" in sys.argv:
             serve_disagg_bench()
+        elif "--tracing" in sys.argv:
+            serve_tracing_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

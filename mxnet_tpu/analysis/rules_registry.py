@@ -18,6 +18,14 @@
   spec (tests, bench, nightly.sh) must be parsed by ``chaos.py``; a
   typo'd clause would otherwise fail the whole spec at runtime, mid-
   nightly.
+* ``span-phase-unknown`` / ``span-phase-undocumented`` /
+  ``span-phase-unrendered`` — every phase name passed to
+  ``tracing.phase(...)`` / ``tracing.add_span(...)`` must be in
+  ``tracing.PHASES``, and every ``PHASES`` entry must be documented
+  (backticked) in ``docs/observability.md`` and rendered by
+  ``tools/trace_report.py``.  Same contract shape as the telemetry
+  drift pair: a phase name that exists only at an emission site is
+  invisible to the waterfall and the attribution table.
 """
 from __future__ import annotations
 
@@ -36,6 +44,9 @@ _EVENT_KIND_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 ENV_DOC = "docs/env_vars.md"
 REPORT = "tools/telemetry_report.py"
 CHAOS_MODULE = "mxnet_tpu/chaos.py"
+TRACING_MODULE = "mxnet_tpu/tracing.py"
+TRACE_REPORT = "tools/trace_report.py"
+OBS_DOC = "docs/observability.md"
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +414,117 @@ class TelemetryDriftRule(Rule):
                     self.UNRENDERED, path, line, col,
                     "serving event kind '%s' is emitted here but %s "
                     "never renders it" % (kind, REPORT)))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# span phase names vs tracing.PHASES / docs / trace_report
+# ---------------------------------------------------------------------------
+
+_PHASE_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_DOC_PHASE_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+
+def _phase_consts(node):
+    """Literal phase names an expression can evaluate to: a constant
+    contributes itself, an IfExp both branches (the engine's
+    ``"replay" if resumed else "prefill"`` site)."""
+    s = str_const(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.IfExp):
+        return _phase_consts(node.body) + _phase_consts(node.orelse)
+    return []
+
+
+def _phases_tuple(tree):
+    """The ``PHASES = (...)`` taxonomy from the tracing module."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "PHASES":
+            vals = node.value.elts if isinstance(
+                node.value, (ast.Tuple, ast.List)) else []
+            return {s for s in (str_const(e) for e in vals) if s}
+    return set()
+
+
+@register
+class SpanPhaseDriftRule(Rule):
+    id = "span-phase-unknown"
+    serving = True   # the forward check guards engine.py call sites
+    UNDOC = "span-phase-undocumented"
+    UNRENDERED = "span-phase-unrendered"
+
+    def check_file(self, ctx, project):
+        if ctx.relpath == TRACING_MODULE:
+            project.data["span-phases"] = _phases_tuple(ctx.tree)
+            return []
+        uses = project.data.setdefault("span-phase-uses", [])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            path = dotted(node.func) or ""
+            if not (path.endswith("tracing.phase")
+                    or path.endswith("tracing.add_span")):
+                continue
+            for name in _phase_consts(node.args[1]):
+                uses.append((name, ctx.relpath, node.lineno,
+                             node.col_offset))
+        return []
+
+    def check_project(self, project):
+        findings = []
+        phases = project.data.get("span-phases")
+        if not phases:
+            # subtree run that excluded tracing.py: load the reference
+            # module directly so the forward check stays meaningful
+            text = project.read_text(TRACING_MODULE)
+            if text:
+                try:
+                    phases = _phases_tuple(ast.parse(text))
+                except SyntaxError:
+                    phases = set()
+        if not phases:
+            return [Finding(self.id, TRACING_MODULE, 1, 0,
+                            "could not extract the PHASES tuple from "
+                            "tracing.py (parser drift?)")]
+        for name, path, line, col in project.data.get(
+                "span-phase-uses", []):
+            if name not in phases:
+                findings.append(Finding(
+                    self.id, path, line, col,
+                    "span phase '%s' is emitted here but is not in "
+                    "tracing.PHASES (known: %s)"
+                    % (name, ", ".join(sorted(phases)))))
+        if project.partial:
+            # the doc/report reverse checks need the full taxonomy to
+            # be authoritative only about files this run actually saw
+            return findings
+        doc = project.read_text(OBS_DOC)
+        documented = set(_DOC_PHASE_RE.findall(doc)) if doc else set()
+        for name in sorted(phases - documented):
+            findings.append(Finding(
+                self.UNDOC, OBS_DOC, 1, 0,
+                "span phase '%s' is in tracing.PHASES but %s never "
+                "mentions it (backtick the phase in the taxonomy table)"
+                % (name, OBS_DOC)))
+        report = project.read_text(TRACE_REPORT)
+        rendered = set()
+        if report:
+            try:
+                for node in ast.walk(ast.parse(report)):
+                    if isinstance(node, ast.Constant) and \
+                            isinstance(node.value, str) and \
+                            _PHASE_NAME_RE.match(node.value):
+                        rendered.add(node.value)
+            except SyntaxError:
+                pass
+        for name in sorted(phases - rendered):
+            findings.append(Finding(
+                self.UNRENDERED, TRACE_REPORT, 1, 0,
+                "span phase '%s' is in tracing.PHASES but %s never "
+                "renders it" % (name, TRACE_REPORT)))
         return findings
 
 

@@ -131,6 +131,7 @@ import jax.numpy as jnp
 
 from .. import chaos
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
@@ -354,11 +355,20 @@ class ServeRequest:
     def _finish(self, error=None):
         if self._done.is_set():
             return
+        # flush delivery first: the retiring step may have appended a
+        # final token the trailing `_publish()` in the step loop has not
+        # delivered yet — stream positions must match span positions
+        # before the trace closes below
+        self._publish()
         self.error = error
         self.t_done = time.perf_counter()
         self._done.set()
         with self._stream_cond:
             self._stream_cond.notify_all()  # unblock stream() waiters
+        # every resolution (retire, shed, quarantine, cancel, deadline,
+        # replica death) funnels through here exactly once: close the
+        # request's trace and fold its phases into serve.attr.*
+        tracing.on_finish(self)
 
 
 class _Seq:
@@ -433,7 +443,7 @@ class _Restore:
                  "handles", "staged", "dst_d", "dst", "kb", "t_stage")
 
     def __init__(self, req, row, tokens, blocks, done, nodes, handles,
-                 staged, dst_d, dst, kb):
+                 staged, dst_d, dst, kb, t_stage=None):
         self.req = req
         self.row = row
         self.tokens = tokens
@@ -445,7 +455,9 @@ class _Restore:
         self.dst_d = dst_d        # (kb,) destination ids, trash-padded
         self.dst = dst            # real destination blocks, in order
         self.kb = kb              # the k-bucket the run padded up to
-        self.t_stage = time.perf_counter()
+        # stamped by the caller BEFORE the host pack + device_put dispatch,
+        # so serve.restore_wait_ms covers the whole stage -> land window
+        self.t_stage = time.perf_counter() if t_stage is None else t_stage
 
 
 class _SessionClaim:
@@ -1449,6 +1461,12 @@ class ServingEngine:
         req._waker = self._wake.set
         self._wake.set()
         telemetry.set_gauge(self._gauge + "queue_depth", depth)
+        # every road into the queue (submit, router dispatch, failover
+        # redispatch, migration, handoff replay) passes through here: open
+        # the trace (idempotent — a requeued request keeps its root and
+        # its original t_submit) and flip the interval phase to queue_wait
+        tracing.open_trace(req.id, self.name, t=req.t_submit)
+        tracing.phase(req.id, "queue_wait", self.name, depth=depth)
         return req
 
     def _enqueue(self, req, count_shed_global=True):
@@ -1575,6 +1593,7 @@ class ServingEngine:
         self._count("quarantined")
         telemetry.record_event("serve_quarantine", replica=self.name,
                                request=req.id, error=msg[:200])
+        tracing.dump(self.name, "quarantine", request=req.id)
         req._finish(error=ServeQuarantined(msg[:500]))
 
     # -- quantization logit-gate trips (docs/serving.md "Quantization") ----
@@ -1607,6 +1626,8 @@ class ServingEngine:
             req._requeues += 1
             with self._qlock:
                 self._queue.appendleft(req)
+            tracing.phase(req.id, "queue_wait", self.name,
+                          requeue="quant_trip")
         else:
             req._finish(error=ServeQuantError(
                 "ServeRequest %d: quantization logit gate tripped (%s) — "
@@ -1867,6 +1888,8 @@ class ServingEngine:
                     pf.req._requeues += 1
                     with self._qlock:
                         self._queue.appendleft(pf.req)
+                    tracing.phase(pf.req.id, "queue_wait", self.name,
+                                  requeue="cache_rebuild")
                 else:
                     self._quarantine(pf.req, "prefill lost to a cache "
                                      "rebuild twice: %s" % reason[:200])
@@ -1880,6 +1903,8 @@ class ServingEngine:
                     rs.req._requeues += 1
                     with self._qlock:
                         self._queue.appendleft(rs.req)
+                    tracing.phase(rs.req.id, "queue_wait", self.name,
+                                  requeue="cache_rebuild")
                 else:
                     self._quarantine(rs.req, "restore lost to a cache "
                                      "rebuild twice: %s" % reason[:200])
@@ -1913,6 +1938,7 @@ class ServingEngine:
         self._count("cache_rebuilds")
         telemetry.record_event("serve_cache_rebuild", replica=self.name,
                                reason=reason[:200])
+        tracing.dump(self.name, "cache_rebuild", detail=reason[:200])
 
     def _samp_device(self, reqs, b):
         """Per-row device sampling arrays for rows ``reqs`` padded to
@@ -1938,6 +1964,8 @@ class ServingEngine:
         if self._paged:
             return self._admit_one_paged(req)
         slot = self._free.pop()
+        tracing.phase(req.id, "prefill", self.name,
+                      prompt_len=len(req.prompt))
         try:
             plen = len(req.prompt)
             s = self._bucket_for(plen, self.prefill_buckets)
@@ -1978,6 +2006,8 @@ class ServingEngine:
                     req._requeues += 1
                     with self._qlock:
                         self._queue.appendleft(req)
+                    tracing.phase(req.id, "queue_wait", self.name,
+                                  requeue="cache_rebuild")
                 else:
                     self._quarantine(req, "prefill launch failed twice "
                                      "across a cache rebuild: %s" % e)
@@ -2004,6 +2034,7 @@ class ServingEngine:
         if self._seq_finished(seq, first):
             self._retire(slot, seq, enter=False)
         else:
+            tracing.phase(req.id, "decode", self.name, pos=plen)
             self._active[slot] = seq
         req._publish()
         return True
@@ -2066,6 +2097,7 @@ class ServingEngine:
         # next iteration (_advance_restores).  A handle the tier
         # evicted in the window truncates the run — contiguity is what
         # makes the table coverage valid.
+        t_stage = time.perf_counter()  # restore stage START (pack + put)
         nodes, handles, arrs, dst = [], [], [], []
         for node in host_nodes:
             arr = self._tier.get(node.block)
@@ -2101,7 +2133,10 @@ class ServingEngine:
             self._restoring[row] = _Restore(req, row, list(tokens), blocks,
                                             matched, nodes, handles,
                                             self._put(data),
-                                            self._put(dsts), dst, kb)
+                                            self._put(dsts), dst, kb,
+                                            t_stage=t_stage)
+            tracing.phase(req.id, "restore_wait", self.name, t=t_stage,
+                          blocks=len(nodes))
             return True
         self._enter_decode_or_prefill(req, row, list(tokens), blocks,
                                       matched)
@@ -2150,8 +2185,15 @@ class ServingEngine:
             seq = _Seq(req, last, pos, blocks=blocks,
                        ctx=list(tokens[:pos]))
             seq.n_new = n_new
+            tracing.phase(req.id, "decode", self.name, pos=pos,
+                          bootstrap=True)
             self._active[row] = seq
             return
+        # a resumed admission re-prefills context it already generated
+        # once: that is SLO-attributed as `replay`, not `prefill`
+        tracing.phase(req.id,
+                      "replay" if req._resume is not None else "prefill",
+                      self.name, covered=covered, total=len(tokens))
         pf = _Prefill(req, row, tokens, blocks,
                       resume=None if req._resume is None
                       else req._resume[1:])
@@ -2240,6 +2282,8 @@ class ServingEngine:
                 self._drop_host_node(node)
             with self._qlock:
                 self._queue.appendleft(req)
+            tracing.phase(req.id, "queue_wait", self.name,
+                          requeue="restore_failed")
             return
         # landed: flip the nodes back to device residency (keeping the
         # host copies — re-evicting them is free), count, and proceed.
@@ -2306,6 +2350,7 @@ class ServingEngine:
         if self._handoff_sink is None or self.role != "prefill" \
                 or not self._paged or req._no_handoff or pos <= 0:
             return False
+        t_pack = time.perf_counter()  # handoff stage START (pack + ship)
         ticket = None
         try:
             if chaos.enabled() and chaos.serve_handoff_fail():
@@ -2331,7 +2376,13 @@ class ServingEngine:
             packed = pack_block_run(self.model, self.block_size, arrs,
                                     kb)
             ticket = HandoffTicket(req, list(tokens[:pos]), last, pos,
-                                   n_new, packed, k, kb, self.name)
+                                   n_new, packed, k, kb, self.name,
+                                   t_start=t_pack)
+            ctx = tracing.context(req.id)
+            if ctx is not None:
+                # the ticket carries (trace id, root span id) across the
+                # role boundary; the decode side adopts it at receive
+                ticket.trace, ticket.parent = ctx
         except Exception as e:  # noqa: BLE001 — degrade to replay
             self._free.append(row)
             self._drop_refs(blocks)
@@ -2343,6 +2394,14 @@ class ServingEngine:
         self._free.append(row)
         self._drop_refs(blocks)
         self._block_gauges()
+        # handoff_wait opens at PACK start: the wait the SLO attribution
+        # charges covers pack + transfer + landing, matching the fixed
+        # serve.handoff_wait_ms stage-time measurement
+        tracing.phase(req.id, "handoff_wait", self.name, t=t_pack,
+                      blocks=ticket.k, nbytes=ticket.nbytes)
+        tracing.add_span(req.id, "handoff_pack", self.name, t_pack,
+                         time.perf_counter(), blocks=ticket.k,
+                         nbytes=ticket.nbytes)
         try:
             self._handoff_sink(ticket)
         except Exception as e:  # noqa: BLE001 — no live decode target
@@ -2364,6 +2423,8 @@ class ServingEngine:
         self._count("handoff_fails")
         telemetry.record_event("serve_handoff_fail", replica=self.name,
                                request=req.id, error=str(msg)[:200])
+        tracing.dump(self.name, "handoff_fail", request=req.id,
+                     error=str(msg)[:200])
         ok = False
         if self._handoff_fallback is not None:
             try:
@@ -2382,6 +2443,9 @@ class ServingEngine:
         race admission-close on a draining target."""
         if not self._paged:
             raise MXNetError("receive_handoff: paged serving only")
+        # adopt the carried trace context BEFORE queueing: spans this
+        # replica records parent under the root the prefill side opened
+        tracing.adopt(ticket.trace, ticket.parent, replica=self.name)
         with self._qlock:
             self._check_alive_locked()
             self._handoff_inbox.append(ticket)
@@ -2445,6 +2509,7 @@ class ServingEngine:
         the staged bytes and falls back to journal exact-replay."""
         t = ld.ticket
         req = t.req
+        t_land = time.perf_counter()
         try:
             compiled = self._compiled_restore(t.kb)
             staged = ld.staged if isinstance(ld.staged, tuple) \
@@ -2476,8 +2541,13 @@ class ServingEngine:
         self._register_prefix(t.ctx, ld.blocks, t.pos)
         self.stats["handoffs_in"] += 1
         self._count("handoffs_in")
+        now = time.perf_counter()
         telemetry.observe("serve.handoff_wait_ms",
-                          1e3 * (time.perf_counter() - t.t_start))
+                          1e3 * (now - t.t_start))
+        tracing.add_span(req.id, "handoff_land", self.name, t_land, now,
+                         blocks=t.k, src=t.src)
+        tracing.phase(req.id, "decode", self.name, pos=t.pos,
+                      handoff=t.src)
         del self._landing[ld.row]
         if self._drafter is not None and t.n_new:
             # the handed-off generation seeds the drafter store, same
@@ -2530,6 +2600,7 @@ class ServingEngine:
         bucket = largest if remaining > largest else \
             self._bucket_for(remaining, self.prefill_buckets)
         chunk = min(remaining, bucket)
+        t_chunk = time.perf_counter()
         try:
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :chunk] = pf.tokens[pf.done:pf.done + chunk]
@@ -2578,6 +2649,9 @@ class ServingEngine:
         self.stats["prefill_chunks"] += 1
         self.stats["prefill_tokens"] += chunk  # the suffix-only witness
         telemetry.inc("serve.prefill_chunks")
+        tracing.add_span(req.id, "prefill_chunk", self.name, t_chunk,
+                         time.perf_counter(), start=pf.done - chunk,
+                         tokens=chunk)
         # publish the chunk's newly-FULL blocks (a block whose bucket
         # tail is padding garbage stays private: `done` counts only real
         # tokens, so it rounds down past any partially-written block)
@@ -2609,6 +2683,8 @@ class ServingEngine:
                 # replayed generation seeds the drafter store (migration
                 # and preempt-resume alike): full accept rate immediately
                 self._drafter.on_resume(list(pf.tokens) + [last])
+            tracing.phase(req.id, "decode", self.name, pos=pos,
+                          resumed=True)
             self._active[pf.row] = seq
             return
         first = int(np.asarray(tok)[0])
@@ -2632,6 +2708,7 @@ class ServingEngine:
             self._retire(pf.row, seq, enter=False)
         elif not self._maybe_handoff(req, pf.row, pf.tokens, blocks,
                                      first, total, 1):
+            tracing.phase(req.id, "decode", self.name, pos=total)
             self._active[pf.row] = seq
         # the first token publishes from the SOURCE exactly once —
         # streaming's positional high-water mark; the decode side
@@ -2796,6 +2873,8 @@ class ServingEngine:
                                request=req.id, pos=pf.done, prefill=True)
         with self._qlock:
             self._queue.appendleft(req)
+        tracing.phase(req.id, "queue_wait", self.name, requeue="preempt",
+                      pos=pf.done)
 
     def _stall(self, row):
         """Sit ``row`` out of this iteration's decode launch: blocks and
@@ -2874,6 +2953,8 @@ class ServingEngine:
                                request=req.id, pos=seq.pos)
         with self._qlock:
             self._queue.appendleft(req)
+        tracing.phase(req.id, "queue_wait", self.name, requeue="preempt",
+                      pos=seq.pos)
 
     def _seq_finished(self, seq, token):
         if seq.req.eos_id is not None and token == seq.req.eos_id:
@@ -3242,6 +3323,7 @@ class ServingEngine:
             self._block_gauges(full=True)
             inflight = self._launch_mega()
         # -- overlap window: host work the device no longer waits on --
+        t_sweep = time.perf_counter()
         self._sweep()
         self._advance_restores()
         self._advance_landings()
@@ -3282,6 +3364,10 @@ class ServingEngine:
             if ms:
                 time.sleep(ms / 1e3)
         if inflight is not None:
+            # the replica-scoped host-sweep span: the PR-16 host_frac
+            # bookkeeping's overlap window, visible per iteration
+            tracing.add_span(0, "host_sweep", self.name, t_sweep,
+                             time.perf_counter())
             self._finish_mega(inflight)
         elif self._active:
             # every active row is stalled on a denied allocation —
@@ -3350,6 +3436,8 @@ class ServingEngine:
         # the launch->fetch span: every host cycle spent inside it
         # (the whole overlap window) rode under the in-flight megastep
         self.stats["hidden_s"] += now - t_launch
+        tracing.add_span(0, "megastep", self.name, t_launch, now,
+                         rows=nrows, bucket=b, m=self._mega_m)
         m = self._mega_m
         self.stats["megasteps"] += 1
         self.stats["decode_rows"] += nrows
@@ -3564,6 +3652,8 @@ class ServingEngine:
         now = time.perf_counter()
         self.stats["fetch_wait_s"] += now - t_fetch
         self.stats["hidden_s"] += now - t_launch
+        tracing.add_span(0, "spec_round", self.name, t_launch, now,
+                         rows=n, bucket=b, k=k)
         self.stats["verify_steps"] += 1
         self.stats["decode_rows"] += n
         self.stats["decode_padded"] += b - n
@@ -3671,6 +3761,10 @@ class ServingEngine:
         is unrecoverable — exactly the PR-11 contract."""
         err = ServeEngineDead("ServingEngine %s: scheduler died: %s"
                               % (self.name, msg))
+        # postmortem FIRST, while the rings still hold the death's lead-up
+        # (the failover hook below may enqueue onto survivors and write
+        # fresh spans into the stream)
+        tracing.dump(self.name, "scheduler_death", error=msg[:200])
         inflight = self._sweep_inflight()
         with self._qlock:
             # mark dead and drain atomically: _enqueue checks _dead under

@@ -67,9 +67,10 @@ class HandoffTicket:
     ``ctx``'s full blocks in its OWN index at landing."""
 
     __slots__ = ("req", "ctx", "last", "pos", "n_new", "data", "k", "kb",
-                 "src", "nbytes", "t_start")
+                 "src", "nbytes", "t_start", "trace", "parent")
 
-    def __init__(self, req, ctx, last, pos, n_new, data, k, kb, src):
+    def __init__(self, req, ctx, last, pos, n_new, data, k, kb, src,
+                 t_start=None):
         self.req = req
         self.ctx = ctx            # tokens cached at rows [0, pos)
         self.last = last          # fed (never re-sampled) at pos
@@ -81,7 +82,15 @@ class HandoffTicket:
         self.src = src            # source replica name (events)
         self.nbytes = sum(a.nbytes for a in data) \
             if isinstance(data, tuple) else data.nbytes
-        self.t_start = time.perf_counter()
+        # stamped by the CALLER at pack start (before the device->host
+        # copies), so serve.handoff_wait_ms measures the whole stage ->
+        # land window, not just what's left after ticket construction
+        self.t_start = time.perf_counter() if t_start is None else t_start
+        # trace context carried across the role boundary: the decode side
+        # adopts (trace id, root span id) so one connected span tree
+        # crosses prefill -> decode (tracing.adopt at receive_handoff)
+        self.trace = None
+        self.parent = None
 
 
 class HandoffLanding:

@@ -50,6 +50,7 @@ __all__ = [
     "MetricsRegistry", "JsonlSink", "MemorySink",
     "registry", "reset", "enabled", "health_enabled", "retrace_enabled",
     "inc", "set_gauge", "observe", "record_event", "events",
+    "emit_record", "add_event_tap", "remove_event_tap",
     "add_sink", "remove_sink", "register_collector",
     "step_report", "step_end",
     "arrays_signature", "watch_jit",
@@ -103,11 +104,46 @@ class MemorySink:
 
 class JsonlSink:
     """One JSON object per line, flushed per record so a crashed run keeps
-    its stream up to the last completed step."""
+    its stream up to the last completed step.
 
-    def __init__(self, path):
+    Size-capped rotation for long soaks: when `max_mb` (default
+    ``MXNET_TELEMETRY_MAX_MB``, 0 = unbounded) is set and the current file
+    crosses it, the stream rotates shift-style — ``path`` -> ``path.1`` ->
+    ``path.2`` ... keeping the newest `keep` (``MXNET_TELEMETRY_KEEP``,
+    default 3) rotated files — so a multi-hour serve bench with per-request
+    span records cannot fill the disk.  Rotation happens on a record
+    boundary, so every file in the set stays valid JSONL."""
+
+    def __init__(self, path, max_mb=None, keep=None):
         self.path = path
+        if max_mb is None:
+            max_mb = float(os.environ.get("MXNET_TELEMETRY_MAX_MB", "0"))
+        if keep is None:
+            keep = int(os.environ.get("MXNET_TELEMETRY_KEEP", "3"))
+        self.max_bytes = int(max_mb * 1024 * 1024)
+        self.keep = max(1, keep)
         self._f = None
+        self._written = 0
+
+    def _rotate(self):
+        self._f.close()
+        self._f = None
+        for k in range(self.keep, 0, -1):
+            src = self.path if k == 1 else "%s.%d" % (self.path, k - 1)
+            dst = "%s.%d" % (self.path, k)
+            try:
+                if os.path.exists(src):
+                    os.replace(src, dst)
+            except OSError:
+                pass
+        # anything past the keep window from an earlier, larger keep
+        extra = "%s.%d" % (self.path, self.keep + 1)
+        if os.path.exists(extra):
+            try:
+                os.remove(extra)
+            except OSError:
+                pass
+        self._written = 0
 
     def emit(self, record):
         if self._f is None:
@@ -115,8 +151,16 @@ class JsonlSink:
             if d:
                 os.makedirs(d, exist_ok=True)
             self._f = open(self.path, "a")
-        self._f.write(json.dumps(record, default=str) + "\n")
+            try:
+                self._written = os.path.getsize(self.path)
+            except OSError:
+                self._written = 0
+        line = json.dumps(record, default=str) + "\n"
+        self._f.write(line)
         self._f.flush()
+        self._written += len(line)
+        if self.max_bytes and self._written >= self.max_bytes:
+            self._rotate()
 
     def close(self):
         if self._f is not None:
@@ -285,6 +329,19 @@ class MetricsRegistry:
         if kind is not None:
             log = [e for e in log if e.get("kind") == kind]
         return log
+
+    def emit_record(self, record):
+        """Emit one raw record to every sink, bypassing the step rollup —
+        the tracing span/flight-recorder stream rides the same JSONL as
+        the step reports (readers discriminate on ``record["type"]``)."""
+        with self._lock:
+            sinks = list(self._sinks)
+        for sink in sinks:
+            try:
+                sink.emit(record)
+            except Exception:
+                logging.exception("telemetry sink %r failed", sink)
+        return record
 
     # -- sinks / collectors ------------------------------------------------
     def add_sink(self, sink):
@@ -625,13 +682,48 @@ def observe(name, v):
 def record_event(kind, **fields):
     if not enabled():
         return None
-    return registry().record_event(kind, **fields)
+    ev = registry().record_event(kind, **fields)
+    # event taps (the tracing flight recorder) see every event the process
+    # records; a broken tap must not kill the instrumented call site
+    for tap in list(_EVENT_TAPS):
+        try:
+            tap(ev)
+        except Exception:
+            logging.exception("telemetry event tap %r failed", tap)
+    return ev
 
 
 def events(kind=None):
     if _REG is None:
         return []
     return _REG.events(kind)
+
+
+def emit_record(record):
+    """Emit one raw (non-step) record to the attached sinks — no-op until
+    a sink exists, so span emission is free in unsinked processes."""
+    if not enabled() or _REG is None or not _REG._sinks:
+        return None
+    return _REG.emit_record(record)
+
+
+# taps survive registry reset() (they belong to the tracing module's
+# lifecycle, not the registry's); tracing.reset() removes its own tap
+_EVENT_TAPS = []
+
+
+def add_event_tap(fn):
+    """Forward every `record_event` dict to `fn` (the tracing flight
+    recorder mirrors replica-tagged events into its rings this way — the
+    dependency points tracing -> telemetry, never back)."""
+    if fn not in _EVENT_TAPS:
+        _EVENT_TAPS.append(fn)
+    return fn
+
+
+def remove_event_tap(fn):
+    if fn in _EVENT_TAPS:
+        _EVENT_TAPS.remove(fn)
 
 
 def add_sink(sink):

@@ -120,6 +120,18 @@ elif [ "$1" = "--serve-chaos-smoke" ]; then
     T1=""
     set -- tests/test_serve_chaos.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--trace-smoke" ]; then
+    # fast request-tracing smoke: span-tree continuity across handoff /
+    # migration / preemption-replay (one trace id end to end, no orphan
+    # spans), SLO attribution folding (phases tile e2e), the flight
+    # recorder dump on engine_crash/handoff_fail, JSONL sink rotation,
+    # the MXNET_SERVE_TRACING=0 kill-switch parity, and the
+    # span-phase-drift lint rule (docs/observability.md
+    # "Request tracing")
+    shift
+    T1=""
+    set -- tests/test_tracing.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--chaos-smoke" ]; then
     # fast single-host fault-tolerance smoke: the chaos-driven recovery
     # tests (idempotent retries, snapshot/restart, nonfinite skip,

@@ -540,4 +540,64 @@ PY
 # -- disaggregation smoke: handoff parity/failure/affinity/drain-fence
 # unit coverage (run_tests.sh --serve-disagg-smoke)
 ./run_tests.sh --serve-disagg-smoke
+
+# -- tracing gate (docs/observability.md "Request tracing") ---------------
+# tracing-on vs MXNET_SERVE_TRACING=0 at equal everything on the disagg
+# burst trace: traced tok/s within 3% of untraced, output_sig bit for
+# bit, zero steady-state compiles and zero retrace events on BOTH legs
+# (the span layer is host-side bookkeeping only), one ok root per
+# completed request with no orphan spans, at least one span tree
+# crossing the prefill->decode boundary when handoffs happened,
+# interval phases tiling >=80% of e2e, and ZERO span records on the =0
+# leg; artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SERVE_REQUESTS=48 \
+    python bench.py --serve --tracing | tee /tmp/nightly_serve_trace.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_trace.log").read().strip().splitlines()[-1])
+for leg in ("traced", "untraced"):
+    r = rec[leg]
+    assert r["hung"] == 0, \
+        "tracing gate (%s): %d hung requests" % (leg, r["hung"])
+    assert r["steady_state_recompiles"] == 0, \
+        "tracing gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "tracing gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+assert rec["parity"], \
+    "tracing gate: outputs diverged between traced and untraced legs"
+assert rec["value"] >= 0.97, \
+    "tracing gate: traced throughput is %sx untraced — span overhead " \
+    "must stay within 3%%" % rec["value"]
+sp = rec["spans"]
+assert sp["roots_ok"] == rec["traced"]["completed"], \
+    "tracing gate: %s ok span roots for %s completed requests" % (
+        sp["roots_ok"], rec["traced"]["completed"])
+assert sp["orphans"] == 0, \
+    "tracing gate: %d orphan spans (parent sid unresolved)" % sp["orphans"]
+if sp["handoffs"] >= 1:
+    assert sp["cross_replica_traces"] >= 1, \
+        "tracing gate: %d handoffs but no span tree crosses replicas" \
+        % sp["handoffs"]
+assert sp["attributed_frac"] is not None and \
+    sp["attributed_frac"] >= 0.8, \
+    "tracing gate: interval phases cover only %s of e2e" \
+    % sp["attributed_frac"]
+assert rec["untraced_span_records"] == 0, \
+    "tracing gate: MXNET_SERVE_TRACING=0 leg emitted %d span records" \
+    % rec["untraced_span_records"]
+print("tracing gate passed: %sx tok/s, %s spans / %s traces, "
+      "%s cross-replica, attribution %s, %s recorder dumps" % (
+          rec["value"], sp["records"], sp["traces"],
+          sp["cross_replica_traces"], sp["attributed_frac"],
+          sp["recorder_dumps"]))
+PY
+
+# -- tracing smoke: span continuity / flight recorder / kill-switch unit
+# coverage (run_tests.sh --trace-smoke)
+./run_tests.sh --trace-smoke
 echo "nightly: all gates passed"

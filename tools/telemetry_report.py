@@ -119,6 +119,16 @@ SERVE_QUANT_COUNTERS = ("serve.quant.trips", "serve.quant.scale_corrupts")
 SERVE_QUANT_GAUGE = "serve.quant_logit_err"
 SERVE_QUANT_EVENT_KINDS = ("serve_quant_trip", "serve_scale_corrupt")
 
+# SLO attribution (docs/observability.md "Request tracing"): the tracing
+# layer folds every retired request's span timeline into per-phase
+# serve.attr.*_ms histograms — a ttft/e2e p99 regression names its phase
+SERVE_ATTR_HISTS = (
+    "serve.attr.queue_wait_ms", "serve.attr.prefill_ms",
+    "serve.attr.replay_ms", "serve.attr.restore_wait_ms",
+    "serve.attr.handoff_wait_ms", "serve.attr.decode_ms",
+    "serve.attr.unattributed_ms", "serve.attr.e2e_ms",
+    "serve.attr.ttft_ms")
+
 
 def load(path):
     records = []
@@ -170,28 +180,47 @@ def _fmt_bytes(n):
     return "%d B" % n
 
 
+def step_rows(records, max_steps=None):
+    """The per-step table as data: one dict per rendered row with the
+    same columns — the machine-readable twin `--json` emits so gates
+    read fields instead of scraping the rendered text."""
+    rows = records if max_steps is None else records[-max_steps:]
+    out = []
+    for rec in rows:
+        d = rec.get("deltas", {})
+        io = rec.get("hists", {}).get("io.wait_ms", {})
+        out.append({
+            "step": rec.get("step"),
+            "step_ms": _step_ms(rec),
+            "samples_per_sec": rec.get("gauges", {}).get(
+                "train.samples_per_sec"),
+            "jit_entries": int(d.get("dispatch.jit_entries", 0)),
+            "host_transfers": int(d.get("dispatch.host_transfers", 0)),
+            "comm_bytes": _comm_delta(rec),
+            "io_wait_ms": io.get("mean") if io.get("count") else None,
+            "events": [e.get("kind", "?")
+                       for e in rec.get("events", [])],
+        })
+    return out
+
+
 def render(records, max_steps=None):
     lines = []
-    rows = records if max_steps is None else records[-max_steps:]
     lines.append("%6s %10s %12s %8s %8s %10s %9s %s" % (
         "step", "step_ms", "samples/s", "jit", "xfers", "comm", "io_ms",
         "events"))
-    for rec in rows:
-        d = rec.get("deltas", {})
-        g = rec.get("gauges", {})
-        io = rec.get("hists", {}).get("io.wait_ms", {})
-        evs = ",".join(e.get("kind", "?") for e in rec.get("events", []))
-        ms = _step_ms(rec)
-        sps = g.get("train.samples_per_sec")
+    for row in step_rows(records, max_steps=max_steps):
+        ms, sps, io = row["step_ms"], row["samples_per_sec"], \
+            row["io_wait_ms"]
         lines.append("%6s %10s %12s %8d %8d %10s %9s %s" % (
-            rec.get("step", "?"),
+            row["step"] if row["step"] is not None else "?",
             "%.1f" % ms if ms is not None else "-",
             "%.1f" % sps if sps is not None else "-",
-            int(d.get("dispatch.jit_entries", 0)),
-            int(d.get("dispatch.host_transfers", 0)),
-            _fmt_bytes(_comm_delta(rec)),
-            "%.1f" % io["mean"] if io.get("count") else "-",
-            evs))
+            row["jit_entries"],
+            row["host_transfers"],
+            _fmt_bytes(row["comm_bytes"]),
+            "%.1f" % io if io is not None else "-",
+            ",".join(row["events"])))
     return "\n".join(lines)
 
 
@@ -366,6 +395,24 @@ def summarize(records):
         disagg["serve.handoff_wait_ms"] = wait
     if disagg:
         out["disaggregation"] = disagg
+    attribution = {}
+    for name in SERVE_ATTR_HISTS:
+        agg = _merge_hists(records, name)
+        if agg:
+            attribution[name] = agg
+    if attribution:
+        e2e = attribution.get("serve.attr.e2e_ms")
+        if e2e and e2e["count"]:
+            # the structural invariant the nightly tracing gate asserts:
+            # interval phases tile submit->done, so their totals cover
+            # ~all of e2e (unattributed = finish-path remainder)
+            total = sum(v["mean"] * v["count"]
+                        for k, v in attribution.items()
+                        if k not in ("serve.attr.e2e_ms",
+                                     "serve.attr.ttft_ms"))
+            attribution["attributed_frac"] = round(
+                total / (e2e["mean"] * e2e["count"]), 4)
+        out["attribution"] = attribution
     quantization = {k: int(final.get(k, 0)) for k in SERVE_QUANT_COUNTERS
                     if final.get(k)}
     for r in records:
@@ -469,6 +516,17 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %s" % (key, v))
+    attribution = summary.get("attribution")
+    if attribution:
+        lines.append("  attribution:")
+        for key in sorted(attribution):
+            v = attribution[key]
+            if isinstance(v, dict):
+                lines.append("    %-28s n=%d mean=%.1f p99<=%.1f max=%.1f"
+                             % (key, v["count"], v["mean"], v["p99_max"],
+                                v["max"]))
+            else:
+                lines.append("    %-28s %s" % (key, v))
     quantization = summary.get("quantization")
     if quantization:
         lines.append("  quantization:")
@@ -491,7 +549,9 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=40,
                     help="show at most the last N per-step rows (0 = all)")
     ap.add_argument("--json", action="store_true",
-                    help="print the summary as JSON instead of text")
+                    help="print one JSON object mirroring every rendered "
+                         "section (summary + per-step table) instead of "
+                         "text")
     args = ap.parse_args(argv)
     records = load(args.path)
     if not records:
@@ -499,7 +559,10 @@ def main(argv=None):
         return 1
     summary = summarize(records)
     if args.json:
-        print(json.dumps(summary, default=str))
+        print(json.dumps(
+            {"summary": summary,
+             "steps": step_rows(records, max_steps=args.steps or None)},
+            default=str))
         return 0
     print(render(records, max_steps=args.steps or None))
     print(format_summary(summary))
