@@ -2004,6 +2004,341 @@ def serve_tracing_bench(record=True):
     return result
 
 
+def serve_elastic_bench(record=True):
+    """Elastic gateway soak (``python bench.py --serve --elastic``).
+
+    Phase 1 — the soak: a 1-replica fleet behind the HTTP/SSE gateway
+    takes Poisson streaming traffic whose offered rate STEPS up for the
+    middle third of the run; the `AutoScaler` grows the fleet off the
+    SHARED frozen AotCache and shrinks it back once the step passes.
+    The gates the nightly elastic-soak job asserts:
+
+    * zero failed requests (scale-down mid-traffic drains + migrates,
+      it never kills work);
+    * zero steady-state compiles (every respawn is asserted
+      compile-free against the warmup-frozen program set);
+    * at least one scale-up AND one scale-down, ending at the min clamp;
+    * streamed ttfb within 10% of the engine's own ttft (per-trace
+      join of the `gateway_send` span against the request root span) —
+      streaming must deliver the first token when the ENGINE has it,
+      not when the request finishes;
+    * bounded gateway memory: the open-connection peak stays under
+      `conn_max` (send buffers are watermark-bounded by construction);
+    * `serve.gateway.*` counters consistent with the span stream
+      (accepted == completed streams == gateway_send spans).
+
+    Phase 2 — the chaos matrix: each new clause alone
+    (`client_disconnect`, `slow_consumer`, `conn_flood`) and their
+    composition with `engine_crash` under an active autoscaler.  A leg
+    is green when every request resolves (served, typed-cancelled, or
+    typed-shed — NOTHING hangs) and no blocks leak.
+
+    Artifact: bench_results/serve_bench.json.
+    """
+    import socket
+    import threading
+
+    import jax
+
+    from mxnet_tpu import chaos as chaos_mod
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import (AutoScaler, ReplicaRouter, ServeGateway,
+                                   ServingEngine, TransformerKVModel)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tel_path = os.path.join(here, "bench_results", "telemetry_serve.jsonl")
+    try:
+        os.remove(tel_path)
+    except OSError:
+        pass
+    os.makedirs(os.path.dirname(tel_path), exist_ok=True)
+    telemetry.add_sink(telemetry.JsonlSink(tel_path))
+    os.environ["MXNET_SERVE_GATEWAY"] = "1"   # this IS the gateway bench
+    os.environ.setdefault("MXNET_CHAOS_SEED", "0")
+
+    n_requests = int(os.environ.get("ELASTIC_REQUESTS", "48"))
+    max_fleet = int(os.environ.get("ELASTIC_MAX_REPLICAS", "3"))
+    base_rate = float(os.environ.get("ELASTIC_RATE", "8"))
+    hysteresis = float(os.environ.get("ELASTIC_HYSTERESIS_S", "0.2"))
+    vocab = int(os.environ.get("ELASTIC_VOCAB", "128"))
+    seq = int(os.environ.get("ELASTIC_SEQ", "64"))
+    prompt_max = 12
+    max_new = int(os.environ.get("ELASTIC_NEW", "12"))
+    rng = np.random.RandomState(int(os.environ.get("SERVE_SEED", "0")))
+
+    model = TransformerKVModel(vocab, seq, num_layers=2, num_heads=2,
+                               num_embed=32)
+    params = model.init_params(rng)
+
+    def _fleet(n):
+        # one shared device: elasticity is about PROGRAMS and queues,
+        # not chips — respawned replicas land where their template runs
+        return [ServingEngine(model, params, max_batch=4,
+                              prefill_buckets=[16], max_new_tokens=max_new,
+                              sampling=False, name="replica%d" % i)
+                for i in range(n)]
+
+    def _sse(port, prompt, out):
+        """One streaming request; records its typed outcome."""
+        rec = {"status": None, "tokens": 0, "done": False, "error": None}
+        try:
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": max_new}).encode()
+            s = socket.create_connection(("127.0.0.1", port), timeout=120)
+            try:
+                s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: b\r\n"
+                          b"Content-Length: %d\r\n\r\n%s"
+                          % (len(body), body))
+                buf = b""
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        if not rec["done"] and rec["error"] is None:
+                            rec["error"] = "hangup"  # server dropped us
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, _, buf = buf.partition(b"\n")
+                        line = line.strip()
+                        if rec["status"] is None \
+                                and line.startswith(b"HTTP/1.1"):
+                            rec["status"] = int(line.split()[1])
+                        elif line == b"data: [DONE]":
+                            rec["done"] = True
+                        elif line.startswith(b"data: ") or \
+                                line.startswith(b"{"):
+                            try:
+                                d = json.loads(
+                                    line.split(b"data: ", 1)[-1])
+                            except ValueError:
+                                continue
+                            if "token" in d:
+                                rec["tokens"] += 1
+                            elif "error" in d:
+                                rec["error"] = d["error"]
+                    if rec["done"] or (rec["status"] not in (None, 200)
+                                       and rec["error"] is not None):
+                        break
+            finally:
+                s.close()
+        except Exception as e:  # noqa: BLE001 — a leg outcome, not a crash
+            rec["error"] = rec["error"] or repr(e)
+        out.append(rec)
+
+    def _run_traffic(port, prompts, rates):
+        out, threads = [], []
+        fleet_sizes, conn_peaks = [], []
+        reg = telemetry.registry()
+        for p, r in zip(prompts, rates):
+            th = threading.Thread(target=_sse, args=(port, p, out))
+            th.start()
+            threads.append(th)
+            fleet_sizes.append(len(router.engines))
+            conn_peaks.append(
+                reg._gauges.get("serve.gateway.open_conns", 0))
+            if r > 0:
+                time.sleep(rng.exponential(1.0 / r))
+        hung = 0
+        for th in threads:
+            th.join(timeout=180)
+            hung += th.is_alive()
+        return out, fleet_sizes, conn_peaks, hung
+
+    # ---- phase 1: the soak -----------------------------------------------
+    chaos_ambient = os.environ.pop("MXNET_CHAOS", None)
+    chaos_mod.reset()
+    engines = _fleet(1)
+    router = ReplicaRouter(engines, respawn=False)
+    buckets = router.warmup()[0]
+    telemetry.step_report(extra={"phase": "serve_warmup"})
+    reg = telemetry.registry()
+    compiles0 = reg.counter("serve.aot.compiles").value
+    router.start()
+    gw = ServeGateway(router).start()
+    asc = AutoScaler(router, min_replicas=1, max_replicas=max_fleet,
+                     hysteresis_s=hysteresis, up_depth=1.0,
+                     down_depth=0.5, period=hysteresis / 8.0).start()
+    third = max(1, n_requests // 3)
+    prompts = [[int(t) for t in
+                rng.randint(0, vocab, size=int(rng.randint(2, prompt_max)))]
+               for _ in range(n_requests)]
+    # the load step: Poisson at base_rate, then the middle third arrives
+    # back to back (rate 0 = no pacing), then base_rate again
+    rates = [0 if third <= i < 2 * third else base_rate
+             for i in range(n_requests)]
+    t0 = time.perf_counter()
+    results, fleet_sizes, conn_peaks, hung = _run_traffic(
+        gw.port, prompts, rates)
+    elapsed = time.perf_counter() - t0
+    peak_fleet = max(fleet_sizes + [len(router.engines)])
+    # idle now: the cold window must walk the fleet back to the clamp
+    shrink_deadline = time.time() + max(20 * hysteresis, 15)
+    while time.time() < shrink_deadline and len(router.engines) > 1:
+        time.sleep(hysteresis / 4.0)
+    end_fleet = len(router.engines)
+    asc.stop()
+    gw.stop()
+    router.stop()
+    telemetry.step_report(extra={"phase": "serve_elastic_end"})
+    steady_compiles = reg.counter("serve.aot.compiles").value - compiles0
+    scale_ups = int(reg.counter("serve.scale_ups").value)
+    scale_downs = int(reg.counter("serve.scale_downs").value)
+    accepted = int(reg.counter("serve.gateway.accepted").value)
+    failed = sum(1 for r in results
+                 if r["status"] != 200 or not r["done"] or r["error"])
+    n_tokens = sum(r["tokens"] for r in results)
+    leaked = sum(e.leaked_blocks() for e in router.engines)
+
+    # ttfb-vs-ttft: join the gateway_send span against the request root
+    # span per trace id (= router request id) out of the span stream
+    roots, sends = {}, {}
+    try:
+        with open(tel_path) as f:
+            for line in f:
+                try:
+                    s = json.loads(line)
+                except ValueError:
+                    continue
+                if s.get("type") != "span":
+                    continue
+                attrs = s.get("attrs") or {}
+                if s.get("phase") == "request" \
+                        and attrs.get("ttft_ms") is not None:
+                    roots[s.get("trace")] = attrs["ttft_ms"]
+                elif s.get("phase") == "gateway_send" \
+                        and attrs.get("ttfb_ms") is not None:
+                    sends[s.get("trace")] = attrs["ttfb_ms"]
+    except OSError:
+        pass
+    pairs = [(roots[t], sends[t]) for t in sends if t in roots]
+    ttft_mean = round(float(np.mean([a for a, _ in pairs])), 3) \
+        if pairs else None
+    ttfb_mean = round(float(np.mean([b for _, b in pairs])), 3) \
+        if pairs else None
+    # the acceptance bound: streamed ttfb within 10% of engine ttft (a
+    # 2 ms absolute floor absorbs scheduling noise at toy CPU scale
+    # where ttft itself is single-digit ms)
+    ttfb_ok = bool(pairs) and \
+        ttfb_mean <= 1.10 * ttft_mean + 2.0
+
+    soak = {
+        "requests": n_requests,
+        "failed": failed,
+        "hung": hung,
+        "tokens": n_tokens,
+        "elapsed_s": round(elapsed, 3),
+        "fleet": {"start": 1, "peak": peak_fleet, "end": end_fleet,
+                  "max": max_fleet},
+        "scale_ups": scale_ups,
+        "scale_downs": scale_downs,
+        "steady_state_compiles": steady_compiles,
+        "leaked_blocks": leaked,
+        "ttft_ms_mean": ttft_mean,
+        "ttfb_ms_mean": ttfb_mean,
+        "ttfb_pairs": len(pairs),
+        "open_conns_peak": int(max(conn_peaks) if conn_peaks else 0),
+        "conn_max": gw.conn_max,
+        "counters_consistent": accepted == n_requests == len(sends),
+    }
+
+    # ---- phase 2: chaos matrix -------------------------------------------
+    def _chaos_leg(spec, autoscale=False, conn_max=None, n=10):
+        os.environ["MXNET_CHAOS"] = spec
+        chaos_mod.reset()
+        lrng = np.random.RandomState(1)
+        legs_engines = _fleet(2)
+        lrouter = ReplicaRouter(legs_engines,
+                                respawn="engine_crash" in spec)
+        lrouter.warmup()
+        lrouter.start()
+        lgw = ServeGateway(lrouter, conn_max=conn_max).start()
+        lasc = AutoScaler(lrouter, min_replicas=1,
+                          max_replicas=max_fleet,
+                          hysteresis_s=hysteresis, up_depth=2.0,
+                          period=hysteresis / 8.0).start() \
+            if autoscale else None
+        out, threads = [], []
+        try:
+            for _ in range(n):
+                p = [int(t) for t in lrng.randint(0, vocab, size=6)]
+                th = threading.Thread(target=_sse,
+                                      args=(lgw.port, p, out))
+                th.start()
+                threads.append(th)
+                time.sleep(0.01)
+            lhung = 0
+            for th in threads:
+                th.join(timeout=180)
+                lhung += th.is_alive()
+        finally:
+            if lasc is not None:
+                lasc.stop()
+            lgw.stop()
+            lrouter.stop()
+        ok = sum(1 for r in out if r["status"] == 200 and r["done"]
+                 and not r["error"])
+        # a cancel (SSE error frame / deliberate server hangup) and a
+        # shed (429/503 at the door) are the TYPED outcomes the clause
+        # exists to force — green means nothing left the taxonomy
+        cancelled = sum(1 for r in out if r["status"] == 200
+                        and not r["done"])
+        shed = sum(1 for r in out
+                   if r["status"] not in (None, 200))
+        lleaked = sum(e.leaked_blocks() for e in lrouter.engines)
+        return {
+            "chaos": spec, "autoscaler": autoscale, "requests": n,
+            "ok": ok, "cancelled": cancelled, "shed": shed,
+            "hung": lhung, "leaked_blocks": lleaked,
+            "green": (lhung == 0 and lleaked == 0
+                      and ok + cancelled + shed == len(out) == n),
+        }
+
+    legs = [
+        _chaos_leg("client_disconnect:0.5"),
+        _chaos_leg("slow_consumer:0.5:40"),
+        _chaos_leg("conn_flood:8:16", conn_max=4),
+        _chaos_leg("client_disconnect:0.25,slow_consumer:0.25:40,"
+                   "conn_flood:8:12,engine_crash:3:replica0",
+                   autoscale=True, conn_max=8),
+    ]
+    if chaos_ambient is None:
+        os.environ.pop("MXNET_CHAOS", None)
+    else:
+        os.environ["MXNET_CHAOS"] = chaos_ambient
+    chaos_mod.reset()
+
+    gates = {
+        "zero_failed": failed == 0 and hung == 0,
+        "zero_steady_state_compiles": steady_compiles == 0,
+        "scaled_up_and_down": scale_ups >= 1 and scale_downs >= 1
+        and end_fleet == 1,
+        "ttfb_within_10pct_of_ttft": ttfb_ok,
+        "gateway_memory_bounded": soak["open_conns_peak"] <= gw.conn_max,
+        "counters_consistent": soak["counters_consistent"],
+        "chaos_legs_green": all(leg["green"] for leg in legs),
+    }
+    result = {
+        "metric": "serve_elastic_soak",
+        "value": round(n_tokens / max(elapsed, 1e-9), 2),
+        "unit": "streamed tok/s through the gateway (fleet 1->%d->%d, "
+                "vocab=%d S=%d)" % (peak_fleet, end_fleet, vocab, seq),
+        "soak": soak,
+        "chaos_legs": legs,
+        "gates": gates,
+        "all_gates_passed": all(gates.values()),
+        "buckets": buckets,
+        "backend": jax.default_backend(),
+        "telemetry_stream": os.path.relpath(tel_path, here),
+    }
+    if record:
+        out_path = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def _io_pipeline_ips(n=384):
     """RecordIO read + JPEG decode throughput on this host (img/s)."""
     import tempfile
@@ -2093,6 +2428,8 @@ if __name__ == "__main__":
             serve_disagg_bench()
         elif "--tracing" in sys.argv:
             serve_tracing_bench()
+        elif "--elastic" in sys.argv:
+            serve_elastic_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

@@ -1,76 +1,171 @@
 #!/usr/bin/env python
-"""Train -> checkpoint -> serve: the full deployment path.
+"""Deploy a generation fleet behind the HTTP/SSE gateway — end to end.
 
-1. Train a small classifier with FeedForward and checkpoint it
-   (`prefix-symbol.json` + `prefix-%04d.params`, reference format).
-2. Load the checkpoint into a `Predictor` (the `MXPredCreate` surface).
-3. `export()` a single self-contained artifact (StableHLO + params) and
-   serve from `load_exported` with no Symbol graph or op registry — the
-   amalgamation-analogue deployable (`amalgamation/README.md` role).
+The seed for a real deployment (`docs/serving.md` "Gateway &
+autoscaling"):
+
+1. Build a 2-replica continuous-batching fleet (`ServingEngine` x2 on
+   SHARED params behind a `ReplicaRouter`) and warm up the frozen AOT
+   program set — steady state compiles nothing.
+2. Front it with `ServeGateway` (`MXNET_SERVE_GATEWAY=1`): a
+   stdlib-asyncio HTTP server speaking JSON and per-token SSE.
+3. Talk to it with NOTHING but the stdlib: a JSON completion via
+   `http.client`, then the same prompt streamed token-by-token over
+   `text/event-stream` on a raw socket — the two answers must match.
+4. Flood it: a concurrent burst against a queue_max=1 fleet makes the
+   admission bound bite, and the gateway answers typed `429
+   ServeOverload` JSON instead of queueing without bound — the
+   backpressure contract, observable with curl.
+
+Everything runs on whatever JAX backend is present (CPU included).
 """
 from __future__ import annotations
 
 import argparse
+import json
+import http.client
 import logging
 import os
+import socket
 import sys
-import tempfile
+import threading
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu.predictor import load_exported  # noqa: E402
+from mxnet_tpu.serving import (ReplicaRouter, ServeGateway,  # noqa: E402
+                               ServingEngine, TransformerKVModel)
+
+
+def _post(port, path, obj, timeout=120):
+    """One stdlib JSON POST -> (status, parsed body)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(obj),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def _stream(port, obj, timeout=120):
+    """POST with stream=true and parse the SSE frames off a raw socket.
+
+    Returns the token list; prints each token as it lands — that is the
+    point of the streaming path (ttfb ~ engine ttft, not full latency).
+    """
+    body = json.dumps(dict(obj, stream=True)).encode()
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                  b"Host: localhost\r\nContent-Type: application/json\r\n"
+                  b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        buf, tokens = b"", []
+        while b"\r\n\r\n" not in buf:          # response header
+            buf += s.recv(4096)
+        buf = buf.split(b"\r\n\r\n", 1)[1]
+        while True:
+            while b"\n\n" in buf:              # complete SSE frames
+                frame, buf = buf.split(b"\n\n", 1)
+                payload = frame.split(b"data: ", 1)[1]
+                if payload == b"[DONE]":
+                    return tokens
+                rec = json.loads(payload)
+                if "error" in rec:
+                    raise RuntimeError("stream error: %r" % (rec,))
+                tokens.append(rec["token"])
+                print("  token[%d] = %d" % (len(tokens) - 1, rec["token"]))
+            chunk = s.recv(4096)
+            if not chunk:
+                raise RuntimeError("server hung up mid-stream")
+            buf += chunk
+    finally:
+        s.close()
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--num-epoch", type=int, default=8)
-    ap.add_argument("--batch-size", type=int, default=64)
-    ap.add_argument("--out-dir", default=None)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--burst", type=int, default=32,
+                    help="concurrent requests in the overload demo")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
-    out_dir = args.out_dir or tempfile.mkdtemp(prefix="deploy_")
+    os.environ.setdefault("MXNET_SERVE_GATEWAY", "1")
 
-    rng = np.random.RandomState(0)
-    n, d, k = 1024, 32, 5
-    y = rng.randint(0, k, n)
-    X = rng.randn(n, d).astype(np.float32)
-    X[np.arange(n), y * 6] += 3.0
+    # 1. the fleet: shared params, tiny queue bound so the flood demo
+    #    actually sheds (production would size queue_max to the SLO)
+    model = TransformerKVModel(64, 64, num_layers=2, num_heads=2,
+                               num_embed=32)
+    params = model.init_params(np.random.RandomState(0))
+    engines = []
+    for i in range(args.replicas):
+        eng = ServingEngine(model, params, max_batch=4,
+                            prefill_buckets=[16, 32],
+                            max_new_tokens=args.max_new, sampling=False,
+                            queue_max=1, overload="shed")
+        eng.name = "replica%d" % i
+        eng._gauge = "serve.replica%d." % i
+        engines.append(eng)
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()          # the whole program set, compiled once
+    router.start()
 
-    data = mx.sym.Variable("data")
-    fc1 = mx.sym.FullyConnected(data=data, num_hidden=64, name="fc1")
-    act = mx.sym.Activation(data=fc1, act_type="relu")
-    fc2 = mx.sym.FullyConnected(data=act, num_hidden=k, name="fc2")
-    net = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    # 2. the gateway on an ephemeral port
+    gw = ServeGateway(router).start()
+    logging.info("gateway up: http://127.0.0.1:%d", gw.port)
 
-    # 1. train + checkpoint
-    model = mx.model.FeedForward(
-        symbol=net, ctx=mx.cpu(), num_epoch=args.num_epoch,
-        optimizer="sgd", learning_rate=0.2, initializer=mx.init.Xavier())
-    model.fit(X=mx.io.NDArrayIter(X, y.astype(np.float32),
-                                  batch_size=args.batch_size, shuffle=True))
-    prefix = os.path.join(out_dir, "clf")
-    model.save(prefix, args.num_epoch)
-    logging.info("checkpoint: %s-{symbol.json,%04d.params}", prefix,
-                 args.num_epoch)
+    try:
+        prompt = [1, 5, 9, 2]
 
-    # 2. predictor from the checkpoint files
-    pred = mx.predictor.load(prefix, args.num_epoch,
-                             input_shapes={"data": (args.batch_size, d)})
-    acc = (pred.predict(data=X[:args.batch_size]).argmax(1)
-           == y[:args.batch_size]).mean()
-    logging.info("Predictor accuracy on a batch: %.3f", acc)
+        # 3a. plain JSON completion (stream defaults to true — SSE is
+        #     the native dialect; opt out for request/response)
+        status, body = _post(gw.port, "/v1/generate",
+                             {"prompt": prompt, "max_new_tokens": 8,
+                              "stream": False})
+        assert status == 200, body
+        logging.info("JSON completion: %s", body["tokens"])
 
-    # 3. single-artifact export -> registry-free serving
-    artifact = os.path.join(out_dir, "clf.mxtpu")
-    pred.export(artifact)
-    served = load_exported(artifact)
-    acc2 = (served.predict(data=X[:args.batch_size]).argmax(1)
-            == y[:args.batch_size]).mean()
-    logging.info("exported artifact %s (%d bytes): accuracy %.3f",
-                 artifact, os.path.getsize(artifact), acc2)
-    assert abs(acc - acc2) < 1e-9
+        # 3b. the same prompt streamed per-token over SSE
+        logging.info("SSE stream of the same prompt:")
+        streamed = _stream(gw.port, {"prompt": prompt,
+                                     "max_new_tokens": 8})
+        assert streamed == body["tokens"], (streamed, body["tokens"])
+        logging.info("streamed tokens match the JSON completion")
+
+        # 4. flood: a concurrent burst against queue_max=1 must shed
+        #    typed 429s, never queue unboundedly or drop the connection
+        results = []
+        lock = threading.Lock()
+
+        def fire():
+            st, rec = _post(gw.port, "/v1/generate",
+                            {"prompt": prompt, "stream": False,
+                             "max_new_tokens": args.max_new})
+            with lock:
+                results.append((st, rec.get("error")))
+
+        threads = [threading.Thread(target=fire)
+                   for _ in range(args.burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok = sum(1 for st, _ in results if st == 200)
+        shed = sum(1 for st, err in results
+                   if st == 429 and err == "ServeOverload")
+        other = len(results) - ok - shed
+        logging.info("flood of %d: %d served, %d shed typed 429, "
+                     "%d other", args.burst, ok, shed, other)
+        assert ok >= 1, "the fleet served nothing under flood"
+        assert shed >= 1, "queue_max=1 never shed under a %d-burst" \
+            % args.burst
+        assert other == 0, results
+    finally:
+        gw.stop()
+        router.stop()
+    logging.info("deploy seed done: stream parity + typed backpressure")
 
 
 if __name__ == "__main__":

@@ -2,7 +2,7 @@
 invariants at lint time instead of diagnosing their violation at runtime
 (docs/static_analysis.md).
 
-Five rule families, each grounded in a real failure mode of this stack:
+Six rule families, each grounded in a real failure mode of this stack:
 
 * trace safety (``trace-host-sync``/``trace-py-branch``/
   ``trace-shape-branch``) — host syncs and Python control flow inside
@@ -19,6 +19,10 @@ Five rule families, each grounded in a real failure mode of this stack:
   and the chaos-spec grammar must agree with the code.
 * AOT-shape hygiene (``aot-dynamic-shape``) — serving launch shapes
   must come from the bucket/warmup tables, never per-request lengths.
+* async discipline (``async-blocking-call``) — synchronous blocking
+  calls inside gateway coroutines: one blocked ``await``-less
+  ``result()``/``time.sleep`` stalls every connection the event loop
+  carries.
 
 Entry points: ``tools/mxlint.py`` (CLI), ``run_tests.sh --lint`` (CI
 gate), ``bench.py --serve`` preflight (``scope='serving'``), and
@@ -36,6 +40,7 @@ from . import rules_donation   # noqa: F401
 from . import rules_locks      # noqa: F401
 from . import rules_registry   # noqa: F401
 from . import rules_aot        # noqa: F401
+from . import rules_async      # noqa: F401
 
 __all__ = ["Finding", "Rule", "Result", "run", "all_rules", "register",
            "rule_ids", "DEFAULT_TARGETS", "SERVING_PATHS"]

@@ -1,0 +1,137 @@
+"""Async discipline: no synchronous blocking calls inside ``async def``.
+
+The serving gateway runs ONE asyncio event loop for every connection;
+a single synchronous blocking call inside any coroutine stalls every
+stream the gateway is carrying (and the autoscaler's health probes with
+them).  The failure is silent in tests — a blocking `result()` still
+returns the right bytes, just one-connection-at-a-time — so it is a
+lint contract, not a runtime assert.
+
+``async-blocking-call`` flags, inside any ``async def`` body:
+
+* ``time.sleep(...)`` — the coroutine form is ``await asyncio.sleep``;
+* ``<x>.result(...)`` — the typed blocking wait on a `ServeRequest` (or
+  a concurrent Future); hand it to a worker thread instead:
+  ``await loop.run_in_executor(None, functools.partial(req.result, t))``
+  (the partial REFERENCES ``result`` without calling it, so the clean
+  idiom stays silent);
+* blocking socket ops (``recv``/``recv_into``/``accept``/``connect``/
+  ``sendall``) — asyncio's reader/writer pair is the non-blocking road;
+* ``<thread>.join(...)`` / ``<event>.wait(...)`` on threading objects
+  when the receiver is a plain name or self-attribute (an
+  ``asyncio.Event``'s ``wait`` is awaited, so an un-awaited ``.wait()``
+  call expression is blocking by construction).
+
+Nested synchronous ``def``s inside a coroutine are exempt: they run
+wherever they are called from (the gateway's ``on_token`` closure runs
+on the scheduler thread, where blocking is that thread's business).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, Finding, register, dotted
+
+# attribute calls that block the calling thread by contract
+_BLOCKING_ATTRS = {
+    "result": "a blocking typed wait; use "
+              "loop.run_in_executor(None, functools.partial(...))",
+    "recv": "a blocking socket read; use the asyncio StreamReader",
+    "recv_into": "a blocking socket read; use the asyncio StreamReader",
+    "accept": "a blocking socket accept; use asyncio.start_server",
+    "connect": "a blocking socket connect; use asyncio.open_connection",
+    "sendall": "a blocking socket write; use StreamWriter.write + drain",
+    "join": "a blocking thread join; hand it to run_in_executor",
+}
+# Event.wait()-style calls: blocking only when the call is a STATEMENT
+# (an awaited asyncio.Event.wait() sits under an Await node instead)
+_WAIT_ATTRS = {"wait"}
+
+# calls whose ARGUMENTS are coroutines the loop will drive — a `.wait()`
+# handed to `await asyncio.wait_for(ev.wait(), t)` is the non-blocking
+# idiom, not a blocking call
+_AWAITABLE_WRAPPERS = {"wait_for", "shield", "gather", "ensure_future",
+                       "create_task", "wait", "timeout"}
+
+
+def _async_bodies(tree):
+    """Every ``async def`` in the file, nested ones included."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def _own_calls(fn):
+    """Call nodes belonging to ``fn``'s own coroutine body — nested
+    synchronous functions/lambdas execute elsewhere and are skipped
+    (nested async defs are visited by `_async_bodies` on their own)."""
+    out = []
+
+    def walk(node, awaited):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Await):
+                walk(child, True)
+                continue
+            if isinstance(child, ast.Call):
+                out.append((child, awaited))
+                name = child.func.attr \
+                    if isinstance(child.func, ast.Attribute) \
+                    else (child.func.id
+                          if isinstance(child.func, ast.Name) else None)
+                # inside an awaited wrapper, argument calls produce the
+                # coroutines the loop drives — they inherit awaited-ness
+                walk(child, awaited and name in _AWAITABLE_WRAPPERS)
+                continue
+            walk(child, False)
+
+    walk(fn, False)
+    return out
+
+
+@register
+class AsyncBlockingCallRule(Rule):
+    id = "async-blocking-call"
+    serving = True
+
+    def check_file(self, ctx, project):
+        findings = []
+        for fn in _async_bodies(ctx.tree):
+            for call, awaited in _own_calls(fn):
+                hit = self._blocking(call, awaited)
+                if hit:
+                    findings.append(Finding(
+                        self.id, ctx.relpath, call.lineno,
+                        call.col_offset,
+                        "'%s' inside 'async def %s' is %s — it stalls "
+                        "the event loop (every connection, not just "
+                        "this one)" % (hit[0], fn.name, hit[1])))
+        return findings
+
+    def _blocking(self, call, awaited):
+        path = dotted(call.func)
+        if path == "time.sleep":
+            return (path, "a synchronous sleep; use 'await "
+                          "asyncio.sleep'")
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = dotted(call.func.value)
+        if attr in _BLOCKING_ATTRS:
+            # asyncio's own cousins are awaited: `await task.result()`
+            # does not exist, but e.g. `await reader.read()` never lands
+            # here (different attr); the awaited check keeps legitimate
+            # awaitable `.connect()`-style APIs (third-party) clean
+            if awaited:
+                return None
+            return ("%s.%s()" % (recv or "…", attr),
+                    _BLOCKING_ATTRS[attr])
+        if attr in _WAIT_ATTRS and not awaited:
+            # `ev.wait()` un-awaited: blocking for threading.Event and a
+            # silent no-op bug for asyncio.Event — flag both
+            return ("%s.%s()" % (recv or "…", attr),
+                    "a blocking (or un-awaited) wait; use 'await "
+                    "event.wait()' on an asyncio.Event")
+        return None

@@ -97,6 +97,24 @@ Spec grammar (``MXNET_CHAOS``, comma-separated clauses)::
                           (`ServeQuantError`) — never a silently wrong
                           token.  No-op unless the engine runs
                           quantized KV blocks (MXNET_SERVE_KV_QUANT)
+    client_disconnect:P   with probability P a gateway HTTP client drops
+                          its connection mid-stream
+                          (MXNET_SERVE_GATEWAY): the gateway must cancel
+                          the in-flight request through the ordinary
+                          `cancel()` path — abandoned work stops burning
+                          decode slots and its blocks release typed,
+                          never leaked
+    slow_consumer:P:MS    with probability P a gateway connection's
+                          consumer stalls MS ms per read (a congested
+                          client): the per-connection send buffer must
+                          absorb it up to its watermark, then cancel
+                          THAT request typed — co-batched rows and the
+                          scheduler never stall behind one slow socket
+    conn_flood:RATE[:TOTAL]  each gateway accept-loop poll injects RATE
+                          synthetic connection attempts (TOTAL cap,
+                          default 256) against the bounded accept queue
+                          — exercises the 429/503 shed taxonomy the way
+                          queue_flood exercises MXNET_SERVE_OVERLOAD
 
 Determinism: draws come from a ``numpy.random.RandomState`` seeded with
 ``MXNET_CHAOS_SEED`` (default 0) mixed with the process role and rank
@@ -126,7 +144,8 @@ __all__ = [
     "serve_decode_slow", "serve_engine_crash", "serve_launch_error",
     "serve_queue_flood", "serve_block_exhaust", "serve_prefix_evict",
     "serve_draft_junk", "serve_spill_fail", "serve_handoff_fail",
-    "serve_restore_slow", "serve_scale_corrupt",
+    "serve_restore_slow", "serve_scale_corrupt", "serve_client_disconnect",
+    "serve_slow_consumer", "serve_conn_flood",
 ]
 
 # distinct from generic python failures so a supervisor (tools/launch.py
@@ -167,6 +186,9 @@ class _Spec:
         self.handoff_fail = 0.0           # probability per handoff transfer
         self.restore_slow = (0.0, 0.0)    # (probability, milliseconds)
         self.scale_corrupt = 0.0          # probability per scheduler step
+        self.client_disconnect = 0.0      # probability per gateway stream
+        self.slow_consumer = (0.0, 0.0)   # (probability, milliseconds)
+        self.conn_flood = None            # (per-poll rate, total cap)
         for clause in filter(None, (c.strip() for c in raw.split(","))):
             parts = clause.split(":")
             kind = parts[0]
@@ -211,6 +233,15 @@ class _Spec:
                                      else 20.0)
             elif kind == "scale_corrupt":
                 self.scale_corrupt = float(parts[1])
+            elif kind == "client_disconnect":
+                self.client_disconnect = float(parts[1])
+            elif kind == "slow_consumer":
+                self.slow_consumer = (float(parts[1]),
+                                      float(parts[2]) if len(parts) > 2
+                                      else 50.0)
+            elif kind == "conn_flood":
+                self.conn_flood = (int(parts[1]),
+                                   int(parts[2]) if len(parts) > 2 else 256)
             else:
                 raise ValueError(
                     "unknown MXNET_CHAOS clause %r (of %r)" % (clause, raw))
@@ -225,6 +256,7 @@ class _Spec:
         self.fused_update_calls = 0
         self.engine_steps = {}            # replica name -> decode steps
         self.flooded = 0                  # synthetic requests injected
+        self.conn_flooded = 0             # synthetic connections injected
         self._clause_rng = {}
         self.lock = threading.Lock()
 
@@ -494,6 +526,53 @@ def serve_scale_corrupt():
         if rng.random_sample() < s.scale_corrupt:
             return float(rng.random_sample())
     return None
+
+
+def serve_client_disconnect():
+    """True when the CURRENT gateway stream should behave as if the
+    client dropped the connection mid-stream (`client_disconnect:P`):
+    the gateway must cancel the in-flight request through the ordinary
+    `cancel()` path, so abandoned work stops burning decode slots and
+    its blocks release typed — never a leak, never a stuck row."""
+    s = spec()
+    if s is None or s.client_disconnect <= 0:
+        return False
+    with s.lock:
+        return bool(s.rng_for("client_disconnect").random_sample()
+                    < s.client_disconnect)
+
+
+def serve_slow_consumer():
+    """Milliseconds the CURRENT gateway connection's consumer should
+    stall per read, or None (`slow_consumer:P:MS`).  The gateway's
+    per-connection send buffer must absorb the stall up to its
+    watermark and then cancel only THAT request typed — one congested
+    socket may never back-pressure co-batched rows or the scheduler."""
+    s = spec()
+    if s is None or s.slow_consumer[0] <= 0:
+        return None
+    p, ms = s.slow_consumer
+    with s.lock:
+        if s.rng_for("slow_consumer").random_sample() < p:
+            return ms
+    return None
+
+
+def serve_conn_flood():
+    """Number of synthetic connection attempts the CURRENT gateway
+    accept-loop poll should inject against the bounded accept queue
+    (0 when the clause is absent or its TOTAL cap is spent) — the
+    connection-layer sibling of `serve_queue_flood`."""
+    s = spec()
+    if s is None or s.conn_flood is None:
+        return 0
+    rate, total = s.conn_flood
+    with s.lock:
+        n = min(rate, total - s.conn_flooded)
+        if n <= 0:
+            return 0
+        s.conn_flooded += n
+    return n
 
 
 def serve_queue_flood():

@@ -77,11 +77,28 @@ package is the production path on top of it (ROADMAP item 1):
   decode inter-token p99 stays flat.  A dead transfer or target falls
   back to the journal's exact-replay road; ``=0`` (default) is the
   colocated fleet bit for bit.
+* `gateway.ServeGateway` (``MXNET_SERVE_GATEWAY``) — stdlib-asyncio
+  HTTP/SSE front door over the router: per-token streaming rides the
+  engine's `on_token` push path (ttfb ≈ engine ttft), HTTP sessions map
+  onto session affinity, and backpressure is end-to-end — a bounded
+  connection budget sheds with typed status codes from the error
+  taxonomy, per-connection send buffers cancel slow consumers at a
+  watermark (releasing their blocks), and client disconnects cancel
+  the in-flight request.  ``=0`` (default) builds nothing.
+* `autoscale.AutoScaler` (``MXNET_SERVE_AUTOSCALE``) — gauge-driven
+  elasticity over the same fleet primitives: sustained per-replica
+  queue depth (or shed activity) past a hysteresis window grows the
+  fleet off the SHARED frozen `AotCache` (asserted compile-free);
+  sustained idleness drains a replica, migrating stragglers AND
+  session histories to survivors.  Under ``MXNET_SERVE_DISAGG`` the
+  prefill/decode pools scale independently.
 * `errors` — the typed failure taxonomy every request resolves to.
 
 See docs/serving.md.
 """
+from .autoscale import AutoScaler, autoscale_enabled
 from .decode import TransformerKVModel
+from .gateway import ServeGateway, gateway_enabled, http_status
 from .engine import ServeRequest, ServingEngine, ReplicaRouter
 from .handoff import HandoffTicket, disagg_enabled
 from .journal import RequestJournal, journal_enabled
@@ -97,6 +114,8 @@ from .errors import (ServeError, ServeTimeout, ServeOverload,
 
 __all__ = ["TransformerKVModel", "ServeRequest", "ServingEngine",
            "ReplicaRouter", "HandoffTicket", "disagg_enabled",
+           "ServeGateway", "gateway_enabled", "http_status",
+           "AutoScaler", "autoscale_enabled",
            "RequestJournal", "journal_enabled",
            "BlockAllocator", "PrefixCache", "TRASH_BLOCK", "HostBlockTier",
            "pack_block_run", "sample_tokens", "Drafter", "NgramDrafter", "ModelDrafter",

@@ -1208,19 +1208,23 @@ class ServingEngine:
                  "kv": None if self._kv_quant is None
                  else self._kv_quant.name}}
 
-    def respawn(self):
+    def respawn(self, name=None):
         """A replacement engine for this (dead) replica: same device,
         geometry, name, and admission config; params SHARED (already on
         the device, no host round-trip); the compiled AOT set SHARED, so
         the replacement's `warmup()` re-seeds the watchdog but compiles
-        nothing new; fresh K/V cache and slot state."""
+        nothing new; fresh K/V cache and slot state.  ``name`` overrides
+        the replica name — the autoscaler's scale-up templates a NEW
+        replica off a live one, which must not collide with it in the
+        per-replica gauges or the chaos step counters."""
         return ServingEngine(
             self.model, self._params, ctx=self._device,
             max_batch=self.max_batch,
             decode_buckets=list(self.decode_buckets),
             prefill_buckets=list(self.prefill_buckets),
             max_new_tokens=self.max_new_default, eos_id=self.eos_id,
-            name=self.name, queue_max=self._queue_max,
+            name=self.name if name is None else name,
+            queue_max=self._queue_max,
             overload=self._overload,
             deadline_ms=self._deadline_ms_default, aot=self._aot,
             paged=self._paged, block_size=self.block_size,
@@ -4129,8 +4133,12 @@ class ReplicaRouter:
         enqueues on the least-loaded survivor, and the survivor's
         ordinary resume admission chunk-prefills the replayed context
         and re-enters decode at the same position with the same
-        request-keyed RNG.  Returns False when nothing can take it (no
-        journal, no paged survivor, or every survivor shed)."""
+        request-keyed RNG.  Returns the engine that took it (truthy; a
+        request already resolved in the window returns True), or False
+        when nothing can take it (no journal, no paged survivor, or
+        every survivor shed) — callers that only branch keep working,
+        and `drain` uses the target to move session entries WITH their
+        live turn."""
         if self.journal is None:
             return False  # PR-11: in-flight context dies with the replica
         if req.done:
@@ -4156,7 +4164,7 @@ class ReplicaRouter:
                 "serve_migrate", request=req.id, target=eng.name,
                 pos=0 if state is None else state[2],
                 generated=len(req.tokens))
-            return True
+            return eng
         req._migrated = False
         req._resume = None if state is not None else req._resume
         return False
@@ -4406,11 +4414,15 @@ class ReplicaRouter:
         err = ServeEngineDead(
             "ServingEngine %s: drained for restart with no live replica "
             "to migrate to" % eng.name)
+        moved = {}   # id(req) -> engine the straggler migrated to
         for req in stragglers:
             if req.done:
                 continue
             try:
-                if self._migrate(req, exclude=eng):
+                target = self._migrate(req, exclude=eng)
+                if target:
+                    if isinstance(target, ServingEngine):
+                        moved[id(req)] = target
                     continue
                 # no journal (or no paged survivor): a straggler with no
                 # generated tokens needs no replay — the PR-8 redispatch
@@ -4435,16 +4447,169 @@ class ReplicaRouter:
                 telemetry.record_event("serve_respawn_failed",
                                        replica=eng.name,
                                        error=str(ex)[:200])
-                return None
-            with self._lock:
-                try:
-                    self.engines[self.engines.index(eng)] = fresh
-                except ValueError:  # raced with a concurrent swap
-                    fresh.stop()
-                    return None
-            if self._monitor is not None and self._monitor.is_alive():
-                fresh.start()
+                fresh = None
+            if fresh is not None:
+                with self._lock:
+                    try:
+                        self.engines[self.engines.index(eng)] = fresh
+                    except ValueError:  # raced with a concurrent swap
+                        fresh.stop()
+                        fresh = None
+                if fresh is not None and self._monitor is not None \
+                        and self._monitor.is_alive():
+                    fresh.start()
+        # session histories move WITH the drain (PR-13 affinity made the
+        # engines holders-only: an entry left on the stopped engine would
+        # orphan the conversation — the follow-up turn would silently
+        # restart it on a stranger).  Runs after the swap so a live
+        # straggler's entry follows ITS new engine and everything else
+        # lands on the replacement (or the least-loaded survivor).
+        self._migrate_sessions(eng, moved, dest=fresh)
         return fresh
+
+    def _migrate_sessions(self, eng, moved, dest=None):
+        """Move ``eng``'s session store to the rest of the fleet (the
+        engine is stopped: its scheduler no longer mutates the store).
+        A session whose live turn migrated as a straggler follows that
+        turn's engine — `_session_store` advances the history there at
+        retire, and the unresolved-turn guard keeps protecting it.
+        Every other entry (resolved turn, claim, first-turn record)
+        lands on ``dest`` (the drain replacement) or the least-loaded
+        live survivor.  Returns how many entries moved."""
+        with eng._slock:
+            sessions = list(eng._sessions.items())
+            eng._sessions.clear()
+        if not sessions:
+            return 0
+        live = self._live_engines(exclude=eng)
+        n = 0
+        for key, (hist, ent) in sessions:
+            if isinstance(ent, _SessionClaim):
+                # an un-admitted claim: the previous resolved turn is
+                # the state the conversation retries from
+                ent = ent.prev
+            target = None
+            if isinstance(ent, ServeRequest) and not ent.done:
+                target = moved.get(id(ent))
+            if target is None:
+                target = dest
+            if target is None and live:
+                target = min(live, key=lambda e: e.depth())
+            if target is None:
+                continue   # nowhere to go: the history dies with eng
+            with target._slock:
+                if key in target._sessions:
+                    continue   # the target's own copy wins
+                target._sessions[key] = (hist, ent)
+                target._sessions.move_to_end(key)
+                target._trim_sessions_locked()
+            n += 1
+        if n:
+            telemetry.inc("serve.sessions_migrated", n)
+            telemetry.record_event("serve_sessions_migrated",
+                                   replica=eng.name, n=n)
+        return n
+
+    def _next_name(self):
+        """A fresh replicaN name (caller holds ``_lock``)."""
+        names = {e.name for e in self.engines}
+        idx = len(self.engines)
+        while "replica%d" % idx in names:
+            idx += 1
+        return "replica%d" % idx
+
+    def add_replica(self, role=None, name=None, template=None):
+        """Grow the fleet by one replica — the autoscaler's scale-up
+        primitive.  The new engine is templated off a live replica:
+        params SHARED (already device-resident) and the frozen AotCache
+        SHARED, so its warmup is pure cache hits.  That zero-compile
+        property is ASSERTED — a scale-up that would compile raises
+        instead of stalling steady state, the same contract respawn
+        holds.  Under MXNET_SERVE_DISAGG ``role`` picks the pool
+        (default decode).  Returns the started engine."""
+        if self._stopped:
+            raise MXNetError("ReplicaRouter: router stopped")
+        with self._lock:
+            if template is None:
+                for e in self.engines:
+                    if e._dead is None and not e._stopped.is_set() \
+                            and not e._draining:
+                        template = e
+                        break
+            if template is None:
+                raise MXNetError("ReplicaRouter: no live replica to "
+                                 "template a scale-up from")
+            if name is None:
+                name = self._next_name()
+        if self._disagg and role is None:
+            role = "decode"
+        fresh = template.respawn(name=name)
+        self._wire(fresh, role if self._disagg else None)
+        before = fresh._aot.compiles
+        fresh.warmup()
+        compiled = fresh._aot.compiles - before
+        if compiled:
+            telemetry.record_event("serve_respawn_compiled",
+                                   replica=name, n=compiled)
+            fresh.stop()
+            raise MXNetError(
+                "ReplicaRouter.add_replica: scale-up warmup compiled %d "
+                "new program(s) — growth off the shared frozen AotCache "
+                "must be compile-free" % compiled)
+        with self._lock:
+            self.engines.append(fresh)
+            if self._disagg and role == "prefill":
+                self._n_prefill += 1
+            fleet = len(self.engines)
+        fresh.start()
+        telemetry.set_gauge("serve.replicas", fleet)
+        return fresh
+
+    def remove_replica(self, replica=None, deadline_ms=None, role=None):
+        """Shrink the fleet by one replica — the autoscaler's scale-down
+        primitive: graceful `drain` (admission closes typed, in-flight
+        work serves out, stragglers AND session histories migrate to
+        survivors), then the stopped engine leaves the fleet.  With no
+        ``replica`` given the least-loaded live one (of ``role``, when
+        set) is chosen.  Refuses to remove the last replica — or the
+        last of its role under MXNET_SERVE_DISAGG.  Returns the removed
+        engine's name."""
+        with self._lock:
+            engines = list(self.engines)
+        if replica is None:
+            pool = [e for e in engines if e._dead is None
+                    and not e._stopped.is_set() and not e._draining]
+            if role is not None:
+                pool = [e for e in pool if e.role == role]
+            if not pool:
+                raise MXNetError(
+                    "ReplicaRouter: no removable replica%s"
+                    % (" with role %r" % role if role else ""))
+            eng = min(pool, key=lambda e: e.depth())
+        else:
+            eng = self._resolve_engine(replica)
+        with self._lock:
+            if self._disagg:
+                peers = [e for e in self.engines
+                         if e is not eng and e.role == eng.role]
+            else:
+                peers = [e for e in self.engines if e is not eng]
+            if not peers:
+                raise MXNetError(
+                    "ReplicaRouter: refusing to remove %s — it is the "
+                    "last %sreplica" % (eng.name, "%s " % eng.role
+                                        if eng.role else ""))
+        self.drain(eng, deadline_ms=deadline_ms, respawn=False)
+        with self._lock:
+            try:
+                self.engines.remove(eng)
+            except ValueError:
+                pass   # raced with a concurrent removal
+            if self._disagg and eng.role == "prefill":
+                self._n_prefill = max(1, self._n_prefill - 1)
+            fleet = len(self.engines)
+        telemetry.set_gauge("serve.replicas", fleet)
+        return eng.name
 
     def start(self):
         self._stopped = False

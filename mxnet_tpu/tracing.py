@@ -72,6 +72,7 @@ PHASES = (
     "megastep",       # replica: one m-step launch->fetch window
     "host_sweep",     # replica: the overlap-window host work
     "spec_round",     # replica: one draft->verify->accept round
+    "gateway_send",   # leaf: gateway submit -> last SSE byte flushed
 )
 
 # Interval phases folded into the serve.attr.* SLO attribution at retire.

@@ -120,6 +120,19 @@ elif [ "$1" = "--serve-chaos-smoke" ]; then
     T1=""
     set -- tests/test_serve_chaos.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--gateway-smoke" ]; then
+    # fast gateway/autoscaler smoke: HTTP/SSE stream parity with the
+    # engine oracle, the status-code taxonomy on the wire, the
+    # backpressure failure matrix (disconnect frees blocks, slow
+    # consumer cancels typed, conn_flood sheds), autoscaler hysteresis
+    # on synthetic gauge streams, compile-free scale-up and zero-failed
+    # scale-down, session survival across a holder drain, and the
+    # MXNET_SERVE_GATEWAY=0 kill-switch (docs/serving.md "Gateway &
+    # autoscaling")
+    shift
+    T1=""
+    set -- tests/test_serve_gateway.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--trace-smoke" ]; then
     # fast request-tracing smoke: span-tree continuity across handoff /
     # migration / preemption-replay (one trace id end to end, no orphan

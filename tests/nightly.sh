@@ -600,4 +600,59 @@ PY
 # -- tracing smoke: span continuity / flight recorder / kill-switch unit
 # coverage (run_tests.sh --trace-smoke)
 ./run_tests.sh --trace-smoke
+
+# -- elastic-soak gate (docs/serving.md "Gateway & autoscaling") ----------
+# the HTTP/SSE gateway fronting an autoscaled fleet through a Poisson
+# soak with a mid-run load step: the fleet must scale UP during the
+# burst and back DOWN after (every scale-up warming compile-free from
+# the shared AOT cache), zero failed requests across the resize, ttfb
+# at the gateway within 10% of engine ttft (joined per-trace from the
+# span stream), bounded gateway memory (open_conns returns to 0), the
+# serve.gateway.* / serve.scale_ups / serve.scale_downs counters
+# consistent with the request log, and all three gateway chaos clauses
+# (client_disconnect, slow_consumer, conn_flood) green alone AND
+# composed with engine_crash under the autoscaler; artifact lands in
+# bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python bench.py --serve --elastic | tee /tmp/nightly_serve_elastic.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_serve_elastic.log").read().strip().splitlines()[-1])
+g, soak = rec["gates"], rec["soak"]
+assert g["zero_failed"], \
+    "elastic gate: %s failed / %s hung requests" % (soak["failed"],
+                                                    soak["hung"])
+assert g["zero_steady_state_compiles"], \
+    "elastic gate: %s compiles after warmup (scale-up must be " \
+    "compile-free off the shared AOT cache)" % soak["steady_state_compiles"]
+assert g["scaled_up_and_down"], \
+    "elastic gate: fleet never grew AND shrank back (fleet %s, " \
+    "scale_ups %s, scale_downs %s)" % (soak["fleet"], soak["scale_ups"],
+                                       soak["scale_downs"])
+assert g["ttfb_within_10pct_of_ttft"], \
+    "elastic gate: gateway ttfb %s ms vs engine ttft %s ms" % (
+        soak["ttfb_ms_mean"], soak["ttft_ms_mean"])
+assert g["gateway_memory_bounded"], \
+    "elastic gate: open_conns peaked at %s (conn_max %s)" % (
+        soak["open_conns_peak"], soak["conn_max"])
+assert g["counters_consistent"], \
+    "elastic gate: serve.gateway.* counters disagree with the request log"
+assert g["chaos_legs_green"], \
+    "elastic gate: gateway chaos legs failed: %s" % [
+        leg for leg in rec["chaos_legs"] if not leg["green"]]
+assert rec["all_gates_passed"]
+print("elastic gate passed: fleet 1->%s->%s, %s ups / %s downs, "
+      "ttfb %s vs ttft %s ms, %s/%s served, %s tok/s" % (
+          soak["fleet"]["peak"], soak["fleet"]["end"],
+          soak["scale_ups"], soak["scale_downs"],
+          soak["ttfb_ms_mean"], soak["ttft_ms_mean"],
+          soak["requests"] - soak["failed"], soak["requests"],
+          rec["value"]))
+PY
+
+# -- gateway smoke: HTTP/SSE parity, backpressure failure matrix,
+# autoscaler hysteresis, session-drain migration, kill-switch unit
+# coverage (run_tests.sh --gateway-smoke)
+./run_tests.sh --gateway-smoke
 echo "nightly: all gates passed"

@@ -127,8 +127,14 @@ def test_moe_lm_example():
 
 
 def test_deploy_predictor_example():
-    out = run_example("deploy_predictor.py", "--num-epoch", "4")
-    assert "exported artifact" in out
+    """Gateway deployment seed: JSON/SSE parity + typed 429 backpressure
+    over real HTTP against a 2-replica fleet (docs/serving.md
+    "Gateway & autoscaling")."""
+    out = run_example("deploy_predictor.py", "--max-new", "8",
+                      "--burst", "12")
+    assert "streamed tokens match the JSON completion" in out
+    assert "shed typed 429" in out
+    assert "deploy seed done: stream parity + typed backpressure" in out
 
 
 def test_speech_demo_example():

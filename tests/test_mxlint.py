@@ -447,6 +447,59 @@ def test_disable_file_suppresses_whole_file(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# async discipline
+# ---------------------------------------------------------------------------
+
+def test_async_blocking_call_detected(tmp_path):
+    res = lint(tmp_path, {"gw.py": """
+        import time
+
+        async def pump(req, sock, ev):
+            time.sleep(0.1)            # sync sleep on the event loop
+            toks = req.result(30)      # blocking typed wait
+            data = sock.recv(4096)     # blocking socket read
+            ev.wait()                  # un-awaited wait
+            return toks, data
+    """}, rules=["async-blocking-call"])
+    assert rule_ids(res) == ["async-blocking-call"] * 4
+
+
+def test_async_blocking_call_suppressed_and_clean(tmp_path):
+    res = lint(tmp_path, {"gw.py": """
+        import asyncio
+        import functools
+        import time
+
+        async def pump(req, loop, ev, reader):
+            await asyncio.sleep(0.1)             # the coroutine sleep
+            toks = await loop.run_in_executor(   # executor wait idiom:
+                None, functools.partial(req.result, 30))  # a reference,
+            data = await reader.read(4096)       # not a call
+            await ev.wait()                      # awaited asyncio.Event
+            await asyncio.wait_for(ev.wait(), 1)  # awaited via wrapper
+            time.sleep(0)  # MXLINT: disable=async-blocking-call -- fixture
+            return toks, data
+
+        def on_token(tok):
+            time.sleep(0.1)   # sync helper: runs on the caller's thread
+    """}, rules=["async-blocking-call"])
+    assert res.findings == []
+    assert [r for _, r in res.suppressed] == ["fixture"]
+
+
+def test_async_nested_sync_def_exempt(tmp_path):
+    res = lint(tmp_path, {"gw.py": """
+        import time
+
+        async def handler(router, prompt):
+            def cb(tok):               # executes on the scheduler thread
+                time.sleep(0.01)
+            return router.submit(prompt, on_token=cb)
+    """}, rules=["async-blocking-call"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
 # CLI + self-check
 # ---------------------------------------------------------------------------
 
@@ -467,7 +520,8 @@ def test_cli_json_exit_codes_and_scope():
     assert listed.returncode == 0
     ids = set(listed.stdout.split())
     assert {"trace-host-sync", "donate-reuse", "lock-unguarded",
-            "env-undocumented", "aot-dynamic-shape"} <= ids
+            "env-undocumented", "aot-dynamic-shape",
+            "async-blocking-call"} <= ids
 
 
 def test_subtree_run_skips_reverse_drift_checks():

@@ -1,0 +1,672 @@
+"""HTTP/SSE gateway + gauge-driven autoscaling (ISSUE-19).
+
+Contracts under test:
+
+1. Kill-switch: `MXNET_SERVE_GATEWAY=0` (default) builds NOTHING —
+   constructing a `ServeGateway` raises typed; `MXNET_SERVE_AUTOSCALE`
+   likewise reports off.
+2. HTTP surface: /healthz, malformed/unknown-route/bad-method answers,
+   non-streaming JSON and per-token SSE streaming both emit the
+   engine-oracle tokens; HTTP ``"session"`` rides the engines' session
+   affinity with suffix-only follow-ups.
+3. Status taxonomy: typed serve errors map onto the documented codes
+   (`ServeOverload` 429, `ServeBlocksExhausted` 413, deadline/timeout
+   504, `ServeCancelled` 499, `ServeEngineDead` 503) and an overloaded
+   fleet answers 429 on the wire.
+4. End-to-end backpressure failure matrix (the tentpole):
+   * client disconnect mid-stream cancels the in-flight request and
+     frees its blocks (leak-asserted) — both the chaos clause
+     `client_disconnect:P` and a REAL socket hangup;
+   * a slow consumer (`slow_consumer:P:MS`) trips the send-buffer
+     watermark, cancels typed (SSE error, 499) WITHOUT stalling
+     co-batched rows or the scheduler;
+   * `conn_flood:RATE[:TOTAL]` sheds past `conn_max` with 503
+     `conn_limit` and recovers once the flood spends its budget.
+5. Autoscaler hysteresis on synthetic gauge streams (`decide` is pure):
+   sustained pressure fires exactly once per window+cooldown, a lone
+   spike never fires, an alternating flap stream never fires, sustained
+   idleness steps down to the min clamp; a shed-counter delta forces
+   the hot window.
+6. Elasticity on a real fleet: `add_replica` grows off the SHARED
+   frozen AotCache (compile-free, asserted), `remove_replica` drains
+   mid-Poisson with ZERO failed requests, and session histories
+   survive a holder drain (the ISSUE-19 regression).
+"""
+import json
+import http.client
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import chaos, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (AutoScaler, ReplicaRouter, ServeGateway,
+                               ServingEngine, TransformerKVModel,
+                               autoscale_enabled, gateway_enabled,
+                               http_status,
+                               ServeBlocksExhausted, ServeCancelled,
+                               ServeDeadlineExceeded, ServeEngineDead,
+                               ServeError, ServeOverload, ServeTimeout)
+
+V, S, L, H, E = 61, 32, 2, 2, 32
+
+
+@pytest.fixture
+def model_and_params():
+    model = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E)
+    return model, model.init_params(np.random.RandomState(7))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXNET_CHAOS", "MXNET_SERVE_GATEWAY",
+                "MXNET_SERVE_GATEWAY_PORT", "MXNET_SERVE_GATEWAY_CONN_MAX",
+                "MXNET_SERVE_GATEWAY_SEND_BUF", "MXNET_SERVE_AUTOSCALE",
+                "MXNET_SERVE_AUTOSCALE_MIN", "MXNET_SERVE_AUTOSCALE_MAX",
+                "MXNET_SERVE_HYSTERESIS_S"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "0")
+    telemetry.reset()
+    chaos.reset()
+    yield
+    telemetry.reset()
+    chaos.reset()
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("sampling", False)
+    return ServingEngine(model, params, **kw)
+
+
+def _fleet(model, params, n=2, **kw):
+    engines = []
+    for i in range(n):
+        eng = _engine(model, params, **kw)
+        eng.name = "replica%d" % i
+        eng._gauge = "serve.replica%d." % i
+        engines.append(eng)
+    router = ReplicaRouter(engines, respawn=False)
+    router.warmup()
+    return router
+
+
+def _oracle(model, params, prompt, max_new=6, **kw):
+    eng = _engine(model, params, max_batch=1)
+    req = eng.submit(prompt, max_new_tokens=max_new, **kw)
+    eng.run_until_idle(timeout=300)
+    return req.result(1)
+
+
+def _chaos(monkeypatch, spec):
+    monkeypatch.setenv("MXNET_CHAOS", spec)
+    chaos.reset()
+
+
+# -- HTTP client helpers ----------------------------------------------------
+
+def _http(port, method, path, obj=None, timeout=60):
+    """One request/response over http.client; (status, parsed json)."""
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        body = None if obj is None else json.dumps(obj)
+        c.request(method, path, body,
+                  {} if body is None else {"Content-Type":
+                                           "application/json"})
+        r = c.getresponse()
+        raw = r.read()
+        return r.status, (json.loads(raw) if raw else None)
+    finally:
+        c.close()
+
+
+def _sse(port, obj, timeout=60, hangup_after=None):
+    """Stream POST /v1/generate over a raw socket; returns
+    (status, frames, done, error) where frames are the parsed
+    ``data:`` token dicts, ``done`` says a ``[DONE]`` arrived and
+    ``error`` is the SSE error payload (if any).  ``hangup_after=k``
+    closes the socket abruptly after k token frames (the real
+    client-disconnect leg)."""
+    body = json.dumps(obj).encode()
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+                  b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        buf = b""
+        frames, done, error, status = [], False, None, None
+        while True:
+            # parse incrementally so hangup_after can fire mid-stream
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                line = line.strip()
+                if status is None and line.startswith(b"HTTP/1.1"):
+                    status = int(line.split()[1])
+                elif line == b"data: [DONE]":
+                    done = True
+                elif line.startswith(b"data: "):
+                    payload = json.loads(line[6:])
+                    if "token" in payload:
+                        frames.append(payload)
+                    else:
+                        error = payload
+                if hangup_after is not None and \
+                        len(frames) >= hangup_after:
+                    s.shutdown(socket.SHUT_RDWR)
+                    return status, frames, done, error
+            d = s.recv(4096)
+            if not d:
+                return status, frames, done, error
+            buf += d
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# 1. kill-switches
+# ---------------------------------------------------------------------------
+
+def test_gateway_kill_switch_builds_nothing():
+    assert not gateway_enabled()
+    assert not autoscale_enabled()
+    with pytest.raises(MXNetError, match="MXNET_SERVE_GATEWAY"):
+        ServeGateway(None)
+
+
+def test_gateway_enabled_env(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    assert gateway_enabled()
+    monkeypatch.setenv("MXNET_SERVE_AUTOSCALE", "1")
+    assert autoscale_enabled()
+
+
+# ---------------------------------------------------------------------------
+# 2/3. HTTP surface + status taxonomy
+# ---------------------------------------------------------------------------
+
+def test_http_status_taxonomy():
+    assert http_status(ServeOverload("x")) == 429
+    assert http_status(ServeBlocksExhausted("x")) == 413
+    assert http_status(ServeDeadlineExceeded("x")) == 504
+    assert http_status(ServeTimeout("x")) == 504
+    assert http_status(ServeCancelled("x")) == 499
+    assert http_status(ServeEngineDead("x")) == 503
+    assert http_status(ServeError("x")) == 500
+    assert http_status(ValueError("x")) == 500
+
+
+def test_gateway_http_roundtrip_and_stream_parity(model_and_params,
+                                                  monkeypatch):
+    """healthz, error routes, and the two generate modes — the SSE
+    frames and the JSON body both carry the engine-oracle tokens, and
+    streamed ttfb is observed."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    oracle = _oracle(model, params, [3, 4, 5])
+    router = _fleet(model, params, n=1)
+    router.start()
+    gw = ServeGateway(router).start()
+    try:
+        code, health = _http(gw.port, "GET", "/healthz")
+        assert code == 200 and health["ok"] and health["replicas"] == 1
+        assert _http(gw.port, "GET", "/nope")[0] == 404
+        assert _http(gw.port, "GET", "/v1/generate")[0] == 405
+        code, err = _http(gw.port, "POST", "/v1/generate", {"prompt": []})
+        assert code == 400 and err["error"] == "malformed"
+        code, out = _http(gw.port, "POST", "/v1/generate",
+                          {"prompt": [3, 4, 5], "stream": False})
+        assert code == 200 and out["tokens"] == oracle
+        assert out["ttft_ms"] is not None
+        status, frames, done, error = _sse(gw.port, {"prompt": [3, 4, 5]})
+        assert status == 200 and done and error is None
+        assert [f["token"] for f in frames] == oracle
+        assert [f["index"] for f in frames] == list(range(len(oracle)))
+    finally:
+        gw.stop()
+        router.stop()
+    reg = telemetry.registry()
+    assert reg.counter("serve.gateway.accepted").value == 2
+    assert reg.counter("serve.gateway.errors").value == 1  # the 400
+    assert reg._hists.get("serve.gateway.ttfb_ms")  # ttfb observed
+
+
+def test_gateway_overload_answers_429(model_and_params, monkeypatch):
+    """A full queue resolves on the wire as the taxonomy says: 429."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    router = _fleet(model, params, n=1, queue_max=1, overload="shed")
+    # engines NOT started: the filler parks in the queue and every
+    # further admission sheds
+    filler = router.submit([1, 2], max_new_tokens=2)
+    gw = ServeGateway(router).start()
+    try:
+        code, err = _http(gw.port, "POST", "/v1/generate",
+                          {"prompt": [3, 4], "stream": False})
+        assert code == 429 and err["error"] == "ServeOverload"
+    finally:
+        gw.stop()
+        router.stop()
+    assert not filler.done or filler.error is not None
+
+
+def test_gateway_session_rides_affinity(model_and_params, monkeypatch):
+    """HTTP ``"session"`` lands follow-up turns on the holder and emits
+    full-history parity tokens."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    router = _fleet(model, params, n=2, block_size=4, n_blocks=17,
+                    tier=True, host_blocks=16, max_new_tokens=8)
+    router.start()
+    gw = ServeGateway(router).start()
+    try:
+        code, out1 = _http(gw.port, "POST", "/v1/generate",
+                           {"prompt": [1, 2, 3, 4, 5], "session": "chat",
+                            "max_new_tokens": 3, "stream": False})
+        assert code == 200
+        holders = [e for e in router.engines if e.has_session("chat")]
+        assert len(holders) == 1
+        code, out2 = _http(gw.port, "POST", "/v1/generate",
+                           {"prompt": [6, 7], "session": "chat",
+                            "max_new_tokens": 3, "stream": False})
+        assert code == 200
+        assert holders[0].stats["session_hits"] == 1
+    finally:
+        gw.stop()
+        router.stop()
+    hist = [1, 2, 3, 4, 5] + out1["tokens"] + [6, 7]
+    assert out2["tokens"] == _oracle(model, params, hist, max_new=3)
+
+
+# ---------------------------------------------------------------------------
+# 4. the backpressure failure matrix
+# ---------------------------------------------------------------------------
+
+def test_chaos_client_disconnect_frees_blocks(model_and_params,
+                                              monkeypatch):
+    """`client_disconnect:1` hangs up after the first frame: the
+    in-flight request cancels through the ordinary path and its blocks
+    release — zero leaks, engine back to idle, co-batched row
+    unharmed."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    router = _fleet(model, params, n=1, max_new_tokens=16)
+    router.start()
+    gw = ServeGateway(router).start()
+    _chaos(monkeypatch, "client_disconnect:1")
+    try:
+        bystander = router.submit([9, 8, 7], max_new_tokens=16)
+        status, frames, done, _ = _sse(gw.port, {"prompt": [3, 4, 5]})
+        assert status == 200 and not done     # stream dropped mid-flight
+        assert len(frames) >= 1
+        assert bystander.result(timeout=120) is not None
+        router.run_until_idle(timeout=120)
+    finally:
+        gw.stop()
+        router.stop()
+    eng = router.engines[0]
+    assert eng.leaked_blocks() == 0
+    reg = telemetry.registry()
+    assert reg.counter("serve.gateway.disconnects").value >= 1
+    kinds = [e.get("reason") for e in
+             telemetry.events("serve_gateway_cancel")]
+    assert "client_disconnect" in kinds
+
+
+def test_real_socket_hangup_cancels_inflight(model_and_params,
+                                             monkeypatch):
+    """No chaos: a REAL client closing its socket mid-stream is seen by
+    the EOF watcher, the request cancels, blocks release."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    # decode_slow keeps the generation alive long enough that the
+    # hangup lands mid-flight deterministically
+    router = _fleet(model, params, n=1, max_new_tokens=24)
+    router.start()
+    gw = ServeGateway(router).start()
+    _chaos(monkeypatch, "decode_slow:1:100")
+    try:
+        status, frames, done, _ = _sse(gw.port, {"prompt": [3, 4, 5]},
+                                       hangup_after=1)
+        assert status == 200 and not done and len(frames) == 1
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if telemetry.registry().counter(
+                    "serve.gateway.disconnects").value >= 1:
+                break
+            time.sleep(0.05)
+        chaos.reset()
+        monkeypatch.delenv("MXNET_CHAOS", raising=False)
+        router.run_until_idle(timeout=120)
+    finally:
+        gw.stop()
+        router.stop()
+    assert telemetry.registry().counter(
+        "serve.gateway.disconnects").value >= 1
+    assert router.engines[0].leaked_blocks() == 0
+
+
+def test_slow_consumer_cancels_typed_without_stalling(model_and_params,
+                                                      monkeypatch):
+    """`slow_consumer:1:150` + a tiny send buffer: the watermark trips,
+    THAT request cancels typed (SSE error, 499), and a co-batched row
+    submitted directly finishes untouched — the scheduler never
+    stalls on the slow socket."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    router = _fleet(model, params, n=1, max_new_tokens=8)
+    router.start()
+    gw = ServeGateway(router, send_buf=48).start()
+    _chaos(monkeypatch, "slow_consumer:1:150")
+    try:
+        bystander = router.submit([9, 8, 7], max_new_tokens=8)
+        t0 = time.time()
+        status, frames, done, error = _sse(gw.port, {"prompt": [3, 4, 5]})
+        assert status == 200 and not done
+        assert error is not None and error["status"] == 499
+        assert error["error"] == "SlowConsumer"
+        assert bystander.result(timeout=120) is not None
+        assert time.time() - t0 < 60
+        router.run_until_idle(timeout=120)
+    finally:
+        gw.stop()
+        router.stop()
+    assert router.engines[0].leaked_blocks() == 0
+    reg = telemetry.registry()
+    assert reg.counter("serve.gateway.slow_consumer_cancels").value >= 1
+    reasons = [e.get("reason") for e in
+               telemetry.events("serve_gateway_cancel")]
+    assert "slow_consumer" in reasons
+
+
+def test_conn_flood_sheds_then_recovers(model_and_params, monkeypatch):
+    """`conn_flood:8:8` with conn_max=4: the flooded poll sheds the
+    real connection 503/conn_limit; once the flood budget is spent the
+    next request lands normally."""
+    model, params = model_and_params
+    monkeypatch.setenv("MXNET_SERVE_GATEWAY", "1")
+    router = _fleet(model, params, n=1)
+    gw = ServeGateway(router, conn_max=4).start()
+    _chaos(monkeypatch, "conn_flood:8:8")
+    try:
+        code, err = _http(gw.port, "GET", "/healthz")
+        assert code == 503 and err["error"] == "conn_limit"
+        code, _ = _http(gw.port, "GET", "/healthz")
+        assert code == 200                     # flood budget exhausted
+    finally:
+        gw.stop()
+        router.stop()
+    assert telemetry.registry().counter(
+        "serve.gateway.conn_shed").value == 1
+
+
+# ---------------------------------------------------------------------------
+# 5. autoscaler hysteresis on synthetic gauge streams
+# ---------------------------------------------------------------------------
+
+def _asc(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("hysteresis_s", 1.0)
+    kw.setdefault("up_depth", 4.0)
+    kw.setdefault("down_depth", 0.5)
+    kw.setdefault("period", 0.25)
+    return AutoScaler(None, **kw)
+
+
+def _feed(asc, stream, n=2):
+    """Run a synthetic (now, load) stream through the pure decision
+    core; returns [(now, delta), ...] for the non-zero decisions."""
+    pool = asc._pools[0]
+    out = []
+    for now, load in stream:
+        d = asc.decide(pool, n, load, now)
+        if d:
+            out.append((now, d))
+            n += d
+    return out
+
+
+def test_autoscaler_sustained_pressure_fires_after_window():
+    asc = _asc()
+    stream = [(0.25 * i, 8.0) for i in range(20)]   # 5s of hot load
+    actions = _feed(asc, stream, n=1)
+    assert actions and all(d == 1 for _, d in actions)
+    assert actions[0][0] >= asc.hysteresis_s        # never before the window
+    gaps = [b - a for (a, _), (b, _) in zip(actions, actions[1:])]
+    assert all(g >= asc.hysteresis_s for g in gaps)  # cooldown holds
+
+
+def test_autoscaler_single_spike_never_fires():
+    asc = _asc()
+    stream = [(0.25 * i, 0.0) for i in range(8)]
+    stream += [(2.0, 8.0)]                          # one lonely spike
+    stream += [(2.25 + 0.25 * i, 0.0) for i in range(8)]
+    assert _feed(asc, stream, n=1) == []            # n=min: no downs either
+
+
+def test_autoscaler_flapping_load_never_fires():
+    asc = _asc()
+    stream = [(0.25 * i, 6.0 if i % 2 == 0 else 0.0) for i in range(40)]
+    assert _feed(asc, stream, n=2) == []
+
+
+def test_autoscaler_scales_down_to_min_clamp():
+    asc = _asc()
+    stream = [(0.25 * i, 0.0) for i in range(40)]   # 10s idle
+    actions = _feed(asc, stream, n=3)
+    assert [d for _, d in actions] == [-1, -1]      # 3 -> 2 -> 1, clamped
+    gaps = [b - a for (a, _), (b, _) in zip(actions, actions[1:])]
+    assert all(g >= asc.hysteresis_s for g in gaps)
+
+
+def test_autoscaler_max_clamp():
+    asc = _asc(max_replicas=2)
+    stream = [(0.25 * i, 8.0) for i in range(40)]
+    actions = _feed(asc, stream, n=1)
+    assert [d for _, d in actions] == [1]           # 1 -> 2, clamped
+
+
+def test_autoscaler_bad_clamp_raises():
+    with pytest.raises(MXNetError, match="below"):
+        _asc(min_replicas=4, max_replicas=2)
+
+
+class _StubEngine:
+    def __init__(self):
+        self.name = "stub0"
+        self.role = None
+        self.max_batch = 4
+        self._dead = None
+        self._stopped = threading.Event()
+        self._draining = False
+
+    def depth(self):
+        return 0
+
+    def decode_depth(self):
+        return 0
+
+
+class _StubRouter:
+    def __init__(self):
+        self.engines = [_StubEngine()]
+        self.calls = []
+
+    def add_replica(self, role=None):
+        self.calls.append(("up", role))
+        eng = _StubEngine()
+        eng.name = "stub%d" % len(self.engines)
+        self.engines.append(eng)
+        return eng
+
+    def remove_replica(self, role=None):
+        self.calls.append(("down", role))
+        return self.engines.pop().name
+
+
+def test_autoscaler_shed_delta_forces_hot_window():
+    """Queue depth reads 0 but the shed counter is advancing: shedding
+    IS overload — the scaler grows anyway, and the action lands in the
+    scale_ups counter + event stream."""
+    router = _StubRouter()
+    asc = AutoScaler(router, min_replicas=1, max_replicas=2,
+                     hysteresis_s=0.2, up_depth=4.0, down_depth=-1.0,
+                     period=0.05)
+    asc.step(now=0.0)                       # baseline shed snapshot
+    telemetry.inc("serve.shed")
+    asc.step(now=0.1)                       # delta>0: hot window opens
+    telemetry.inc("serve.shed")
+    taken = asc.step(now=0.35)              # window elapsed: scale up
+    assert taken == [(None, 1)]
+    assert router.calls == [("up", None)]
+    assert telemetry.registry().counter("serve.scale_ups").value == 1
+    assert telemetry.events("serve_scale_up")
+
+
+# ---------------------------------------------------------------------------
+# 6. real-fleet elasticity
+# ---------------------------------------------------------------------------
+
+def test_add_replica_compile_free_and_serves(model_and_params):
+    model, params = model_and_params
+    router = _fleet(model, params, n=1)
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    router.start()
+    try:
+        fresh = router.add_replica()
+        assert fresh.name == "replica1"
+        assert len(router.engines) == 2
+        assert reg.counter("serve.aot.compiles").value == compiles
+        reqs = [router.submit([3 + i, 4]) for i in range(6)]
+        outs = [r.result(timeout=120) for r in reqs]
+        assert all(o is not None for o in outs)
+        gone = router.remove_replica()
+        assert gone in ("replica0", "replica1")
+        assert len(router.engines) == 1
+        assert router.submit([5, 6]).result(timeout=120) is not None
+        with pytest.raises(MXNetError, match="last"):
+            router.remove_replica()
+    finally:
+        router.stop()
+    assert reg.counter("serve.aot.compiles").value == compiles
+    serving_events = [e for e in telemetry.events("retrace")
+                      if str(e.get("site", "")).startswith("serving.")]
+    assert serving_events == []
+
+
+def test_scale_down_mid_poisson_zero_failed(model_and_params):
+    """remove_replica under live load: every request (submitted before,
+    during, and after the drain) completes — zero failed — and the
+    survivors leak nothing."""
+    model, params = model_and_params
+    rng = np.random.RandomState(5)
+    prompts = [list(rng.randint(0, V, size=int(n)))
+               for n in rng.randint(2, 8, size=12)]
+    oracle = [_oracle(model, params, p) for p in prompts]
+    router = _fleet(model, params, n=3, max_batch=2)
+    router.start()
+    try:
+        reqs = [router.submit(p) for p in prompts[:6]]
+        gone = router.remove_replica(deadline_ms=1)   # strands stragglers
+        reqs += [router.submit(p) for p in prompts[6:]]
+        outs = [r.result(timeout=120) for r in reqs]
+    finally:
+        router.stop()
+    assert outs == oracle
+    assert len(router.engines) == 2
+    assert gone not in [e.name for e in router.engines]
+    for e in router.engines:
+        assert e.leaked_blocks() == 0
+
+
+def test_session_survives_holder_drain(model_and_params):
+    """The ISSUE-19 regression: draining the replica that holds a
+    session's history must MIGRATE the session store — the follow-up
+    turn finds its history (no silent conversation restart) and emits
+    full-history-parity tokens."""
+    model, params = model_and_params
+    router = _fleet(model, params, n=2, block_size=4, n_blocks=17,
+                    tier=True, host_blocks=16, max_new_tokens=8)
+    router.start()
+    try:
+        r1 = router.submit([1, 2, 3, 4, 5], max_new_tokens=3,
+                           session="conv")
+        out1 = r1.result(timeout=120)
+        holder = [e for e in router.engines if e.has_session("conv")][0]
+        fresh = router.drain(holder)
+        assert fresh is not None
+        holders = [e for e in router.engines if e.has_session("conv")]
+        assert len(holders) == 1               # history moved, not lost
+        assert holders[0] is not holder
+        r2 = router.submit([6, 7], max_new_tokens=3, session="conv")
+        out2 = r2.result(timeout=120)
+        assert holders[0].stats["session_hits"] == 1
+    finally:
+        router.stop()
+    hist = [1, 2, 3, 4, 5] + out1 + [6, 7]
+    assert out2 == _oracle(model, params, hist, max_new=3)
+    assert telemetry.registry().counter(
+        "serve.sessions_migrated").value >= 1
+    assert telemetry.events("serve_sessions_migrated")
+
+
+def test_session_survives_scale_down(model_and_params):
+    """remove_replica of the holder (no replacement spawns): the
+    session lands on a SURVIVOR and the follow-up still matches the
+    full-history oracle."""
+    model, params = model_and_params
+    router = _fleet(model, params, n=2, block_size=4, n_blocks=17,
+                    tier=True, host_blocks=16, max_new_tokens=8)
+    router.start()
+    try:
+        out1 = router.submit([1, 2, 3, 4, 5], max_new_tokens=3,
+                             session="conv").result(timeout=120)
+        holder = [e for e in router.engines if e.has_session("conv")][0]
+        router.remove_replica(holder)
+        assert len(router.engines) == 1
+        survivor = router.engines[0]
+        assert survivor.has_session("conv")
+        out2 = router.submit([6, 7], max_new_tokens=3,
+                             session="conv").result(timeout=120)
+    finally:
+        router.stop()
+    hist = [1, 2, 3, 4, 5] + out1 + [6, 7]
+    assert out2 == _oracle(model, params, hist, max_new=3)
+
+
+def test_autoscaler_loop_on_real_fleet_grows_compile_free(
+        model_and_params, monkeypatch):
+    """The wired loop: saturating queue pressure grows a real fleet by
+    one replica off the frozen AotCache with zero compiles.
+    decode_slow chaos pins the queue depth up long enough that the hot
+    window fills regardless of how fast this host decodes."""
+    model, params = model_and_params
+    _chaos(monkeypatch, "decode_slow:1:50")
+    router = _fleet(model, params, n=1)
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    router.start()
+    asc = AutoScaler(router, min_replicas=1, max_replicas=2,
+                     hysteresis_s=0.1, up_depth=0.5, down_depth=-1.0,
+                     period=0.02)
+    asc.start()
+    try:
+        # park enough work that depth/replica stays past up_depth
+        reqs = [router.submit([3 + i, 4], max_new_tokens=6)
+                for i in range(8)]
+        deadline = time.time() + 60
+        while time.time() < deadline and len(router.engines) < 2:
+            time.sleep(0.02)
+        outs = [r.result(timeout=120) for r in reqs]
+    finally:
+        asc.stop()
+        router.stop()
+    assert len(router.engines) == 2
+    assert all(o is not None for o in outs)
+    assert reg.counter("serve.aot.compiles").value == compiles
+    assert reg.counter("serve.scale_ups").value >= 1

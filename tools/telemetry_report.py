@@ -119,6 +119,20 @@ SERVE_QUANT_COUNTERS = ("serve.quant.trips", "serve.quant.scale_corrupts")
 SERVE_QUANT_GAUGE = "serve.quant_logit_err"
 SERVE_QUANT_EVENT_KINDS = ("serve_quant_trip", "serve_scale_corrupt")
 
+# gateway & elasticity (docs/serving.md "Gateway & autoscaling"): the
+# HTTP/SSE front door's accept/shed/cancel accounting + the streamed
+# time-to-first-byte histogram, the autoscaler's fleet actions, and the
+# session migration that makes scale-down invisible to conversations
+SERVE_GATEWAY_COUNTERS = (
+    "serve.gateway.requests", "serve.gateway.accepted",
+    "serve.gateway.errors", "serve.gateway.conn_shed",
+    "serve.gateway.disconnects", "serve.gateway.slow_consumer_cancels",
+    "serve.scale_ups", "serve.scale_downs", "serve.sessions_migrated")
+SERVE_GATEWAY_GAUGE = "serve.gateway.open_conns"
+SERVE_GATEWAY_HIST = "serve.gateway.ttfb_ms"
+SERVE_GATEWAY_EVENT_KINDS = ("serve_gateway_cancel", "serve_scale_up",
+                             "serve_scale_down", "serve_sessions_migrated")
+
 # SLO attribution (docs/observability.md "Request tracing"): the tracing
 # layer folds every retired request's span timeline into per-phase
 # serve.attr.*_ms histograms — a ttft/e2e p99 regression names its phase
@@ -428,6 +442,24 @@ def summarize(records):
             quantization["%s_events" % kind] = n
     if quantization:
         out["quantization"] = quantization
+    gateway = {k: int(final.get(k, 0)) for k in SERVE_GATEWAY_COUNTERS
+               if final.get(k)}
+    # live connection count: last-seen value of the gateway's accept
+    # gauge — nonzero at end-of-stream means connections outlived stop()
+    for r in records:
+        v = r.get("gauges", {}).get(SERVE_GATEWAY_GAUGE)
+        if v is not None:
+            gateway[SERVE_GATEWAY_GAUGE] = v
+    for kind in SERVE_GATEWAY_EVENT_KINDS:
+        n = sum(1 for r in records for e in r.get("events", [])
+                if e.get("kind") == kind)
+        if n:
+            gateway["%s_events" % kind] = n
+    ttfb = _merge_hists(records, SERVE_GATEWAY_HIST)
+    if ttfb:
+        gateway[SERVE_GATEWAY_HIST] = ttfb
+    if gateway:
+        out["gateway"] = gateway
     healths = [r["health"] for r in records if "health" in r]
     if healths:
         out["last_health"] = healths[-1]
@@ -532,6 +564,17 @@ def format_summary(summary):
         lines.append("  quantization:")
         for key in sorted(quantization):
             lines.append("    %-24s %s" % (key, quantization[key]))
+    gateway = summary.get("gateway")
+    if gateway:
+        lines.append("  gateway & elasticity:")
+        for key in sorted(gateway):
+            v = gateway[key]
+            if isinstance(v, dict):
+                lines.append("    %-32s n=%d mean=%.1f p99<=%.1f max=%.1f"
+                             % (key, v["count"], v["mean"], v["p99_max"],
+                                v["max"]))
+            else:
+                lines.append("    %-32s %s" % (key, v))
     if "last_health" in summary:
         h = summary["last_health"]
         lines.append("  health (last step)   grad_norm=%.4g "

@@ -28,7 +28,8 @@ import sys
 RENDERED_PHASES = (
     "request", "queue_wait", "prefill", "replay", "restore_wait",
     "handoff_wait", "decode", "prefill_chunk", "handoff_pack",
-    "handoff_land", "megastep", "host_sweep", "spec_round")
+    "handoff_land", "megastep", "host_sweep", "spec_round",
+    "gateway_send")
 
 # interval phases: at most one open per trace at a time; their per-trace
 # totals are the serve.attr.* decomposition and must tile ~all of e2e
@@ -38,7 +39,7 @@ INTERVAL_PHASES = ("queue_wait", "prefill", "replay", "restore_wait",
 TTFT_PHASES = ("queue_wait", "prefill", "replay", "restore_wait",
                "handoff_wait")
 LEAF_PHASES = ("prefill_chunk", "handoff_pack", "handoff_land",
-               "megastep", "host_sweep", "spec_round")
+               "megastep", "host_sweep", "spec_round", "gateway_send")
 
 BAR_WIDTH = 36
 
