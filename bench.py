@@ -645,8 +645,10 @@ def serve_bench(record=True, with_chaos=False):
         pass
     telemetry.add_sink(telemetry.JsonlSink(tel_path))
 
+    moe_experts = int(os.environ.get("SERVE_MOE_EXPERTS", "0"))
     model = TransformerKVModel(vocab, seq, num_layers=layers,
-                               num_heads=heads, num_embed=embed)
+                               num_heads=heads, num_embed=embed,
+                               moe_experts=moe_experts)
     params = model.init_params(rng)
     n_replicas = min(n_replicas, len(jax.devices()))
     router = ReplicaRouter.from_mesh(model, params, n_replicas=n_replicas,
@@ -935,6 +937,31 @@ def serve_bench(record=True, with_chaos=False):
             "rollback_blocks": _spec_sum("spec_rollbacks"),
             "junk_rounds": _spec_sum("spec_junk_rounds"),
         }
+    # sub-mesh accounting (docs/serving.md "Sharded replicas"): chips =
+    # devices actually held by the fleet (a k-shard replica owns k), the
+    # per-device share of params+KV, and — for MoE models — the
+    # per-expert dispatch balance the expert-parallel decode exposes
+    n_chips = 0
+    per_dev_bytes = total_bytes = 0
+    for e in router.engines:
+        mf = e.memory_footprint()
+        n_chips += mf["devices"]
+        per_dev_bytes = max(per_dev_bytes, mf["per_device_bytes"])
+        total_bytes += mf["total_bytes"]
+    moe_stats = None
+    if moe_experts:
+        load = None
+        for e in router.engines:
+            el = e.expert_load()
+            if el is not None:
+                load = el if load is None else load + el
+        if load is not None and load.sum():
+            mean = float(load.sum()) / len(load)
+            moe_stats = {
+                "experts": moe_experts,
+                "expert_load": [int(v) for v in load],
+                "load_imbalance": round(float(load.max()) / mean, 4),
+            }
     # token-parity witness across A/B legs run on the same request set:
     # a digest of every successfully completed request's output (keyed
     # by submit index, so legs compare request-for-request)
@@ -981,10 +1008,16 @@ def serve_bench(record=True, with_chaos=False):
                   if reg.counter(k).value}
     result = {
         "metric": "serve_tokens_per_sec_per_chip",
-        "value": round(n_tokens / elapsed / n_replicas, 2),
-        "unit": "tok/s/chip (continuous batching, %d replicas, greedy, "
-                "vocab=%d L=%d E=%d S=%d)" % (n_replicas, vocab, layers,
-                                              embed, seq),
+        # per-CHIP, not per-replica: a k-shard sub-mesh replica holds k
+        # devices (n_chips == n_replicas on an unsharded fleet)
+        "value": round(n_tokens / elapsed / max(n_chips, 1), 2),
+        "unit": "tok/s/chip (continuous batching, %d replicas, %d chips, "
+                "greedy, vocab=%d L=%d E=%d S=%d)"
+                % (n_replicas, n_chips, vocab, layers, embed, seq),
+        "chips": n_chips,
+        "memory": {"per_device_bytes": per_dev_bytes,
+                   "total_bytes": total_bytes},
+        "moe": moe_stats,
         "requests": n_requests,
         "completed": sum(1 for r in reqs if r.done and r.error is None),
         # every offered request must account for itself: finished (ok or
@@ -1862,6 +1895,92 @@ def serve_disagg_bench(record=True):
     return result
 
 
+def serve_sharded_bench(record=True):
+    """Sub-mesh replica A/B on EQUAL chips (``python bench.py --serve
+    --sharded``).
+
+    Both legs get the same N devices and the same trace; only the
+    replica topology differs: the `replicated` leg runs N single-device
+    replicas (each holding full params + KV pool — PR-19 scale-out),
+    the `sharded` leg runs ONE N-device sub-mesh replica (params and
+    the paged KV pool split over the mesh via NamedSharding/pjit,
+    docs/serving.md "Sharded replicas").  ``SERVE_SHARD_DEVICES``
+    (default 2) sets N; the model knobs should be sized so the
+    footprint exceeds one device's budget — the sharded leg's
+    ``memory.per_device_bytes`` is the existence proof the nightly
+    gate reads (replicated serving of that config would need the whole
+    model per chip).
+
+    Recorded per leg: tok/s/chip (chip-normalized — the sub-mesh
+    replica owns N chips), ttft p50/p99, admitted concurrency, zero
+    steady-state recompiles, and (``SERVE_MOE_EXPERTS`` > 0) the
+    per-expert load balance of the expert-parallel decode.  The
+    headline is sharded/replicated tok/s/chip; `parity` witnesses that
+    greedy outputs match request-for-request across topologies.
+    """
+    import jax
+
+    from mxnet_tpu import telemetry
+
+    n_dev = len(jax.devices())
+    k = max(2, min(int(os.environ.get("SERVE_SHARD_DEVICES", "2")), n_dev))
+    runs = {}
+    shared = {"MXNET_SERVE_PAGED": "1"}
+    for mode, env in (
+            ("replicated", {"SERVE_REPLICAS": str(k),
+                            "MXNET_SERVE_SHARDED_DEVICES": "1"}),
+            ("sharded", {"SERVE_REPLICAS": "1",
+                         "MXNET_SERVE_SHARDED_DEVICES": str(k)})):
+        env = dict(shared, **env)
+        old = {kk: os.environ.get(kk) for kk in env}
+        os.environ.update(env)
+        telemetry.reset()  # fresh counters/sinks per leg
+        try:
+            runs[mode] = serve_bench(record=False)
+        finally:
+            for kk, v in old.items():
+                if v is None:
+                    os.environ.pop(kk, None)
+                else:
+                    os.environ[kk] = v
+    rep, sha = runs["replicated"], runs["sharded"]
+    result = {
+        "metric": "serve_sharded_vs_replicated",
+        # equal chips: tok/s/chip ratio (1.0 = sharding keeps per-chip
+        # throughput; < 1.0 is the price of collectives, paid only when
+        # the model no longer fits one device)
+        "value": round(sha["value"] / max(rep["value"], 1e-9), 3),
+        "unit": "sharded/replicated tok/s/chip ratio "
+                "(%d chips each leg)" % k,
+        "devices_per_replica": k,
+        "replicated": rep,
+        "sharded": sha,
+        "parity": rep["output_sig"] == sha["output_sig"],
+        "tok_s_chip": {"replicated": rep["value"], "sharded": sha["value"]},
+        "ttft_p50_ms": {"replicated": rep["ttft_ms"]["p50"],
+                        "sharded": sha["ttft_ms"]["p50"]},
+        "ttft_p99_ms": {"replicated": rep["ttft_ms"]["p99"],
+                        "sharded": sha["ttft_ms"]["p99"]},
+        "max_concurrent": {"replicated": rep["max_concurrent"],
+                           "sharded": sha["max_concurrent"]},
+        "per_device_bytes": {
+            "replicated": rep["memory"]["per_device_bytes"],
+            "sharded": sha["memory"]["per_device_bytes"]},
+        "moe": {"replicated": rep.get("moe"), "sharded": sha.get("moe")},
+        "steady_state_recompiles": {
+            "replicated": rep["steady_state_recompiles"],
+            "sharded": sha["steady_state_recompiles"]},
+    }
+    if record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        out = os.path.join(here, "bench_results", "serve_bench.json")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def serve_tracing_bench(record=True):
     """Request-tracing overhead A/B on the disaggregated burst trace
     (``python bench.py --serve --tracing``).
@@ -2430,6 +2549,8 @@ if __name__ == "__main__":
             serve_tracing_bench()
         elif "--elastic" in sys.argv:
             serve_elastic_bench()
+        elif "--sharded" in sys.argv:
+            serve_sharded_bench()
         else:
             serve_bench(with_chaos="--chaos" in sys.argv)
     else:

@@ -22,6 +22,16 @@ one program per distinct ``length=``, so a per-request value leaking
 into it (``length=req.max_new_tokens``) is the same per-request
 recompile storm — the rule fires on a tainted scan length (keyword or
 4th positional), and only ``*bucket*``-table lookups are sanctioned.
+
+Sharding specs are shapes too (sub-mesh replicas, docs/serving.md
+"Sharded replicas"): a ``jax.jit``/``pjit`` call's ``in_shardings`` /
+``out_shardings`` kwargs are part of the compiled executable's
+signature — a spec derived from a per-request value (a mesh or
+PartitionSpec picked off request state) partitions a fresh program per
+request exactly like a dynamic dimension.  The rule walks those kwarg
+expressions with the same taint analysis; specs built from ``self._*``
+engine configuration (the frozen mesh chosen at construction) stay
+silent.
 """
 from __future__ import annotations
 
@@ -107,7 +117,27 @@ class AotShapeRule(Rule):
                               and isinstance(func, ast.Attribute))
                 is_scan = (name == "scan"
                            and isinstance(func, ast.Attribute))
-                if not (is_creator or is_reshape or is_scan):
+                is_jit = name in ("jit", "pjit")
+                if not (is_creator or is_reshape or is_scan or is_jit):
+                    continue
+                if is_jit:
+                    # in/out sharding specs are part of the executable
+                    # signature: a per-request spec = per-request compile
+                    specs = [kw.value for kw in node.keywords
+                             if kw.arg in ("in_shardings", "out_shardings")]
+                    for spec in specs:
+                        if _req_tainted(spec, tainted):
+                            findings.append(Finding(
+                                self.id, ctx.relpath, node.lineno,
+                                node.col_offset,
+                                "jit sharding spec in '%s' takes a per-"
+                                "request value — in/out shardings are "
+                                "part of the compiled executable's "
+                                "signature; sub-mesh serving specs must "
+                                "come from the engine's frozen mesh "
+                                "(self._*) or this partitions a new "
+                                "program per request" % fn.name))
+                            break
                     continue
                 if is_scan:
                     # the scan LENGTH is a compiled shape: length= kwarg

@@ -354,12 +354,25 @@ class AotCache:
     zero-recompile invariant survives failover.  `compiles` exposes the
     local build count for exactly that gate."""
 
-    def __init__(self, name="aot"):
+    def __init__(self, name="aot", signature=()):
         self._name = name
         self._cache = {}
         self._lock = threading.Lock()
         self._compiles = 0
         self._frozen = False
+        # every key is scoped by this tuple (a sub-mesh serving replica
+        # passes its mesh signature): executables partitioned for one
+        # mesh shape are wrong — not just slow — on another, so two
+        # engines with different signatures sharing this cache can
+        # never alias each other's entries
+        self._signature = tuple(signature or ())
+
+    @property
+    def signature(self):
+        return self._signature
+
+    def _scoped(self, key):
+        return (key + self._signature) if self._signature else key
 
     @property
     def compiles(self):
@@ -373,6 +386,7 @@ class AotCache:
     def get(self, key, build=None):
         """The executable for `key`, building (and counting a compile) via
         `build()` on first use.  `build=None` probes without compiling."""
+        key = self._scoped(key)
         with self._lock:
             ent = self._cache.get(key)
         if ent is not None:

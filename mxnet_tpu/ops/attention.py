@@ -7,6 +7,20 @@ lowers to the fused Pallas flash kernel on TPU
 (`mxnet_tpu/ops/pallas_kernels/flash_attention.py`) and to a blockwise
 lax.scan elsewhere; sequence-parallel variants live in
 `mxnet_tpu/parallel/sequence.py`.
+
+GSPMD head-axis contract (docs/serving.md "Sharded replicas"): the
+serving-side helpers below (`decode_attention`, the paged gathers,
+`chunk_attention`, `verify_attention`) are pure jnp gather/einsum over
+`(..., embed)` operands with embed laid out HEAD-MAJOR — every
+`reshape(b, s, e) -> (b, s, h, hd)` splits the embed axis on heads
+first.  A `NamedSharding` that splits embed over n devices where n
+divides num_heads therefore maps 1:1 onto a head split: the reshapes
+are shard-local, each device attends over its own head group against
+its own slice of the K/V pool, and GSPMD partitions every einsum here
+without inserting a collective until the output projection's
+row-sharded matmul reduces.  Keep it that way — no op in this file may
+mix embed positions across the head boundary (e.g. a transpose to
+`(hd, h)` order), or sub-mesh serving silently gains all-to-alls.
 """
 from __future__ import annotations
 

@@ -6,7 +6,8 @@ ps-lite BSP → SPMD pjit over a `jax.sharding.Mesh`; ctx_group model
 parallelism → sharding annotations; plus TPU-era capabilities the reference
 lacked (sequence/context parallelism via ring attention).
 """
-from .mesh import MeshContext, get_mesh, make_mesh, data_parallel_sharding
+from .mesh import (MeshContext, get_mesh, make_mesh,
+                   data_parallel_sharding, mesh_signature, submeshes)
 from .trainer import SPMDTrainer
 from .sequence import ring_attention, ulysses_attention
 from .pipeline import PipelineParallel

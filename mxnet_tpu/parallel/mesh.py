@@ -78,3 +78,39 @@ def data_parallel_sharding(mesh, axis="data"):
 
 def replicated_sharding(mesh):
     return NamedSharding(mesh, P())
+
+
+def mesh_signature(mesh):
+    """Hashable identity of a mesh's PROGRAM SPACE: axis names, axis
+    sizes, and device platform.  Two meshes with the same signature
+    compile identical partitioned programs, two with different
+    signatures must never share an AOT cache entry — `AotCache`
+    appends this tuple to every key on a sub-mesh serving replica, so
+    a 2-shard and a 4-shard replica sharing one cache cannot collide.
+    `None` (single-device callers) signs as the empty tuple."""
+    if mesh is None:
+        return ()
+    devs = np.asarray(mesh.devices)
+    first = devs.reshape(-1)[0]
+    return (tuple(mesh.axis_names), tuple(devs.shape),
+            str(getattr(first, "platform", first)))
+
+
+def submeshes(devices, per_mesh, axis_names=("model",)):
+    """Partition ``devices`` into consecutive groups of ``per_mesh``
+    and return one 1-axis Mesh per group — the sub-mesh serving
+    replica's fleet layout (`ReplicaRouter.from_mesh(...,
+    devices_per_replica=k)`).  A remainder that cannot fill a whole
+    group is dropped (a half-width replica would compile a different
+    program space than its peers)."""
+    devices = list(devices)
+    per_mesh = int(per_mesh)
+    if per_mesh < 1:
+        raise MXNetError("submeshes: need per_mesh >= 1, got %d" % per_mesh)
+    groups = [devices[i:i + per_mesh]
+              for i in range(0, len(devices) - per_mesh + 1, per_mesh)]
+    if not groups:
+        raise MXNetError(
+            "submeshes: %d devices cannot fill one %d-device sub-mesh"
+            % (len(devices), per_mesh))
+    return [Mesh(np.array(g), axis_names) for g in groups]

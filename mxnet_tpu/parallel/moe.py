@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
+from .. import telemetry
 from .mesh import shard_map
 
 
@@ -108,13 +109,31 @@ class MoEFFN:
         p = jnp.mean(probs, axis=0)
         aux = E * jnp.sum(f * p)
         aux = jax.lax.pmean(aux, ax)
-        return y, aux
+        # dispatch accounting: how many tokens each expert admitted, and how
+        # many overflowed its capacity block (their combine weight is zero,
+        # i.e. the layer silently outputs 0 for them) — psum'd so every
+        # device reports the global totals
+        admitted = jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        admitted = jax.lax.psum(admitted, ax)  # (E,)
+        dropped = jax.lax.psum(jnp.sum((~keep).astype(jnp.int32)), ax)
+        return y, aux, admitted, dropped
 
     def __call__(self, params, x):
         fn = shard_map(
             self._local, mesh=self.mesh,
             in_specs=({"wr": P(), "w1": P(self.axis), "w2": P(self.axis)},
                       P(self.axis)),
-            out_specs=(P(self.axis), P()),
+            out_specs=(P(self.axis), P(), P(), P()),
         )
-        return fn(params, x)
+        y, aux, admitted, dropped = fn(params, x)
+        if not isinstance(admitted, jax.core.Tracer):
+            # eager call: fold dispatch stats into the telemetry registry
+            # (under jit the stats are tracers; callers see only (y, aux))
+            counts = np.asarray(admitted)
+            for e, c in enumerate(counts):
+                telemetry.inc("moe.expert_dispatch.%s" % e, int(c))
+                telemetry.set_gauge("moe.expert_load.%s" % e, int(c))
+            nd = int(dropped)
+            if nd:
+                telemetry.inc("moe.overflow_dropped", nd)
+        return y, aux

@@ -59,6 +59,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..base import MXNetError
 from ..ops.attention import (gather_paged_kv, gather_paged_scales,
@@ -81,7 +82,8 @@ class TransformerKVModel:
 
     def __init__(self, vocab_size, seq_len, num_layers=2, num_heads=4,
                  num_embed=128, num_ffn_hidden=None, use_bias=True,
-                 eps=1e-5, dtype=np.float32, quant=None, kv_quant=None):
+                 eps=1e-5, dtype=np.float32, quant=None, kv_quant=None,
+                 moe_experts=0):
         if num_embed % num_heads != 0:
             raise MXNetError("num_embed must be divisible by num_heads")
         self.vocab_size = int(vocab_size)
@@ -91,6 +93,11 @@ class TransformerKVModel:
         self.num_embed = int(num_embed)
         self.num_ffn_hidden = int(num_ffn_hidden or 4 * num_embed)
         self.use_bias = bool(use_bias)
+        # moe_experts > 0 replaces every layer's dense FFN with a top-1
+        # routed mixture of expert FFNs (`_ffn`): the Switch-style
+        # serving counterpart of parallel/moe.py, dispatched densely
+        # (no capacity drops) so parity and batch-invariance hold
+        self.moe_experts = int(moe_experts or 0)
         self.eps = float(eps)
         self.dtype = np.dtype(dtype)
         # post-training quantization specs (None = full precision, the
@@ -133,12 +140,22 @@ class TransformerKVModel:
             shapes[p + "ln1_beta"] = (e,)
             shapes[p + "ln2_gamma"] = (e,)
             shapes[p + "ln2_beta"] = (e,)
-            for proj, (nh, nin) in (("q", (e, e)), ("k", (e, e)),
-                                    ("v", (e, e)), ("attn_out", (e, e)),
-                                    ("ffn1", (f, e)), ("ffn2", (e, f))):
+            projs = [("q", (e, e)), ("k", (e, e)), ("v", (e, e)),
+                     ("attn_out", (e, e))]
+            if not self.moe_experts:
+                projs += [("ffn1", (f, e)), ("ffn2", (e, f))]
+            for proj, (nh, nin) in projs:
                 shapes[p + proj + "_weight"] = (nh, nin)
                 if self.use_bias:
                     shapes[p + proj + "_bias"] = (nh,)
+            if self.moe_experts:
+                # expert banks (biasless, Switch-style): the router is
+                # O(e*E); w1/w2 stack every expert's FFN on axis 0 —
+                # the axis a sub-mesh replica shards for expert
+                # parallelism
+                shapes[p + "moe_router_weight"] = (e, self.moe_experts)
+                shapes[p + "moe_w1"] = (self.moe_experts, e, f)
+                shapes[p + "moe_w2"] = (self.moe_experts, f, e)
         return shapes
 
     def init_params(self, rng=None, scale=0.02):
@@ -168,10 +185,15 @@ class TransformerKVModel:
         table stay full precision — they are O(E) bytes and sit on
         addition paths where a scale would buy nothing."""
         names = ["embed_weight", "pred_weight"]
+        projs = ("q", "k", "v", "attn_out")
+        if not self.moe_experts:
+            # the stacked (E, ., .) expert banks stay full precision:
+            # the codec's per-output-channel scheme is 2-D, and the MoE
+            # serving story is capacity-via-sharding, not weight quant
+            projs = projs + ("ffn1", "ffn2")
         for i in range(self.num_layers):
             p = "layer%d_" % i
-            names += [p + s + "_weight" for s in
-                      ("q", "k", "v", "attn_out", "ffn1", "ffn2")]
+            names += [p + s + "_weight" for s in projs]
         return names
 
     def quantize_params(self, params):
@@ -192,6 +214,80 @@ class TransformerKVModel:
             out[name + "_qscale"] = scale
         return out
 
+    # -- sub-mesh sharding rules -------------------------------------------
+    def param_shardings(self, mesh, axis="model"):
+        """{name: NamedSharding} for a sub-mesh serving replica — the
+        serving counterpart of `SPMDTrainer`'s auto-param-sharding
+        rules (tensor-parallel projections and head, replicated norms):
+
+        * q/k/v/ffn1 weights column-split ``P(axis, None)`` (biases
+          ``P(axis)``) — each shard owns a slice of heads / hidden;
+        * attn_out/ffn2 weights row-split ``P(None, axis)`` (biases
+          replicated: they add AFTER the cross-shard reduction);
+        * embed/pred head vocab-split ``P(axis, None)`` (pred bias
+          ``P(axis)``) — the trainer's CE-shard head rule;
+        * MoE expert banks ``P(axis, None, None)`` (expert
+          parallelism), the router replicated (every shard routes);
+        * everything 1-D on the residual path (LN gammas/betas,
+          pos_embed) replicated.
+
+        Any dimension the mesh axis doesn't divide falls back to
+        replicated for that tensor — the rules never reject a
+        geometry, they just shard less of it.  Quantized-weight scale
+        vectors (``<name>_qscale``) follow their weight's axis-0
+        split (per-OUTPUT-channel scales live on the column axis)."""
+        n = int(mesh.shape[axis])
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def ns(*spec):
+            return NamedSharding(mesh, PartitionSpec(*spec))
+
+        out = {}
+        for name, shape in self.param_shapes().items():
+            sh = repl
+            if name.endswith(("moe_w1", "moe_w2")):
+                if shape[0] % n == 0:
+                    sh = ns(axis, None, None)
+            elif name.endswith("moe_router_weight"):
+                sh = repl
+            elif name in ("embed_weight", "pred_weight") or \
+                    name.endswith(("q_weight", "k_weight", "v_weight",
+                                   "ffn1_weight")):
+                if shape[0] % n == 0:
+                    sh = ns(axis, None)
+            elif name == "pred_bias" or \
+                    name.endswith(("q_bias", "k_bias", "v_bias",
+                                   "ffn1_bias")):
+                if shape[0] % n == 0:
+                    sh = ns(axis)
+            elif name.endswith(("attn_out_weight", "ffn2_weight")):
+                if shape[1] % n == 0:
+                    sh = ns(None, axis)
+            out[name] = sh
+        if self.quant is not None:
+            for wname in self._quant_weight_names():
+                spec = out[wname].spec
+                out[wname + "_qscale"] = \
+                    ns(spec[0]) if len(spec) and spec[0] else repl
+        return out
+
+    def kv_shardings(self, mesh, axis="model"):
+        """(pool, scales) shardings for the sub-mesh replica's KV
+        buffers: the paged pool (L, 2, n_blocks, bs, E) and the slot
+        cache (L, 2, n_slots, S_max, E) split on the trailing embed
+        (head) axis — every shard holds ITS heads' K/V for ALL blocks,
+        so block tables, the allocator, the prefix cache and all
+        host-side scheduling stay replica-global exactly as on one
+        device — while the KV-quant scales (one f32 per token row, no
+        embed axis) replicate.  Falls back to fully replicated when
+        the mesh axis doesn't divide the embed width."""
+        repl = NamedSharding(mesh, PartitionSpec())
+        if self.num_embed % int(mesh.shape[axis]):
+            return repl, repl
+        return (NamedSharding(mesh,
+                              PartitionSpec(None, None, None, None, axis)),
+                repl)
+
     def init_cache(self, n_slots, device=None):
         """Zeroed K/V cache: (num_layers, 2, n_slots, S_max, embed).
 
@@ -201,6 +297,11 @@ class TransformerKVModel:
         touching the compiled executables — rebuild compiles nothing)."""
         shape = (self.num_layers, 2, int(n_slots), self.seq_len,
                  self.num_embed)
+        if isinstance(device, tuple):
+            # a sub-mesh engine passes its (pool, scales) sharding pair
+            # uniformly; the slot cache is one full-precision array and
+            # takes the pool half (same rank, embed axis last)
+            device = device[0]
         if device is None:
             return jnp.zeros(shape, self.dtype)
         return jax.device_put(np.zeros(shape, self.dtype), device)
@@ -225,6 +326,45 @@ class TransformerKVModel:
             y = y + params[name + "_bias"]
         return y
 
+    def _ffn(self, params, h2, p, tape=None):
+        """Layer ``p``'s FFN over flattened (n, e) rows: the dense
+        gelu(ffn1) @ ffn2 pair, or — when the geometry is MoE
+        (``moe_experts > 0``) — a top-1 routed mixture of expert FFNs.
+
+        The MoE dispatch is DENSE: every row runs every expert and a
+        one-hot gate keeps the winner's output.  No capacity factor, no
+        drops — a row's result is one expert's FFN exactly, independent
+        of what the rest of the batch routed, so serving stays
+        batch-invariant and an expert-sharded mesh replica matches the
+        replicated oracle token for token (each row's sum is one
+        nonzero term plus exact zeros).  Under GSPMD the (E, ., .)
+        expert banks shard on axis 0, making both einsums
+        expert-parallel with no shard_map and no program change.
+
+        ``tape`` (a list or None) collects this layer's per-expert
+        routed row counts — (E,) int32, padding rows included — for
+        the engine's ``serve.<name>.expert_load`` gauges.
+        """
+        if not self.moe_experts:
+            f = jax.nn.gelu(self._proj(params, h2, p + "ffn1"))
+            return self._proj(params, f, p + "ffn2")
+        probs = jax.nn.softmax(
+            jnp.dot(h2.astype(jnp.float32),
+                    params[p + "moe_router_weight"].astype(jnp.float32)),
+            axis=-1)                                        # (n, E) f32
+        gate = jnp.max(probs, axis=-1)                      # (n,)
+        onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
+                                self.moe_experts, dtype=jnp.float32)
+        if tape is not None:
+            tape.append(jnp.sum(onehot, axis=0).astype(jnp.int32))
+        hb = jax.nn.gelu(jnp.einsum(
+            "nd,edf->nef", h2.astype(jnp.float32),
+            params[p + "moe_w1"].astype(jnp.float32)))      # (n, E, f)
+        y = jnp.einsum("nef,efd->ned", hb,
+                       params[p + "moe_w2"].astype(jnp.float32))
+        return jnp.einsum("ned,ne->nd", y,
+                          onehot * gate[:, None]).astype(h2.dtype)
+
     def _embed(self, params, tokens):
         """Token embedding lookup — under weight quant the gathered int8
         rows dequantize by their per-row (per-vocab-entry) scale, so the
@@ -244,7 +384,7 @@ class TransformerKVModel:
             "pred")
 
     # -- prefill -----------------------------------------------------------
-    def prefill(self, params, tokens, length):
+    def prefill(self, params, tokens, length, moe_tape=None):
         """Forward the (right-padded) prompt, returning the cache fill.
 
         tokens: (b, s) int32, rows padded past ``length`` with any id.
@@ -283,15 +423,15 @@ class TransformerKVModel:
             x = x + self._proj(params, attn, p + "attn_out").reshape(b, s, e)
             hn = layer_norm(x, params[p + "ln2_gamma"],
                             params[p + "ln2_beta"], self.eps)
-            f = jax.nn.gelu(self._proj(params, hn.reshape(-1, e), p + "ffn1"))
-            x = x + self._proj(params, f, p + "ffn2").reshape(b, s, e)
+            x = x + self._ffn(params, hn.reshape(-1, e), p,
+                              tape=moe_tape).reshape(b, s, e)
         last = jnp.take_along_axis(
             x, (length.astype(jnp.int32) - 1)[:, None, None], axis=1
         )[:, 0, :]  # (b, e)
         return self._head(params, last), jnp.stack(kv)
 
     # -- decode ------------------------------------------------------------
-    def decode(self, params, cache, token, pos, slots):
+    def decode(self, params, cache, token, pos, slots, moe_tape=None):
         """One generation step for a bucket of sequences.
 
         cache: (num_layers, 2, n_slots, S_max, embed) — donated by the
@@ -326,8 +466,7 @@ class TransformerKVModel:
             x = x + self._proj(params, attn, p + "attn_out")
             hn = layer_norm(x, params[p + "ln2_gamma"],
                             params[p + "ln2_beta"], self.eps)
-            f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
-            x = x + self._proj(params, f, p + "ffn2")
+            x = x + self._ffn(params, hn, p, tape=moe_tape)
         return self._head(params, x), cache
 
     # -- paged cache -------------------------------------------------------
@@ -375,17 +514,22 @@ class TransformerKVModel:
         pool-rebuild recovery allocation."""
         shape = (self.num_layers, 2, int(n_blocks), int(block_size),
                  self.num_embed)
+        # a sub-mesh engine passes ``device`` as the (pool, scales)
+        # sharding PAIR — the pool splits on the embed axis but the
+        # per-row scales have no embed axis and replicate
+        pdev, sdev = device if isinstance(device, tuple) else (device,
+                                                              device)
         if self.kv_quant is None:
-            if device is None:
+            if pdev is None:
                 return jnp.zeros(shape, self.dtype)
-            return jax.device_put(np.zeros(shape, self.dtype), device)
+            return jax.device_put(np.zeros(shape, self.dtype), pdev)
         qdt = np.dtype(self.kv_quant.qdtype(np))
         pool = np.zeros(shape, qdt)
         scales = np.zeros(shape[:-1], np.float32)
-        if device is None:
+        if pdev is None:
             return jnp.asarray(pool), jnp.asarray(scales)
-        return (jax.device_put(pool, device),
-                jax.device_put(scales, device))
+        return (jax.device_put(pool, pdev),
+                jax.device_put(scales, sdev))
 
     def block_run_placeholder(self, k, block_size):
         """Zeroed HOST staging buffers for a ``k``-block run — the
@@ -453,7 +597,8 @@ class TransformerKVModel:
         scales = scales.at[:, :, dst].set(ds.astype(jnp.float32))
         return self._pack_pool(pool, scales)
 
-    def prefill_paged(self, params, pool, tokens, start, length, tables):
+    def prefill_paged(self, params, pool, tokens, start, length, tables,
+                      moe_tape=None):
         """One chunked-prefill step over the paged pool.
 
         tokens: (b, c) int32 — a chunk of the prompt, rows padded past
@@ -528,15 +673,15 @@ class TransformerKVModel:
                                p + "attn_out").reshape(b, c, e)
             hn = layer_norm(x, params[p + "ln2_gamma"],
                             params[p + "ln2_beta"], self.eps)
-            f = jax.nn.gelu(self._proj(params, hn.reshape(-1, e),
-                                       p + "ffn1"))
-            x = x + self._proj(params, f, p + "ffn2").reshape(b, c, e)
+            x = x + self._ffn(params, hn.reshape(-1, e), p,
+                              tape=moe_tape).reshape(b, c, e)
         last = jnp.take_along_axis(
             x, (length.astype(jnp.int32) - 1)[:, None, None], axis=1
         )[:, 0, :]
         return self._head(params, last), self._pack_pool(pool, scales)
 
-    def decode_paged(self, params, pool, token, pos, tables):
+    def decode_paged(self, params, pool, token, pos, tables,
+                     moe_tape=None):
         """One generation step over the paged pool (the block-table
         counterpart of `decode`).
 
@@ -591,12 +736,11 @@ class TransformerKVModel:
             x = x + self._proj(params, attn, p + "attn_out")
             hn = layer_norm(x, params[p + "ln2_gamma"],
                             params[p + "ln2_beta"], self.eps)
-            f = jax.nn.gelu(self._proj(params, hn, p + "ffn1"))
-            x = x + self._proj(params, f, p + "ffn2")
+            x = x + self._ffn(params, hn, p, tape=moe_tape)
         return self._head(params, x), self._pack_pool(pool, scales)
 
     def decode_megastep(self, params, pool, token, pos, left, eos, tables,
-                        steps, pick):
+                        steps, pick, moe_tape=None):
         """``steps`` fused generation steps in ONE launch: a `lax.scan`
         over the `decode_paged` body with per-row active masks, so a row
         that finishes (EOS / generation budget / cache depth) mid-scan
@@ -633,11 +777,20 @@ class TransformerKVModel:
         # write to the trash block instead of clamping onto a real one
         dead = jnp.int32(tables.shape[1] * bs)
         seq_end = jnp.int32(self.seq_len)
+        # MoE expert-load counts ride the scan carry (one (E,) int32
+        # accumulator summed over layers and steps) and come out as a
+        # single tape entry — a scan can't append per-step
+        want = bool(self.moe_experts) and moe_tape is not None
 
         def step(carry, _):
-            pool, tok, p, lf, act = carry
+            if want:
+                pool, tok, p, lf, act, cnt = carry
+            else:
+                pool, tok, p, lf, act = carry
+            tape = [] if want else None
             logits, pool = self.decode_paged(
-                params, pool, tok, jnp.where(act, p, dead), tables)
+                params, pool, tok, jnp.where(act, p, dead), tables,
+                moe_tape=tape)
             picked = pick(logits, p + 1)
             trip = act & (picked < 0)
             adv = act & ~trip
@@ -651,15 +804,23 @@ class TransformerKVModel:
                 (p2 >= seq_end)
             act2 = adv & ~fin
             emit = jnp.where(act, picked, jnp.int32(-2))
+            if want:
+                cnt = cnt + jnp.sum(jnp.stack(tape), axis=0)
+                return (pool, tok2, p2, lf2, act2, cnt), emit
             return (pool, tok2, p2, lf2, act2), emit
 
         carry = (pool, token.astype(jnp.int32), pos.astype(jnp.int32),
                  left.astype(jnp.int32), left > 0)
-        (pool, _, _, _, _), toks = jax.lax.scan(step, carry, None,
-                                                length=steps)
+        if want:
+            carry = carry + (jnp.zeros((self.moe_experts,), jnp.int32),)
+        out, toks = jax.lax.scan(step, carry, None, length=steps)
+        pool = out[0]
+        if want:
+            moe_tape.append(out[5])
         return toks.T, pool
 
-    def verify_paged(self, params, pool, tokens, pos, length, tables):
+    def verify_paged(self, params, pool, tokens, pos, length, tables,
+                     moe_tape=None):
         """Speculative-decoding verify: score a whole draft run with ONE
         launch (the draft-verify counterpart of `decode_paged`).
 
@@ -728,9 +889,8 @@ class TransformerKVModel:
                                p + "attn_out").reshape(b, c, e)
             hn = layer_norm(x, params[p + "ln2_gamma"],
                             params[p + "ln2_beta"], self.eps)
-            f = jax.nn.gelu(self._proj(params, hn.reshape(-1, e),
-                                       p + "ffn1"))
-            x = x + self._proj(params, f, p + "ffn2").reshape(b, c, e)
+            x = x + self._ffn(params, hn.reshape(-1, e), p,
+                              tape=moe_tape).reshape(b, c, e)
         logits = self._head(params, x.reshape(-1, e)).reshape(
             b, c, self.vocab_size)
         return logits, self._pack_pool(pool, scales)

@@ -128,6 +128,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import chaos
 from .. import telemetry
@@ -135,6 +136,7 @@ from .. import tracing
 from ..base import MXNetError
 from ..context import Context
 from ..executor import AotCache
+from ..parallel.mesh import mesh_signature, submeshes
 from ..quant.codec import resolve as quant_resolve
 from .handoff import HandoffLanding, HandoffTicket, disagg_enabled
 from .journal import RequestJournal, journal_enabled
@@ -508,7 +510,30 @@ class ServingEngine:
         model.check_params(params)
         self.model = model
         self.name = name
-        if ctx is None:
+        # sub-mesh replica (docs/serving.md "Sharded replicas"): a Mesh
+        # ctx shards the params AND the paged KV pool over the mesh via
+        # NamedSharding/pjit, while every host-side structure — block
+        # tables, allocator, prefix cache, scheduling, the router's view
+        # — stays replica-global, so failover/respawn/journal/drain all
+        # compose unchanged.  MXNET_SERVE_SHARDED=0 is the kill-switch:
+        # a Mesh ctx degrades to its FIRST device, PR-19 single-device
+        # behavior bit for bit.
+        self._mesh = None
+        self._mesh_axis = None
+        if isinstance(ctx, Mesh):
+            if _env_flag("MXNET_SERVE_SHARDED"):
+                self._mesh = ctx
+                ax = os.environ.get("MXNET_SERVE_SHARDED_AXIS", "model")
+                self._mesh_axis = ax if ax in ctx.axis_names \
+                    else ctx.axis_names[0]
+            else:
+                ctx = np.asarray(ctx.devices).reshape(-1)[0]
+        if self._mesh is not None:
+            # launch operands and token outputs are REPLICATED over the
+            # mesh; _device doubles as that sharding so every existing
+            # _put/device_put site stages mesh-consistently for free
+            self._device = NamedSharding(self._mesh, PartitionSpec())
+        elif ctx is None:
             self._device = jax.devices()[0]
         elif isinstance(ctx, Context):
             self._device = ctx.jax_device()
@@ -602,9 +627,33 @@ class ServingEngine:
             # through (quantize_params is idempotent)
             params = model.quantize_params(params)
         jarr = getattr(jax, "Array", ())
-        self._params = {k: jax.device_put(
-            v if isinstance(v, jarr) else np.asarray(v), self._device)
-            for k, v in params.items()}
+        if self._mesh is not None:
+            # the trainer's auto-param-sharding rules, applied at load:
+            # tensor-parallel projections/head/expert banks, replicated
+            # norms (decode.param_shardings).  Respawn passes already-
+            # committed arrays — device_put onto the same sharding is a
+            # no-op, so recovery moves no bytes, same as single-device.
+            pshard = self.model.param_shardings(self._mesh,
+                                                self._mesh_axis)
+            self._kv_shard = self.model.kv_shardings(self._mesh,
+                                                     self._mesh_axis)
+            self._params = {k: jax.device_put(
+                v if isinstance(v, jarr) else np.asarray(v),
+                pshard.get(k, self._device))
+                for k, v in params.items()}
+        else:
+            self._kv_shard = None
+            self._params = {k: jax.device_put(
+                v if isinstance(v, jarr) else np.asarray(v), self._device)
+                for k, v in params.items()}
+        # per-expert decode telemetry (serve.<name>.expert_load.<i>):
+        # MoE programs return one extra (E,) counts row per launch,
+        # drained LAZILY into a host accumulator so the gauge never
+        # synchronizes an in-flight launch (megastep double-buffering)
+        self._moe = bool(getattr(self.model, "moe_experts", 0))
+        self._moe_pending = []
+        self._moe_load = (np.zeros((self.model.moe_experts,), np.int64)
+                          if self._moe else None)
         if self._paged:
             self._chunk_prefill = _env_flag("MXNET_SERVE_CHUNK_PREFILL") \
                 if chunk_prefill is None else bool(chunk_prefill)
@@ -641,7 +690,7 @@ class ServingEngine:
             self.n_blocks = nb
             self._alloc = BlockAllocator(nb, bs)
             self._cache = model.init_block_pool(nb, bs,
-                                                device=self._device)
+                                                device=self._kv_device())
             self._prefilling = {}  # row -> _Prefill (insertion-ordered)
             # cross-request prefix sharing (MXNET_SERVE_PREFIX=0 restores
             # single-owner paging bit-for-bit; MXNET_SERVE_PREFIX_POOL
@@ -688,7 +737,7 @@ class ServingEngine:
             self._landing = {}
             # slot max_batch is the trash slot padding rows write into
             self._cache = model.init_cache(self.max_batch + 1,
-                                           device=self._device)
+                                           device=self._kv_device())
             self._prefilling = {}
         # speculative decoding (MXNET_SERVE_SPEC, default off: the
         # PR-10 single-token decode path is bit-for-bit untouched at 0)
@@ -733,7 +782,11 @@ class ServingEngine:
                 raise MXNetError(
                     "ServingEngine: MXNET_SERVE_MEGASTEP_STEPS must be "
                     ">= 1, got %d" % self._mega_m)
-        self._aot = aot if aot is not None else AotCache("serve.aot")
+        # AotCache keys gain the mesh signature (executor._scoped): a
+        # 2-shard and a 4-shard replica compile DIFFERENT partitioned
+        # programs, so a shared cache must never cross their entries
+        self._aot = aot if aot is not None else AotCache(
+            "serve.aot", signature=mesh_signature(self._mesh))
         # gauges are namespaced per replica: engines share one process-wide
         # registry, and a global "serve.queue_depth" written by N scheduler
         # threads records whichever replica wrote last — neither any single
@@ -876,11 +929,15 @@ class ServingEngine:
             def build():
                 def prog(params, pool, tokens, start, length, tables,
                          *samp):
+                    tape = []
                     logits, pool = self.model.prefill_paged(
-                        params, pool, tokens, start, length, tables)
-                    return self._pick(logits, samp, start + length), pool
+                        params, pool, tokens, start, length, tables,
+                        moe_tape=tape)
+                    return (self._pick(logits, samp, start + length),
+                            pool) + self._moe_out(tape)
 
-                fn = jax.jit(prog, donate_argnums=(1,))
+                fn = self._jit(prog, (1,), ("repl", "cache")
+                               + ("repl",) * self._moe)
                 toks = self._put(np.zeros((1, s_bucket), np.int32))
                 zero = self._put(np.zeros((1,), np.int32))
                 one = self._put(np.ones((1,), np.int32))
@@ -894,11 +951,15 @@ class ServingEngine:
 
         def build():
             def prog(params, cache, tokens, length, slot, *samp):
-                logits, kv = self.model.prefill(params, tokens, length)
+                tape = []
+                logits, kv = self.model.prefill(params, tokens, length,
+                                                moe_tape=tape)
                 cache = self.model.write_prefill(cache, kv, length, slot)
-                return self._pick(logits, samp, length), cache
+                return (self._pick(logits, samp, length),
+                        cache) + self._moe_out(tape)
 
-            fn = jax.jit(prog, donate_argnums=(1,))
+            fn = self._jit(prog, (1,), ("repl", "cache")
+                           + ("repl",) * self._moe)
             toks = self._put(np.zeros((1, s_bucket), np.int32))
             one = self._put(np.ones((1,), np.int32))
             samp = tuple(self._put(a) for a in self._sample_placeholders(1))
@@ -911,11 +972,14 @@ class ServingEngine:
         if self._paged:
             def build():
                 def prog(params, pool, token, pos, tables, *samp):
+                    tape = []
                     logits, pool = self.model.decode_paged(
-                        params, pool, token, pos, tables)
-                    return self._pick(logits, samp, pos + 1), pool
+                        params, pool, token, pos, tables, moe_tape=tape)
+                    return (self._pick(logits, samp, pos + 1),
+                            pool) + self._moe_out(tape)
 
-                fn = jax.jit(prog, donate_argnums=(1,))
+                fn = self._jit(prog, (1,), ("repl", "cache")
+                               + ("repl",) * self._moe)
                 z = self._put(np.zeros((b_bucket,), np.int32))
                 tables = self._put(np.zeros((b_bucket, self._n_table),
                                             np.int32))
@@ -928,11 +992,15 @@ class ServingEngine:
 
         def build():
             def prog(params, cache, token, pos, slots, *samp):
+                tape = []
                 logits, cache = self.model.decode(params, cache, token,
-                                                  pos, slots)
-                return self._pick(logits, samp, pos + 1), cache
+                                                  pos, slots,
+                                                  moe_tape=tape)
+                return (self._pick(logits, samp, pos + 1),
+                        cache) + self._moe_out(tape)
 
-            fn = jax.jit(prog, donate_argnums=(1,))
+            fn = self._jit(prog, (1,), ("repl", "cache")
+                           + ("repl",) * self._moe)
             z = self._put(np.zeros((b_bucket,), np.int32))
             samp = tuple(self._put(a)
                          for a in self._sample_placeholders(b_bucket))
@@ -956,10 +1024,14 @@ class ServingEngine:
             def prog(params, pool, token, pos, left, eos, tables, *samp):
                 def pick(logits, newpos):
                     return self._pick(logits, samp, newpos)
-                return self.model.decode_megastep(
-                    params, pool, token, pos, left, eos, tables, m, pick)
+                tape = []
+                toks, pool = self.model.decode_megastep(
+                    params, pool, token, pos, left, eos, tables, m, pick,
+                    moe_tape=tape)
+                return (toks, pool) + self._moe_out(tape)
 
-            fn = jax.jit(prog, donate_argnums=(1,))
+            fn = self._jit(prog, (1,), ("repl", "cache")
+                           + ("repl",) * self._moe)
             z = self._put(np.zeros((b_bucket,), np.int32))
             tables = self._put(np.zeros((b_bucket, self._n_table),
                                         np.int32))
@@ -997,16 +1069,20 @@ class ServingEngine:
 
         def build():
             def prog(params, pool, tokens, pos, length, tables, *samp):
+                tape = []
                 logits, pool = self.model.verify_paged(
-                    params, pool, tokens, pos, length, tables)
+                    params, pool, tokens, pos, length, tables,
+                    moe_tape=tape)
                 picked = self._pick_cols(logits, samp, pos)
                 draft = tokens[:, 1:].astype(jnp.int32)
                 match = (picked[:, :-1] == draft).astype(jnp.int32)
                 acc = jnp.sum(jnp.cumprod(match, axis=1),
                               axis=1).astype(jnp.int32)
-                return jnp.concatenate([picked, acc[:, None]], axis=1), pool
+                return (jnp.concatenate([picked, acc[:, None]], axis=1),
+                        pool) + self._moe_out(tape)
 
-            fn = jax.jit(prog, donate_argnums=(1,))
+            fn = self._jit(prog, (1,), ("repl", "cache")
+                           + ("repl",) * self._moe)
             toks = self._put(np.zeros((b_bucket, c), np.int32))
             z = self._put(np.zeros((b_bucket,), np.int32))
             one = self._put(np.ones((b_bucket,), np.int32))
@@ -1038,7 +1114,7 @@ class ServingEngine:
             def prog(pool, src, dst):
                 return self.model.copy_block(pool, src, dst)
 
-            fn = jax.jit(prog, donate_argnums=(0,))
+            fn = self._jit(prog, (0,), ("cache",))
             z = self._put(np.zeros((1,), np.int32))
             return fn.lower(self._cache, z, z).compile()
 
@@ -1064,9 +1140,9 @@ class ServingEngine:
             def prog(pool, dst, data):
                 return self.model.write_block(pool, dst, data)
 
-            fn = jax.jit(prog, donate_argnums=(0,))
+            fn = self._jit(prog, (0,), ("cache",))
             z = self._put(np.zeros((kb,), np.int32))
-            d = self._put(self.model.block_run_placeholder(
+            d = self._put_run(self.model.block_run_placeholder(
                 kb, self.block_size))
             return fn.lower(self._cache, z, d).compile()
 
@@ -1096,7 +1172,131 @@ class ServingEngine:
                 ("dst", "data", "data_scale")[:1 + len(ph)])
 
     def _put(self, a):
+        """Host→device staging for launch operands: the single device —
+        or, on a sub-mesh replica, the REPLICATED mesh sharding
+        (`self._device` doubles as it).  Lowering bakes committed-input
+        shardings into the compiled executable's signature, so warmup
+        placeholders and live operands must stage identically — which
+        this one chokepoint (plus `_put_run` for block runs)
+        guarantees."""
         return jax.device_put(a, self._device)
+
+    def _put_run(self, data):
+        """Stage a packed K/V block run (the restore / handoff payload,
+        an array or the (int8 data, scales) pair): sharded exactly like
+        the pool it scatters into on a sub-mesh replica — the run's
+        trailing axis IS the pool's embed axis — replicated `_put`
+        otherwise.  Used by both the live staging sites and
+        `_compiled_restore`'s lowering placeholder, so the compiled
+        scatter's committed-input sharding always matches."""
+        if self._mesh is None:
+            return self._put(data)
+        psh, ssh = self._kv_shard
+        if isinstance(data, tuple):
+            return (jax.device_put(data[0], psh),
+                    jax.device_put(data[1], ssh))
+        return jax.device_put(data, psh)
+
+    def _kv_device(self):
+        """Placement for the K/V buffers: the (pool, scales) sharding
+        pair on a sub-mesh replica — `init_block_pool`/`init_cache`
+        split it — the plain device otherwise."""
+        return self._device if self._mesh is None else self._kv_shard
+
+    def _cache_sharding(self):
+        """The sharding pytree of `self._cache` as the compiled
+        programs see it (mesh mode only): the (pool, scales) pair under
+        KV quant, the single pool/slot-cache sharding otherwise."""
+        psh, ssh = self._kv_shard
+        if self._paged and self.model.kv_quant is not None:
+            return (psh, ssh)
+        return psh
+
+    def _jit(self, prog, donate, outs):
+        """`jax.jit` with EXPLICIT output shardings on a sub-mesh
+        replica — the pjit leg of the tentpole: the donated cache comes
+        back in its input sharding (anything else would defeat
+        donation) and token/count outputs land replicated for the
+        host's one-fetch-per-step discipline.  ``outs`` names each
+        output: "repl" or "cache".  Single-device engines build the
+        exact PR-19 jit — byte-identical programs."""
+        if self._mesh is None:
+            return jax.jit(prog, donate_argnums=donate)
+        m = {"repl": self._device, "cache": self._cache_sharding()}
+        sh = tuple(m[o] for o in outs)
+        return jax.jit(prog, donate_argnums=donate,
+                       out_shardings=sh if len(sh) > 1 else sh[0])
+
+    def _moe_out(self, tape):
+        """The MoE programs' extra output: the launch's per-expert
+        routed-token counts, summed over layers into ONE (E,) row.
+        Dense models return () — their programs stay byte-identical
+        to PR 19."""
+        if not self._moe:
+            return ()
+        return (jnp.sum(jnp.stack(tape), axis=0),)
+
+    def _unpack(self, out):
+        """Split a compiled launch's outputs into (tokens, new_cache),
+        diverting a MoE program's counts row into the pending list
+        WITHOUT synchronizing — `_drain_moe` folds all but the newest
+        entry later, so megastep double-buffering keeps its overlap."""
+        if self._moe:
+            first, cache, counts = out
+            self._moe_pending.append(counts)
+            return first, cache
+        return out
+
+    def _drain_moe(self, keep_last=True):
+        """Fold pending per-launch expert-count rows into the host
+        accumulator and publish the `serve.<name>.expert_load.<i>`
+        gauges.  ``keep_last`` leaves the newest row pending — it may
+        belong to a launch still in flight."""
+        if not self._moe:
+            return
+        pend = self._moe_pending
+        n = len(pend) - 1 if keep_last else len(pend)
+        if n <= 0:
+            return
+        for a in pend[:n]:
+            self._moe_load += np.asarray(a)
+        del pend[:n]
+        for i, v in enumerate(self._moe_load):
+            telemetry.set_gauge(self._gauge + "expert_load.%s" % i,
+                                int(v))
+
+    def expert_load(self):
+        """Cumulative per-expert routed-token counts as a host array
+        (None for dense models).  Drains every pending launch —
+        synchronizes, so it's a bench/test/report surface, not a
+        scheduler-loop call."""
+        if not self._moe:
+            return None
+        self._drain_moe(keep_last=False)
+        return self._moe_load.copy()
+
+    def memory_footprint(self):
+        """Device-memory accounting for params + K/V buffers:
+        ``total_bytes`` (the whole replica) vs ``per_device_bytes``
+        (the largest single device's share).  The nightly sharded
+        gate's proof obligation reads off this: a config serves on the
+        sub-mesh exactly when per_device_bytes fits one device's HBM
+        even though total_bytes does not."""
+        per = {}
+        total = 0
+        for a in jax.tree_util.tree_leaves((self._params, self._cache)):
+            if not hasattr(a, "dtype"):
+                continue
+            total += int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+            for s in getattr(a, "addressable_shards", ()) or ():
+                nb = int(np.prod(s.data.shape)) \
+                    * np.dtype(s.data.dtype).itemsize
+                d = getattr(s, "device", None)
+                per[d] = per.get(d, 0) + nb
+        return {"total_bytes": int(total),
+                "per_device_bytes": int(max(per.values()) if per
+                                        else total),
+                "devices": len(per) if per else 1}
 
     def _prefill_watch_arrays(self, s):
         """(arrays, names) of a prefill launch at bucket ``s`` — the
@@ -1218,7 +1418,8 @@ class ServingEngine:
         replica off a live one, which must not collide with it in the
         per-replica gauges or the chaos step counters."""
         return ServingEngine(
-            self.model, self._params, ctx=self._device,
+            self.model, self._params,
+            ctx=self._mesh if self._mesh is not None else self._device,
             max_batch=self.max_batch,
             decode_buckets=list(self.decode_buckets),
             prefill_buckets=list(self.prefill_buckets),
@@ -1932,13 +2133,13 @@ class ServingEngine:
                 telemetry.set_gauge(self._gauge + "host_blocks_used", 0)
             self._alloc.reset()
             self._cache = self.model.init_block_pool(
-                self.n_blocks, self.block_size, device=self._device)
+                self.n_blocks, self.block_size, device=self._kv_device())
             if self._drafter is not None:
                 self._drafter.on_cache_rebuild()
             self._block_gauges()
         else:
             self._cache = self.model.init_cache(self.max_batch + 1,
-                                                device=self._device)
+                                                device=self._kv_device())
         self._count("cache_rebuilds")
         telemetry.record_event("serve_cache_rebuild", replica=self.name,
                                reason=reason[:200])
@@ -1992,8 +2193,8 @@ class ServingEngine:
             self._quarantine(req, "prefill setup failed: %s" % e)
             return True
         try:
-            first, self._cache = compiled(self._params, self._cache, toks_d,
-                                          length, slot_d, *samp)
+            first, self._cache = self._unpack(compiled(
+                self._params, self._cache, toks_d, length, slot_d, *samp))
             first = int(np.asarray(first)[0])
         except Exception as e:
             self._free.append(slot)
@@ -2136,7 +2337,7 @@ class ServingEngine:
             dsts[:len(dst)] = dst
             self._restoring[row] = _Restore(req, row, list(tokens), blocks,
                                             matched, nodes, handles,
-                                            self._put(data),
+                                            self._put_run(data),
                                             self._put(dsts), dst, kb,
                                             t_stage=t_stage)
             tracing.phase(req.id, "restore_wait", self.name, t=t_stage,
@@ -2484,7 +2685,7 @@ class ServingEngine:
             dsts = np.full((ticket.kb,), TRASH_BLOCK, np.int32)
             dsts[:ticket.k] = fresh[:ticket.k]
             self._landing[row] = HandoffLanding(
-                ticket, row, fresh, self._put(ticket.data),
+                ticket, row, fresh, self._put_run(ticket.data),
                 self._put(dsts))
             self._block_gauges()
 
@@ -2628,8 +2829,9 @@ class ServingEngine:
             self._quarantine(req, "prefill setup failed: %s" % e)
             return
         try:
-            tok, self._cache = compiled(self._params, self._cache, toks_d,
-                                        start_d, length_d, table_d, *samp)
+            tok, self._cache = self._unpack(compiled(
+                self._params, self._cache, toks_d, start_d, length_d,
+                table_d, *samp))
         except Exception as e:
             kind = self._classify_failure(e)
             if kind == "device":
@@ -3127,6 +3329,9 @@ class ServingEngine:
         (0 = idle)."""
         t0 = time.perf_counter()
         h0 = self.stats["hidden_s"]
+        # fold settled expert-load rows (all but the newest — it may
+        # still be in flight) into the per-expert gauges
+        self._drain_moe()
         if self._mega_m and not self._spec:
             n = self._step_mega()
         else:
@@ -3255,7 +3460,8 @@ class ServingEngine:
         try:
             if chaos.serve_launch_error():
                 raise chaos.ChaosError("chaos: injected decode launch error")
-            nxt, self._cache = compiled(self._params, self._cache, *args)
+            nxt, self._cache = self._unpack(
+                compiled(self._params, self._cache, *args))
         except Exception as e:
             # scoped/transient: the donated cache survived — retry the
             # same decode next iteration, escalate after N consecutive
@@ -3416,7 +3622,8 @@ class ServingEngine:
             if chaos.serve_launch_error():
                 raise chaos.ChaosError(
                     "chaos: injected megastep launch error")
-            out, self._cache = compiled(self._params, self._cache, *args)
+            out, self._cache = self._unpack(
+                compiled(self._params, self._cache, *args))
         except Exception as e:
             self._handle_launch_failure(e, "megastep")
             return None
@@ -3646,7 +3853,8 @@ class ServingEngine:
             if chaos.serve_launch_error():
                 raise chaos.ChaosError("chaos: injected verify launch "
                                        "error")
-            out, self._cache = compiled(self._params, self._cache, *args)
+            out, self._cache = self._unpack(
+                compiled(self._params, self._cache, *args))
         except Exception as e:
             self._handle_launch_failure(e, "verify")
             return len(self._active) + self._pending_work()
@@ -3977,11 +4185,15 @@ class ReplicaRouter:
     """Least-depth dispatch over per-device engine replicas, with health
     monitoring, failover, and respawn.
 
-    Each replica owns a full parameter copy and its own queue/cache — the
-    NamedSharding-tree scale-out (SNIPPETS [3]) degenerates to replicated
-    params per device for serving, where requests are independent and the
-    win is N concurrent batches, not one sharded one.  `from_mesh` builds
-    one engine per device of a mesh (row-major over the first axis).
+    A replica is one device holding full params — OR a sub-mesh of
+    ``devices_per_replica`` devices over which one engine shards its
+    params and paged KV pool via NamedSharding/pjit (docs/serving.md
+    "Sharded replicas"): models bigger than one chip serve as ONE
+    replica here, and every failover/respawn/journal/drain mechanism
+    below composes unchanged because the router only ever sees the
+    engine, never the mesh.  `from_mesh` builds one engine per device
+    (row-major over the first axis), or one engine per consecutive
+    ``devices_per_replica``-device sub-mesh.
 
     Partial failure is the normal case: when a replica's scheduler dies,
     its queued-but-not-admitted requests re-dispatch to survivors, its
@@ -4067,15 +4279,24 @@ class ReplicaRouter:
 
     @classmethod
     def from_mesh(cls, model, params, mesh=None, n_replicas=None,
-                  respawn=None, journal=None, disagg=None,
-                  prefill_replicas=None, **kw):
+                  devices_per_replica=None, respawn=None, journal=None,
+                  disagg=None, prefill_replicas=None, **kw):
         devices = (list(np.asarray(mesh.devices).reshape(-1))
                    if mesh is not None else jax.devices())
+        k = int(os.environ.get("MXNET_SERVE_SHARDED_DEVICES", "1")
+                if devices_per_replica is None else devices_per_replica)
+        if k > 1:
+            # sub-mesh replicas: consecutive k-device groups, each ONE
+            # sharded engine (a remainder that can't fill a group is
+            # dropped — parallel.mesh.submeshes)
+            ctxs = submeshes(devices, k)
+        else:
+            ctxs = devices
         if n_replicas is not None:
-            devices = devices[:int(n_replicas)]
-        engines = [ServingEngine(model, params, ctx=d,
+            ctxs = ctxs[:int(n_replicas)]
+        engines = [ServingEngine(model, params, ctx=c,
                                  name="replica%d" % i, **kw)
-                   for i, d in enumerate(devices)]
+                   for i, c in enumerate(ctxs)]
         return cls(engines, respawn=respawn, journal=journal,
                    disagg=disagg, prefill_replicas=prefill_replicas)
 

@@ -35,6 +35,15 @@ regenerates only tokens that were never appended).
 
 ``MXNET_SERVE_DISAGG=0`` (the default) is the colocated fleet bit for
 bit: no roles, no tickets, no new dispatch order.
+
+Sub-mesh replicas (docs/serving.md "Sharded replicas") compose for
+free: a ticket's ``data`` is a FULL-embed host numpy run — the pack
+side gathers every shard of its pool (np.asarray on a sharded array
+assembles the global view) and the landing side stages with its own
+engine's ``_put_run``, which re-splits the embed axis over the
+receiver's mesh.  Shard counts therefore never have to match across
+the role boundary: a 1-device prefill replica can hand off to a
+4-shard decode replica and vice versa.
 """
 from __future__ import annotations
 

@@ -65,6 +65,13 @@ as the slot free-list it replaces); `alloc` returning None — pool
 exhausted, or the `block_exhaust:P` chaos clause denying the attempt —
 is a NORMAL outcome the engine answers with a typed shed / requeue /
 preemption, never a hang.
+
+SUB-MESH sharding (docs/serving.md "Sharded replicas") is invisible
+here: when a `ServingEngine` spans a device mesh, the pool's embed
+axis E is split over the mesh while block ids, the block tables, this
+allocator, and the `PrefixCache` stay whole-pool host-side — every
+count and refcount below describes LOGICAL blocks, each physically
+striped across all shards.  `pool_bytes` reports both views.
 """
 from __future__ import annotations
 
@@ -74,6 +81,26 @@ from .. import chaos
 from ..base import MXNetError
 
 TRASH_BLOCK = 0
+
+
+def pool_bytes(num_layers, n_blocks, block_size, num_embed, itemsize=4,
+               quant=False, shards=1):
+    """Device bytes of the paged K/V pool
+    `(num_layers, 2, n_blocks, block_size, num_embed)` — the sizing
+    arithmetic the nightly HBM-accounting gate and `bench.py --serve
+    --sharded` use without materialising arrays.  `quant` prices the
+    int8 pool plus its f32 per-(block, position) scales; `shards > 1`
+    returns the PER-DEVICE bytes of a sub-mesh replica (embed axis
+    split; scales replicated, matching `kv_shardings`)."""
+    elems = int(num_layers) * 2 * int(n_blocks) * int(block_size)
+    num_embed, shards = int(num_embed), int(shards)
+    # non-divisible embed falls back to a replicated pool (kv_shardings)
+    per_dev_embed = num_embed // shards if num_embed % shards == 0 \
+        else num_embed
+    if quant:
+        # int8 payload + replicated f32 scale per (L, 2, block, pos)
+        return elems * per_dev_embed + elems * 4
+    return elems * per_dev_embed * int(itemsize)
 
 
 class BlockAllocator:

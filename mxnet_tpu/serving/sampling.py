@@ -52,6 +52,12 @@ def _mask_top_k_top_p(scaled, top_k, top_p):
     csum = jnp.cumsum(probs, axis=-1)
     p_eff = jnp.clip(top_p.astype(jnp.float32), 0.0, 1.0)[:, None]
     keep = (csum - probs) < p_eff          # mass BEFORE the token < p
+    # a k-masked tail entry must NEVER survive into `keep`: its prob is
+    # exactly 0, so its mass-before is the TOTAL mass — whether that
+    # compares < 1.0 is float-rounding luck (a partitioned cumsum on a
+    # sub-mesh replica rounds differently than one device), and one tail
+    # survivor makes thr = -inf, silently disabling top-k entirely
+    keep = keep & jnp.isfinite(desc_k)
     # the smallest surviving logit bounds both filters (it lives inside
     # the top-k prefix, so scaled >= thr implies the k-mask too)
     thr = jnp.min(jnp.where(keep, desc_k, jnp.inf), axis=-1, keepdims=True)

@@ -48,6 +48,13 @@ evict-and-destroy behavior bit for bit.
 Threading contract: scheduler thread only, like `BlockAllocator` —
 every mutation happens between compiled launches of the engine that
 owns the pool the blocks came from.
+
+The tier is SHARD-AGNOSTIC (docs/serving.md "Sharded replicas"): a
+sub-mesh engine spills full-embed host copies (reading one block of a
+sharded pool assembles the global view) and restores through its own
+``_put_run``, which re-splits the embed axis over the mesh — so host
+handles minted by a 1-device engine restore fine into a 4-shard one
+after a respawn changed the replica's geometry.
 """
 from __future__ import annotations
 

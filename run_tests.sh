@@ -111,6 +111,17 @@ elif [ "$1" = "--serve-disagg-smoke" ]; then
     T1=""
     set -- tests/test_serve_disagg.py -q -m 'not slow' \
         -p no:cacheprovider "$@"
+elif [ "$1" = "--serve-sharded-smoke" ]; then
+    # fast sub-mesh replica smoke: single-device-oracle token parity on a
+    # multi-device CPU mesh (T=0 and seeded T>0), the
+    # MXNET_SERVE_SHARDED=0 kill-switch, per-shard-count zero-retrace
+    # gates, chaos with a sub-mesh replica in the fleet, and
+    # expert-parallel MoE decode parity + load telemetry
+    # (docs/serving.md "Sharded replicas")
+    shift
+    T1=""
+    set -- tests/test_serve_sharded.py -q -m 'not slow' \
+        -p no:cacheprovider "$@"
 elif [ "$1" = "--serve-chaos-smoke" ]; then
     # fast serving-resilience smoke: deadlines/cancellation, overload
     # policies, quarantine + cache-rebuild scoping, router failover and

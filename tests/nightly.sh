@@ -541,6 +541,68 @@ PY
 # unit coverage (run_tests.sh --serve-disagg-smoke)
 ./run_tests.sh --serve-disagg-smoke
 
+# -- sharded-replica serve gate (docs/serving.md "Sharded replicas") ------
+# equal-chip A/B on the CPU mesh with an expert-parallel MoE model:
+# k single-device replicas (each holding the FULL model — only possible
+# here because the virtual CPU devices share host RAM) vs ONE k-device
+# sub-mesh replica.  The AOT memory accounting is the existence proof
+# the sharded path exists for: a synthetic per-chip budget strictly
+# between the sharded leg's per-device slice and the replicated leg's
+# full-model footprint names a config that CANNOT serve unsharded but
+# serves sharded — with greedy token parity request-for-request, zero
+# leaked blocks, and zero steady-state recompiles on both legs (every
+# pjit launch joins the frozen per-mesh-signature warmup set);
+# artifact lands in bench_results/serve_bench.json
+env PYTHONPATH= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    SERVE_REQUESTS=24 SERVE_RATE=12 SERVE_SEQ=64 SERVE_NEW=8 \
+    SERVE_PROMPT_MAX=16 SERVE_EMBED=256 SERVE_HEADS=4 \
+    SERVE_SHARD_DEVICES=4 SERVE_MOE_EXPERTS=4 \
+    python bench.py --serve --sharded | tee /tmp/nightly_sharded.log
+python - <<'PY'
+import json
+rec = json.loads(
+    open("/tmp/nightly_sharded.log").read().strip().splitlines()[-1])
+rep, sha = rec["replicated"], rec["sharded"]
+for leg, r in (("replicated", rep), ("sharded", sha)):
+    assert r["completed"] == r["requests"], \
+        "sharded gate (%s): %s/%s completed (errors: %s)" % (
+            leg, r["completed"], r["requests"], r.get("errors"))
+    assert r["steady_state_recompiles"] == 0, \
+        "sharded gate (%s): %d steady-state recompiles" % (
+            leg, r["steady_state_recompiles"])
+    assert r["steady_state_retrace_events"] == 0, \
+        "sharded gate (%s): watchdog fired %d times" % (
+            leg, r["steady_state_retrace_events"])
+    assert r["blocks"]["leaked"] == 0, \
+        "sharded gate (%s): %d blocks leaked" % (leg, r["blocks"]["leaked"])
+assert rec["parity"], \
+    "sharded gate: outputs diverged between replicated and sharded legs"
+rep_dev = rep["memory"]["per_device_bytes"]
+sha_dev = sha["memory"]["per_device_bytes"]
+# the sub-mesh must buy REAL per-chip headroom: at least a third of the
+# full-model footprint (params + the KV pool's embed axis split k ways;
+# replicated norms/tables keep it from 1/k exactly)
+assert sha_dev <= rep_dev * 2 / 3, \
+    "sharded gate: per-device %s bytes is not under 2/3 of the " \
+    "full-model %s — sharding bought no memory headroom" % (
+        sha_dev, rep_dev)
+budget = (sha_dev + rep_dev) // 2
+moe = sha["moe"]
+assert moe and moe["experts"] == 4 and sum(moe["expert_load"]) > 0, \
+    "sharded gate: expert-parallel decode routed nothing (%s)" % (moe,)
+print("sharded gate passed: tok/s/chip ratio %s, per-device %s -> %s "
+      "bytes (a %s-byte chip serves ONLY sharded), moe imbalance %s" % (
+          rec["value"], rep_dev, sha_dev, budget,
+          moe["load_imbalance"]))
+PY
+
+# -- sharded smoke: oracle parity (T=0 + seeded T>0), kill-switch
+# bit-parity, per-shard-count zero-retrace, chaos with a sub-mesh
+# replica, MoE expert-parallel unit coverage
+# (run_tests.sh --serve-sharded-smoke)
+./run_tests.sh --serve-sharded-smoke
+
 # -- tracing gate (docs/observability.md "Request tracing") ---------------
 # tracing-on vs MXNET_SERVE_TRACING=0 at equal everything on the disagg
 # burst trace: traced tok/s within 3% of untraced, output_sig bit for

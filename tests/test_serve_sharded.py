@@ -1,0 +1,371 @@
+"""Sub-mesh serving replicas (ISSUE-21): one engine sharded over a
+named device mesh, treated by the router as ONE replica.
+
+Contracts under test:
+
+1. `submeshes`/`mesh_signature` geometry: consecutive device groups,
+   remainder dropped, signatures distinguish shard counts, and
+   `AotCache` keys scope by signature (a 2-shard and a 4-shard cache
+   cannot collide).
+2. Sharded parity: an engine over a 2- and a 4-device CPU mesh
+   produces token-for-token the single-device oracle's output at T=0
+   AND under seeded T>0 sampling (GSPMD partitions the same program —
+   numerics are the oracle's bit for bit).
+3. Kill-switch: `MXNET_SERVE_SHARDED=0` degrades a Mesh ctx to its
+   first device — no mesh state, no sharded placement, PR-19
+   single-device serving bit for bit.
+4. Zero-steady-state compiles per shard count: after warmup nothing
+   compiles while serving, `frozen_compiles` stays 0, and no
+   serving-site retrace events appear.
+5. Memory accounting: `memory_footprint()` proves the per-device
+   share of params+KV shrinks with the shard count — the "model
+   bigger than one chip" existence proof the nightly gate sizes.
+6. Fleet composition: `from_mesh(devices_per_replica=k)` builds
+   sub-mesh replicas; `engine_crash` + `block_exhaust` chaos with a
+   sub-mesh replica in the fleet resolves every request (tokens or
+   typed), respawn keeps the mesh, zero leaks on survivors.
+7. Expert-parallel MoE decode: a `moe_experts` model sharded over the
+   mesh matches the dense-replicated single-device oracle token for
+   token, and per-expert `serve.<name>.expert_load.<e>` gauges count
+   every decoded token's dispatch.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from mxnet_tpu import chaos, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.executor import AotCache
+from mxnet_tpu.parallel.mesh import make_mesh, mesh_signature, submeshes
+from mxnet_tpu.serving import (ReplicaRouter, ServingEngine,
+                               TransformerKVModel, ServeError)
+
+V, S, L, H, E = 61, 32, 2, 2, 32
+
+
+@pytest.fixture
+def model_and_params():
+    model = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E)
+    return model, model.init_params(np.random.RandomState(7))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("MXNET_CHAOS", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_SHARDED", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_SHARDED_AXIS", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_SHARDED_DEVICES", raising=False)
+    monkeypatch.setenv("MXNET_CHAOS_SEED", "0")
+    telemetry.reset()
+    chaos.reset()
+    yield
+    telemetry.reset()
+    chaos.reset()
+
+
+def _engine(model, params, name=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("sampling", False)
+    eng = ServingEngine(model, params, **kw)
+    if name is not None:
+        eng.name = name
+        eng._gauge = "serve.%s." % name
+    return eng
+
+
+def _serve(eng, submits, timeout=300):
+    """Run (prompt, kwargs) pairs to completion on a bare engine."""
+    reqs = [eng.submit(p, **kw) for p, kw in submits]
+    eng.run_until_idle(timeout=timeout)
+    return [r.result(1) for r in reqs]
+
+
+_oracle_state = {}
+
+
+def _oracle(model, params, prompt, max_new, **kw):
+    """Single-device truth for one request."""
+    key = (tuple(prompt), max_new, tuple(sorted(kw.items())))
+    if key not in _oracle_state:
+        eng = _oracle_state.get("engine")
+        if eng is None:
+            eng = _oracle_state["engine"] = _engine(
+                model, params, max_batch=1, sampling=True)
+        req = eng.submit(prompt, max_new_tokens=max_new, **kw)
+        eng.run_until_idle(timeout=300)
+        _oracle_state[key] = req.result(1)
+    return _oracle_state[key]
+
+
+# ---------------------------------------------------------------------------
+# 1. mesh geometry + AOT cache scoping
+# ---------------------------------------------------------------------------
+
+def test_submeshes_consecutive_groups():
+    devs = jax.devices()
+    ms = submeshes(devs, 2)
+    assert len(ms) == len(devs) // 2
+    flat = [d for m in ms for d in np.asarray(m.devices).reshape(-1)]
+    assert flat == devs[:len(flat)]          # consecutive, in order
+    assert all(m.axis_names == ("model",) for m in ms)
+
+
+def test_submeshes_remainder_dropped_and_too_few_raises():
+    devs = jax.devices()
+    assert len(submeshes(devs, 3)) == len(devs) // 3
+    with pytest.raises(MXNetError, match="sub-mesh"):
+        submeshes(devs[:1], 4)
+
+
+def test_mesh_signature_distinguishes_shard_counts():
+    assert mesh_signature(None) == ()
+    s2 = mesh_signature(submeshes(jax.devices(), 2)[0])
+    s4 = mesh_signature(submeshes(jax.devices(), 4)[0])
+    assert s2 != s4
+    # two DIFFERENT 2-device groups share one program space
+    assert mesh_signature(submeshes(jax.devices(), 2)[1]) == s2
+
+
+def test_aot_cache_keys_scope_by_signature():
+    plain = AotCache("t")
+    signed = AotCache("t", signature=mesh_signature(
+        submeshes(jax.devices(), 2)[0]))
+    assert plain.get(("decode", 4, 1), build=lambda: "a") == "a"
+    assert signed.get(("decode", 4, 1), build=lambda: "b") == "b"
+    assert plain.get(("decode", 4, 1)) == "a"
+    assert signed.get(("decode", 4, 1)) == "b"
+    assert set(plain.keys()).isdisjoint(signed.keys())
+
+
+# ---------------------------------------------------------------------------
+# 2. sharded parity vs the single-device oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_sharded_parity_t0(model_and_params, shards):
+    model, params = model_and_params
+    prompts = [[3, 4, 5], [7, 8], [9] * 6, [2], [5, 6, 7, 8, 9]]
+    want = [_oracle(model, params, p, 6) for p in prompts]
+    mesh = submeshes(jax.devices(), shards)[0]
+    eng = _engine(model, params, name="shard%d" % shards, ctx=mesh)
+    assert eng._mesh is mesh
+    eng.start()
+    try:
+        got = _serve(eng, [(p, {"max_new_tokens": 6}) for p in prompts])
+    finally:
+        eng.stop()
+    assert got == want
+    assert eng.leaked_blocks() == 0
+
+
+def test_sharded_parity_seeded_sampling(model_and_params):
+    """T>0: same program, same request-keyed RNG — the sampled
+    continuation is identical across shard counts."""
+    model, params = model_and_params
+    prompts = [[3, 4, 5], [7, 8, 9, 10], [2] * 5]
+    kw = {"temperature": 0.8, "top_k": 8}
+    want = [_oracle(model, params, p, 6, seed=100 + i, **kw)
+            for i, p in enumerate(prompts)]
+    mesh = submeshes(jax.devices(), 2)[0]
+    eng = _engine(model, params, ctx=mesh, sampling=True)
+    eng.start()
+    try:
+        got = _serve(eng, [(p, dict(kw, max_new_tokens=6, seed=100 + i))
+                           for i, p in enumerate(prompts)])
+    finally:
+        eng.stop()
+    assert got == want
+    assert eng.leaked_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. kill-switch
+# ---------------------------------------------------------------------------
+
+def test_kill_switch_restores_single_device(model_and_params, monkeypatch):
+    """MXNET_SERVE_SHARDED=0: a Mesh ctx degrades to its first device —
+    no mesh state, no sharded placement, PR-19 serving bit for bit."""
+    model, params = model_and_params
+    prompts = [[3, 4, 5], [7, 8], [9] * 6]
+    want = [_oracle(model, params, p, 6) for p in prompts]
+    monkeypatch.setenv("MXNET_SERVE_SHARDED", "0")
+    mesh = submeshes(jax.devices(), 4)[0]
+    eng = _engine(model, params, ctx=mesh)
+    assert eng._mesh is None
+    assert eng._kv_shard is None
+    assert eng._aot.signature == ()          # unscoped cache keys
+    assert eng.memory_footprint()["devices"] == 1
+    eng.start()
+    try:
+        got = _serve(eng, [(p, {"max_new_tokens": 6}) for p in prompts])
+    finally:
+        eng.stop()
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# 4. zero steady-state compiles per shard count
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_zero_steady_state_compiles(model_and_params, shards):
+    model, params = model_and_params
+    mesh = submeshes(jax.devices(), shards)[0]
+    eng = _engine(model, params, ctx=mesh)
+    eng.warmup()
+    reg = telemetry.registry()
+    compiles = reg.counter("serve.aot.compiles").value
+    eng.start()
+    try:
+        _serve(eng, [(p, {"max_new_tokens": 6})
+                     for p in ([3, 4, 5], [7, 8], [9] * 6, [2] * 9)])
+    finally:
+        eng.stop()
+    assert reg.counter("serve.aot.compiles").value == compiles
+    assert reg.counter("serve.aot.frozen_compiles").value == 0
+    assert [e for e in telemetry.events("retrace")
+            if str(e.get("site", "")).startswith("serving.")] == []
+    # every frozen key carries this mesh's signature
+    sig = mesh_signature(mesh)
+    assert eng._aot.signature == sig
+    assert all(k[-len(sig):] == sig for k in eng._aot.keys())
+
+
+# ---------------------------------------------------------------------------
+# 5. memory accounting: the per-device share shrinks with shards
+# ---------------------------------------------------------------------------
+
+def test_memory_footprint_shrinks_per_device(model_and_params):
+    model, params = model_and_params
+    single = _engine(model, params)
+    mf1 = single.memory_footprint()
+    single.stop()
+    per_dev = [mf1["per_device_bytes"]]
+    for shards in (2, 4):
+        eng = _engine(model, params,
+                      ctx=submeshes(jax.devices(), shards)[0])
+        mf = eng.memory_footprint()
+        eng.stop()
+        assert mf["devices"] == shards
+        # total is conserved (sharding relocates bytes, params stay put)
+        assert mf["total_bytes"] == mf1["total_bytes"]
+        per_dev.append(mf["per_device_bytes"])
+    # strictly decreasing: 1 > 2 > 4 shards — a config whose footprint
+    # exceeds one device's HBM fits once the shard count is high enough
+    assert per_dev[0] > per_dev[1] > per_dev[2]
+
+
+# ---------------------------------------------------------------------------
+# 6. fleet composition + chaos with a sub-mesh replica
+# ---------------------------------------------------------------------------
+
+def test_from_mesh_devices_per_replica(model_and_params):
+    model, params = model_and_params
+    router = ReplicaRouter.from_mesh(
+        model, params, devices_per_replica=2, n_replicas=2,
+        max_batch=4, prefill_buckets=[8, 16], max_new_tokens=6,
+        sampling=False, respawn=False)
+    try:
+        assert len(router.engines) == 2
+        for e in router.engines:
+            assert e._mesh is not None
+            assert int(np.asarray(e._mesh.devices).size) == 2
+        # distinct device groups, same program space
+        sigs = {mesh_signature(e._mesh) for e in router.engines}
+        assert len(sigs) == 1
+        meshes = {tuple(np.asarray(e._mesh.devices).reshape(-1))
+                  for e in router.engines}
+        assert len(meshes) == 2
+    finally:
+        router.stop()
+
+
+def test_chaos_with_submesh_replica(model_and_params, monkeypatch):
+    """engine_crash + block_exhaust against a fleet whose replicas are
+    2-device sub-meshes: every request resolves (tokens or typed), the
+    respawned replacement keeps its mesh width, survivors leak
+    nothing."""
+    model, params = model_and_params
+    router = ReplicaRouter.from_mesh(
+        model, params, devices_per_replica=2, n_replicas=2,
+        max_batch=4, prefill_buckets=[8, 16], max_new_tokens=6,
+        sampling=False, respawn=True, n_blocks=24, block_size=8)
+    router.warmup()
+    monkeypatch.setenv("MXNET_CHAOS",
+                       "engine_crash:3:replica0,block_exhaust:0.05")
+    chaos.reset()
+    rng = np.random.RandomState(5)
+    router.start()
+    try:
+        reqs = [router.submit(list(rng.randint(1, V, size=rng.randint(2, 9))),
+                              max_new_tokens=6, deadline_ms=120000)
+                for _ in range(10)]
+        done = typed = 0
+        for r in reqs:
+            try:
+                r.result(timeout=300)
+                done += 1
+            except ServeError:
+                typed += 1
+    finally:
+        router.stop()
+    assert done + typed == len(reqs)         # nothing hung
+    assert done > 0
+    for e in router.engines:
+        if e._dead is None:
+            assert e.leaked_blocks() == 0
+            assert e._mesh is not None       # respawn kept the sub-mesh
+            assert int(np.asarray(e._mesh.devices).size) == 2
+
+
+# ---------------------------------------------------------------------------
+# 7. expert-parallel MoE decode
+# ---------------------------------------------------------------------------
+
+def test_moe_sharded_parity_and_expert_load(model_and_params):
+    """A moe_experts model over a 4-device mesh (experts sharded via
+    the mesh axis) matches the dense-replicated single-device engine
+    token for token, and the per-expert load gauges account every
+    decoded token across both."""
+    moe = TransformerKVModel(V, S, num_layers=L, num_heads=H, num_embed=E,
+                             moe_experts=4)
+    mparams = moe.init_params(np.random.RandomState(7))
+    prompts = [[3, 4, 5], [7, 8], [9] * 6]
+
+    ref = _engine(moe, mparams, name="moe_ref")
+    ref.start()
+    try:
+        want = _serve(ref, [(p, {"max_new_tokens": 6}) for p in prompts])
+        load_ref = ref.expert_load()
+    finally:
+        ref.stop()
+
+    mesh = submeshes(jax.devices(), 4)[0]
+    eng = _engine(moe, mparams, name="moe_mesh", ctx=mesh)
+    eng.start()
+    try:
+        got = _serve(eng, [(p, {"max_new_tokens": 6}) for p in prompts])
+        load = eng.expert_load()
+    finally:
+        eng.stop()
+
+    assert got == want
+    assert load is not None and load.shape == (4,)
+    assert (load == load_ref).all()          # dispatch is topology-free
+    assert load.sum() > 0
+    reg = telemetry.registry()
+    total = sum(reg.gauge("serve.moe_mesh.expert_load.%d" % e).value
+                for e in range(4))
+    assert total == int(load.sum())
+
+
+def test_dense_engine_has_no_expert_load(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    try:
+        assert eng.expert_load() is None
+    finally:
+        eng.stop()

@@ -133,6 +133,14 @@ SERVE_GATEWAY_HIST = "serve.gateway.ttfb_ms"
 SERVE_GATEWAY_EVENT_KINDS = ("serve_gateway_cancel", "serve_scale_up",
                              "serve_scale_down", "serve_sessions_migrated")
 
+# mixture-of-experts accounting (docs/serving.md "Sharded replicas" +
+# parallel/moe.py): per-expert dispatch counters, capacity-overflow drops
+# (those tokens' FFN output is silently zero), and the serving engines'
+# per-replica expert-load gauges (serve.<name>.expert_load.<e>)
+MOE_DISPATCH_PREFIX = "moe.expert_dispatch."
+MOE_DROP_COUNTER = "moe.overflow_dropped"
+MOE_SERVE_GAUGE_MARK = ".expert_load."
+
 # SLO attribution (docs/observability.md "Request tracing"): the tracing
 # layer folds every retired request's span timeline into per-phase
 # serve.attr.*_ms histograms — a ttft/e2e p99 regression names its phase
@@ -409,6 +417,23 @@ def summarize(records):
         disagg["serve.handoff_wait_ms"] = wait
     if disagg:
         out["disaggregation"] = disagg
+    moe = {k: int(v) for k, v in final.items()
+           if k.startswith(MOE_DISPATCH_PREFIX) and v}
+    if final.get(MOE_DROP_COUNTER):
+        moe[MOE_DROP_COUNTER] = int(final[MOE_DROP_COUNTER])
+    for r in records:
+        for k, v in r.get("gauges", {}).items():
+            if k.startswith("serve.") and MOE_SERVE_GAUGE_MARK in k:
+                moe[k] = v  # last-seen per replica
+    if moe:
+        # load balance: max over experts / mean over experts of the
+        # cumulative dispatch counters (1.0 = perfectly balanced)
+        counts = [v for k, v in moe.items()
+                  if k.startswith(MOE_DISPATCH_PREFIX)]
+        if counts and sum(counts):
+            moe["load_imbalance"] = round(
+                max(counts) / (sum(counts) / float(len(counts))), 4)
+        out["moe"] = moe
     attribution = {}
     for name in SERVE_ATTR_HISTS:
         agg = _merge_hists(records, name)
@@ -548,6 +573,11 @@ def format_summary(summary):
                                 v["max"]))
             else:
                 lines.append("    %-24s %s" % (key, v))
+    moe = summary.get("moe")
+    if moe:
+        lines.append("  mixture-of-experts:")
+        for key in sorted(moe):
+            lines.append("    %-32s %s" % (key, moe[key]))
     attribution = summary.get("attribution")
     if attribution:
         lines.append("  attribution:")
